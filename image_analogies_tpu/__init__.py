@@ -1,0 +1,36 @@
+"""image_analogies_tpu — a TPU-native Image Analogies framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capability surface of
+`flair2005/image-analogies-python` (Hertzmann et al., Image Analogies,
+SIGGRAPH 2001): texture-by-numbers, artistic filters, super-resolution
+analogies and luminance-only transfer, driven by a coarse-to-fine pyramid
+synthesizer whose per-level best-match step runs as PatchMatch sweeps
+(jitted XLA sweeps; Pallas kernels in progress) behind a `Matcher` plugin
+interface.  See SURVEY.md for the blueprint and component inventory.
+
+The package name is the importable form of the task's
+`image-analogies-python_tpu` (hyphens are not valid in Python modules).
+"""
+
+from .config import SynthConfig
+from .models import (
+    available_matchers,
+    create_image_analogy,
+    get_matcher,
+    register_matcher,
+)
+from .utils import load_image, psnr, save_image
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SynthConfig",
+    "create_image_analogy",
+    "available_matchers",
+    "get_matcher",
+    "register_matcher",
+    "load_image",
+    "save_image",
+    "psnr",
+    "__version__",
+]

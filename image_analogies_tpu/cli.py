@@ -1,0 +1,181 @@
+"""CLI (SURVEY.md §2 C13) — `python -m image_analogies_tpu.cli`.
+
+Subcommands:
+  synth     A + A' + B -> B'   (the reference's main entry point)
+  batch     A + A' + frame dir -> stylized frames (config 5)
+  examples  generate the procedural example assets (C14)
+
+Flags mirror the reference's knob surface (levels, patch size, kappa,
+matcher) plus `--device {cpu,tpu}` to pick the JAX backend [north star].
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _add_synth_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--levels", type=int, default=5)
+    p.add_argument("--patch-size", type=int, default=5)
+    p.add_argument("--coarse-patch-size", type=int, default=3)
+    p.add_argument("--kappa", type=float, default=0.0)
+    p.add_argument(
+        "--matcher", default="patchmatch", help="brute | patchmatch"
+    )
+    p.add_argument(
+        "--color-mode", default="luminance", choices=["luminance", "rgb"]
+    )
+    p.add_argument("--steerable", action="store_true")
+    p.add_argument("--no-luminance-remap", action="store_true")
+    p.add_argument("--em-iters", type=int, default=3)
+    p.add_argument("--pm-iters", type=int, default=6)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--device", default=None, choices=["cpu", "tpu"])
+    p.add_argument("--save-level-artifacts", default=None)
+    p.add_argument("--progress", default=None, help="JSONL progress path")
+
+
+def _config_from(args) -> "SynthConfig":
+    from .config import SynthConfig
+
+    return SynthConfig(
+        levels=args.levels,
+        patch_size=args.patch_size,
+        coarse_patch_size=args.coarse_patch_size,
+        kappa=args.kappa,
+        matcher=args.matcher,
+        color_mode=args.color_mode,
+        steerable=args.steerable,
+        luminance_remap=not args.no_luminance_remap,
+        em_iters=args.em_iters,
+        pm_iters=args.pm_iters,
+        seed=args.seed,
+        save_level_artifacts=args.save_level_artifacts,
+    )
+
+
+def _select_device(device: str | None) -> None:
+    from .utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    if device == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    # 'tpu' / None: keep the default platform (TPU when present).
+
+
+def cmd_synth(args) -> int:
+    _select_device(args.device)
+    from .models.analogy import create_image_analogy
+    from .utils.io import load_image, save_image
+    from .utils.progress import ProgressWriter
+
+    progress = ProgressWriter(args.progress)
+    a = load_image(args.a)
+    ap = load_image(args.ap)
+    b = load_image(args.b)
+    cfg = _config_from(args)
+    progress.emit("start", shape=list(b.shape), matcher=cfg.matcher)
+    t0 = time.perf_counter()
+    bp = create_image_analogy(a, ap, b, cfg)
+    bp.block_until_ready()
+    progress.emit("done", wall_s=round(time.perf_counter() - t0, 3))
+    save_image(args.out, bp)
+    print(f"wrote {args.out} ({time.perf_counter() - t0:.2f}s)")
+    return 0
+
+
+def cmd_batch(args) -> int:
+    _select_device(args.device)
+    import numpy as np
+
+    from .parallel.batch import synthesize_batch
+    from .parallel.mesh import make_mesh
+    from .utils.io import load_image, save_image
+    from .utils.progress import ProgressWriter
+
+    progress = ProgressWriter(args.progress)
+    a = load_image(args.a)
+    ap = load_image(args.ap)
+    names = sorted(
+        f for f in os.listdir(args.frames)
+        if f.lower().endswith((".png", ".jpg", ".jpeg"))
+    )
+    frames = np.stack([load_image(os.path.join(args.frames, f)) for f in names])
+    cfg = _config_from(args)
+    mesh = make_mesh(args.n_devices)
+    t0 = time.perf_counter()
+    bps = np.asarray(synthesize_batch(a, ap, frames, cfg, mesh, progress=progress))
+    os.makedirs(args.out, exist_ok=True)
+    for name, bp in zip(names, bps):
+        save_image(os.path.join(args.out, name), bp)
+    print(
+        f"wrote {len(names)} frames to {args.out} "
+        f"({time.perf_counter() - t0:.2f}s on {mesh.devices.size} devices)"
+    )
+    return 0
+
+
+def cmd_examples(args) -> int:
+    import numpy as np
+
+    from .utils import examples as ex
+    from .utils.io import save_image
+
+    os.makedirs(args.out, exist_ok=True)
+    sets = {
+        "texture_by_numbers": ex.texture_by_numbers(args.size),
+        "artistic_filter": ex.artistic_filter(args.size),
+        "super_resolution": ex.super_resolution(args.size),
+    }
+    for name, (a, ap, b) in sets.items():
+        for tag, img in [("A", a), ("Ap", ap), ("B", b)]:
+            save_image(os.path.join(args.out, f"{name}_{tag}.png"), img)
+    a, ap, frames = ex.npr_frames(4, args.size)
+    save_image(os.path.join(args.out, "npr_A.png"), a)
+    save_image(os.path.join(args.out, "npr_Ap.png"), ap)
+    for i, f in enumerate(np.asarray(frames)):
+        save_image(os.path.join(args.out, f"npr_frame_{i}.png"), f)
+    print(f"wrote example assets to {args.out}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="image_analogies_tpu",
+        description="TPU-native Image Analogies (A : A' :: B : B')",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("synth", help="synthesize B' from A, A', B")
+    p.add_argument("--a", required=True)
+    p.add_argument("--ap", required=True)
+    p.add_argument("--b", required=True)
+    p.add_argument("--out", required=True)
+    _add_synth_flags(p)
+    p.set_defaults(fn=cmd_synth)
+
+    p = sub.add_parser("batch", help="stylize a directory of frames")
+    p.add_argument("--a", required=True)
+    p.add_argument("--ap", required=True)
+    p.add_argument("--frames", required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--n-devices", type=int, default=None)
+    _add_synth_flags(p)
+    p.set_defaults(fn=cmd_batch)
+
+    p = sub.add_parser("examples", help="generate procedural example assets")
+    p.add_argument("--out", default="examples")
+    p.add_argument("--size", type=int, default=256)
+    p.set_defaults(fn=cmd_examples)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Configuration for image-analogy synthesis.
+
+The reference exposes its knobs as CLI flags (levels, patch size, kappa,
+matcher choice — SURVEY.md §2 C13, BASELINE.json north star).  Here they are
+a frozen dataclass so configs are hashable and can be closed over by jitted
+functions without retracing surprises.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class SynthConfig:
+    """All knobs for `create_image_analogy`.
+
+    Mirrors the reference capability surface (SURVEY.md §2):
+      - `levels`, `patch_size`, `coarse_patch_size`: pyramid + neighborhood
+        geometry (Hertzmann §3.1: 5x5 at level l, 3x3 at level l-1).
+      - `kappa`: Ashikhmin coherence weight (Hertzmann §3.2); 0 disables
+        coherence and yields pure nearest-neighbor matching.
+      - `matcher`: registry key — 'brute' | 'patchmatch' (SURVEY.md C6).
+      - `color_mode`: 'luminance' matches on Y and copies IQ chroma from B
+        (Hertzmann §3.4); 'rgb' matches/copies full color.
+      - `steerable`: append oriented derivative-of-Gaussian responses to the
+        feature vectors (SURVEY.md C4, config 4).
+    """
+
+    levels: int = 5
+    patch_size: int = 5
+    coarse_patch_size: int = 3
+    kappa: float = 0.0
+    matcher: str = "patchmatch"
+    color_mode: str = "luminance"
+    steerable: bool = False
+    n_orientations: int = 4
+    luminance_remap: bool = True
+
+    # PatchMatch / EM schedule (TPU reformulation of the scan-order loop,
+    # SURVEY.md §3.3 and §7 "hard parts").
+    pm_iters: int = 6            # propagate+random-search sweeps per EM step
+    em_iters: int = 3            # B' re-estimation rounds per level
+    pm_random_candidates: int = 6  # random-search scales per sweep
+    seed: int = 0
+
+    # Feature weighting: Gaussian falloff over the neighborhood window.
+    gaussian_weighting: bool = True
+
+    # Matching precision on device ('float32' is the oracle-faithful default;
+    # 'bfloat16' halves HBM traffic for the distance evaluations).
+    match_dtype: str = "float32"
+
+    # Brute-force matcher query chunk (rows of the distance matrix computed
+    # per step; bounds peak HBM for the (chunk, N_A) distance tile).
+    brute_chunk: int = 4096
+
+    # Minimum image side at the coarsest pyramid level; levels are clamped
+    # so the coarsest level is at least this big.
+    min_size: int = 16
+
+    # Optional per-level artifact dump directory (checkpoint/resume,
+    # SURVEY.md §5) — None disables.
+    save_level_artifacts: Optional[str] = None
+
+    def __post_init__(self):
+        if self.patch_size % 2 != 1 or self.coarse_patch_size % 2 != 1:
+            raise ValueError("patch sizes must be odd")
+        if self.color_mode not in ("luminance", "rgb"):
+            raise ValueError(f"unknown color_mode {self.color_mode!r}")
+        if self.levels < 1:
+            raise ValueError("levels must be >= 1")
+        if self.em_iters < 1 or self.pm_iters < 1:
+            raise ValueError("em_iters and pm_iters must be >= 1")
+
+    def clamp_levels(self, *shapes: Tuple[int, int]) -> int:
+        """Number of usable pyramid levels for the given image shapes."""
+        side = min(min(s[0], s[1]) for s in shapes)
+        n = 1
+        while n < self.levels and (side >> n) >= self.min_size:
+            n += 1
+        return n

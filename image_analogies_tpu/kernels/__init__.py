@@ -1,0 +1,1 @@
+"""Pallas TPU kernels (SURVEY.md C9): filled in by kernels modules."""

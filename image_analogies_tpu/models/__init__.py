@@ -1,0 +1,28 @@
+"""Synthesis driver + matcher implementations (SURVEY.md §2 C6-C11)."""
+
+from .matcher import (
+    Matcher,
+    available_matchers,
+    get_matcher,
+    register_matcher,
+)
+from .brute import BruteForceMatcher, exact_nn
+from .patchmatch import PatchMatchMatcher, patchmatch_sweeps, random_init
+from .coherence import CoherenceWrapper, coherence_sweeps
+from .analogy import create_image_analogy, upsample_nnf
+
+__all__ = [
+    "Matcher",
+    "available_matchers",
+    "get_matcher",
+    "register_matcher",
+    "BruteForceMatcher",
+    "exact_nn",
+    "PatchMatchMatcher",
+    "patchmatch_sweeps",
+    "random_init",
+    "CoherenceWrapper",
+    "coherence_sweeps",
+    "create_image_analogy",
+    "upsample_nnf",
+]

@@ -1,0 +1,242 @@
+"""Coarse-to-fine analogy synthesis driver (SURVEY.md §2 C11, §3.1).
+
+Implements `create_image_analogy(A, A', B) -> B'` — the reference's main
+entry point [Hertzmann Fig. 1].  The pyramid loop stays a thin Python
+driver (5 levels => negligible host overhead [north star]); each EM step at
+a level is one jitted function (feature assembly + matcher sweeps + B'
+recomposition), so the per-pixel hot loop of the reference becomes a
+handful of whole-image compiled calls per level (SURVEY.md §3 hot loops).
+
+TPU reformulation of the scan-order loop (SURVEY.md §7 "hard parts"):
+instead of synthesizing B' pixel-by-pixel with causal windows, each level
+alternates
+    1. match:  NN-field from full-window features of the current B',
+    2. render: B'(q) <- A'(s(q)),
+for `em_iters` rounds (an EM fixed point).  Coherence enters through the
+matcher (fused propagation candidates / the kappa rule).  The s-map is
+upsampled between levels with doubled offsets, exactly the reference's
+s(q) bookkeeping.
+
+Luminance-only transfer (C12): matching runs on Y (optionally + steerable
+responses of Y); chroma is copied from B at the end (Hertzmann §3.4).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..config import SynthConfig
+from ..ops.color import luminance, rgb_to_yiq, yiq_to_rgb
+from ..ops.features import assemble_features
+from ..ops.pyramid import build_pyramid, upsample
+from ..ops.remap import remap_luminance
+from ..ops.steerable import steerable_responses
+from .matcher import clamp_nnf, get_matcher
+from .patchmatch import random_init
+
+# Ensure built-in matchers are registered on import.
+from . import brute as _brute  # noqa: F401
+from . import coherence as _coherence  # noqa: F401
+from . import patchmatch as _patchmatch  # noqa: F401
+
+
+def _with_steerable(y: jnp.ndarray, cfg: SynthConfig) -> jnp.ndarray:
+    """Source-side match channels: luminance (+ steerable bank of Y).
+
+    Steerable responses augment the *unfiltered* images only (Hertzmann
+    §3.1); filtered images (A', B') match on raw intensity so the evolving
+    B' estimate never needs its filter bank recomputed mid-EM.
+    """
+    if not cfg.steerable:
+        return y
+    # In rgb mode the oriented filters still run on luminance — responses
+    # are contrast features, not per-channel ones (Hertzmann §3.1).
+    resp = steerable_responses(luminance(y), cfg.n_orientations)
+    if y.ndim == 2:
+        y = y[..., jnp.newaxis]
+    return jnp.concatenate([y, resp], axis=-1)
+
+
+def _gather_image(img: jnp.ndarray, nnf: jnp.ndarray) -> jnp.ndarray:
+    """B'(q) = img(s(q)): row-gather of copy channels at the match field."""
+    ha, wa = img.shape[:2]
+    flat = img.reshape(ha * wa, -1)
+    idx = nnf[..., 0] * wa + nnf[..., 1]
+    out = jnp.take(flat, idx.reshape(-1), axis=0)
+    out = out.reshape(*nnf.shape[:2], -1)
+    return out[..., 0] if img.ndim == 2 else out
+
+
+def upsample_nnf(nnf: jnp.ndarray, target_shape, ha: int, wa: int) -> jnp.ndarray:
+    """s-map to the next finer level: parent offsets doubled + child parity
+    (SURVEY.md §3.1 'upsample s_l -> init s_{l-1}')."""
+    h, w = target_shape
+    up = jnp.repeat(jnp.repeat(nnf, 2, axis=0), 2, axis=1)[:h, :w] * 2
+    py = jax.lax.broadcasted_iota(jnp.int32, (h, w), 0) % 2
+    px = jax.lax.broadcasted_iota(jnp.int32, (h, w), 1) % 2
+    up = up + jnp.stack([py, px], axis=-1)
+    return clamp_nnf(up, ha, wa)
+
+
+def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool):
+    """One EM step at one pyramid level: features -> match -> render.
+
+    Pure function of its array arguments (vmap-able over a frame axis for
+    the batched runner, SURVEY.md C15).
+    """
+    matcher = get_matcher(cfg.matcher)
+
+    def em_step(src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf, key):
+        f_b = assemble_features(
+            src_b,
+            flt_b,
+            cfg,
+            src_b_c if has_coarse else None,
+            flt_b_c if has_coarse else None,
+        )
+        nnf, dist = matcher.match(
+            f_b, f_a, nnf, key=key, level=level, cfg=cfg
+        )
+        bp = _gather_image(copy_a, nnf)
+        return nnf, dist, bp
+
+    return em_step
+
+
+@functools.lru_cache(maxsize=64)
+def _em_step_fn(cfg: SynthConfig, level: int, has_coarse: bool):
+    """Compiled EM step for one pyramid level (cached per config+level)."""
+    return jax.jit(make_em_step(cfg, level, has_coarse))
+
+
+def _resolve_channels(a, ap, b, cfg: SynthConfig):
+    """Split inputs into (match-src, match-flt, copy) channel images."""
+    if cfg.color_mode == "luminance":
+        color = b.ndim == 3
+        yiq_b = rgb_to_yiq(b) if color else None
+        y_b = yiq_b[..., 0] if color else b
+        y_a = rgb_to_yiq(a)[..., 0] if a.ndim == 3 else a
+        y_ap = rgb_to_yiq(ap)[..., 0] if ap.ndim == 3 else ap
+        if cfg.luminance_remap:
+            y_a, y_ap = remap_luminance(y_a, y_ap, y_b)
+        # copy channels == A' luminance; chroma recombined at the end.
+        return y_a, y_ap, y_b, y_ap, yiq_b
+    # rgb: match and copy full color, no remapping.
+    return a, ap, b, ap, None
+
+
+def create_image_analogy(
+    a,
+    ap,
+    b,
+    cfg: Optional[SynthConfig] = None,
+    return_aux: bool = False,
+):
+    """Synthesize B' such that A : A' :: B : B'.
+
+    `a`, `ap`, `b`: float arrays in [0,1], (H,W,3) RGB or (H,W) gray; `a`
+    and `ap` must share a shape.  Returns B' shaped like `b` (or a dict of
+    auxiliary per-level artifacts when `return_aux`).
+    """
+    cfg = cfg or SynthConfig()
+    a = jnp.asarray(a, jnp.float32)
+    ap = jnp.asarray(ap, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    if a.shape != ap.shape:
+        raise ValueError(f"A {a.shape} and A' {ap.shape} must match")
+
+    src_a, flt_a, src_b, copy_a, yiq_b = _resolve_channels(a, ap, b, cfg)
+
+    levels = cfg.clamp_levels(a.shape[:2], b.shape[:2])
+    pyr_src_a = [_with_steerable(x, cfg) for x in build_pyramid(src_a, levels)]
+    pyr_flt_a = build_pyramid(flt_a, levels)
+    pyr_src_b = [_with_steerable(x, cfg) for x in build_pyramid(src_b, levels)]
+    pyr_copy_a = build_pyramid(copy_a, levels)
+    # B-side raw (un-augmented) pyramid seeds the B' estimate.
+    pyr_raw_b = build_pyramid(src_b, levels)
+
+    key = jax.random.PRNGKey(cfg.seed)
+    aux: Dict[str, List] = {"nnf": [None] * levels, "dist": [None] * levels}
+
+    bp = None  # synthesized copy-channel image at current level
+    flt_bp = None  # match-channel (filtered-side) B' estimate
+    flt_bp_coarse = None
+    nnf = None
+
+    for level in range(levels - 1, -1, -1):
+        f_a_src = pyr_src_a[level]
+        h, w = pyr_src_b[level].shape[:2]
+        ha, wa = f_a_src.shape[:2]
+        has_coarse = level < levels - 1
+
+        f_a = assemble_features(
+            f_a_src,
+            pyr_flt_a[level],
+            cfg,
+            pyr_src_a[level + 1] if has_coarse else None,
+            pyr_flt_a[level + 1] if has_coarse else None,
+        )
+
+        level_key = jax.random.fold_in(key, level)
+        if has_coarse:
+            nnf = upsample_nnf(nnf, (h, w), ha, wa)
+            flt_bp_coarse = flt_bp
+            flt_bp = upsample(flt_bp, (h, w))
+            bp = upsample(bp, (h, w))
+        else:
+            nnf = random_init(level_key, h, w, ha, wa)
+            flt_bp = pyr_raw_b[level]
+            bp = pyr_copy_a[level]  # overwritten by first render
+
+        step = _em_step_fn(cfg, level, has_coarse)
+        for em in range(cfg.em_iters):
+            nnf, dist, bp = step(
+                pyr_src_b[level],
+                flt_bp,
+                pyr_src_b[level + 1] if has_coarse else pyr_src_b[level],
+                flt_bp_coarse if has_coarse else flt_bp,
+                f_a,
+                pyr_copy_a[level],
+                nnf,
+                jax.random.fold_in(level_key, em),
+            )
+            # The filtered-side match channels of B' are the synthesized
+            # copy channels (luminance mode) or their luminance (rgb mode).
+            flt_bp = bp
+
+        aux["nnf"][level] = nnf
+        aux["dist"][level] = dist
+        if cfg.save_level_artifacts:
+            _save_level(cfg.save_level_artifacts, level, nnf, dist, bp)
+
+    out = _finalize(bp, yiq_b, b, cfg)
+    if return_aux:
+        return {"bp": out, "nnf": aux["nnf"], "dist": aux["dist"]}
+    return out
+
+
+def _finalize(bp, yiq_b, b, cfg: SynthConfig):
+    """Recombine chroma (luminance mode) and clip to [0,1]."""
+    if cfg.color_mode == "luminance" and b.ndim == 3:
+        yiq = jnp.concatenate([bp[..., None], yiq_b[..., 1:]], axis=-1)
+        out = yiq_to_rgb(yiq)
+    else:
+        out = bp
+    return jnp.clip(out, 0.0, 1.0)
+
+
+def _save_level(path: str, level: int, nnf, dist, bp) -> None:
+    """Per-level checkpoint artifacts (SURVEY.md §5 checkpoint/resume)."""
+    os.makedirs(path, exist_ok=True)
+    np.savez(
+        os.path.join(path, f"level_{level}.npz"),
+        nnf=np.asarray(nnf),
+        dist=np.asarray(dist),
+        bp=np.asarray(bp),
+    )

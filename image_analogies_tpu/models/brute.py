@@ -1,0 +1,90 @@
+"""Brute-force exact nearest-neighbor matcher (SURVEY.md §2 C7).
+
+The reference's brute-force NN is a NumPy full-distance scan
+[BASELINE.json config 1 "brute-force NN"].  The TPU formulation turns it
+into tiled MXU matmuls:
+
+    ||b - a||^2 = ||b||^2 - 2 b.a^T + ||a||^2
+
+so the hot loop is one (chunk, D) x (D, N_A) contraction per query chunk —
+exactly what the systolic array wants — followed by an argmin reduction.
+Queries are processed in chunks of `cfg.brute_chunk` rows via `lax.map`, so
+peak HBM for the distance tile is chunk * N_A * 4 bytes regardless of image
+size.
+
+This matcher is the correctness oracle: the "CPU ref" of the north-star
+PSNR metric [BASELINE.json:2] is this exact path run on the CPU backend
+(SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SynthConfig
+from .matcher import Matcher, flat_to_nnf, register_matcher
+
+
+def exact_nn(
+    f_b_flat: jnp.ndarray,
+    f_a_flat: jnp.ndarray,
+    chunk: int,
+    match_dtype=jnp.float32,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact argmin_{p} ||f_b[q] - f_a[p]||^2 for every query row.
+
+    Returns (idx (N,), dist (N,)).  `dist` is recomputed exactly (float32,
+    direct subtraction) for the winning index so downstream accept tests
+    (coherence kappa rule) see the same metric as `candidate_dist`, immune
+    to the matmul expansion's cancellation error.
+    """
+    n = f_b_flat.shape[0]
+    fa = f_a_flat.astype(match_dtype)
+    a_sq = jnp.sum(
+        f_a_flat.astype(jnp.float32) * f_a_flat.astype(jnp.float32), axis=-1
+    )
+
+    n_pad = (-n) % chunk
+    fb_padded = jnp.pad(f_b_flat, ((0, n_pad), (0, 0)))
+    fb_chunks = fb_padded.reshape(-1, chunk, f_b_flat.shape[-1])
+
+    def one_chunk(fb):
+        # (chunk, D) x (D, N_A) on the MXU; f32 accumulation.
+        cross = jax.lax.dot_general(
+            fb.astype(match_dtype),
+            fa,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        d = a_sq[None, :] - 2.0 * cross  # ||b||^2 constant per row: skip
+        return jnp.argmin(d, axis=-1)
+
+    idx = jax.lax.map(one_chunk, fb_chunks).reshape(-1)[:n]
+    rows = jnp.take(f_a_flat, idx, axis=0)
+    diff = f_b_flat - rows
+    dist = jnp.sum(diff * diff, axis=-1)
+    return idx, dist
+
+
+class BruteForceMatcher(Matcher):
+    """Exact NN via chunked MXU distance tiles; ignores the incoming NNF."""
+
+    name = "brute"
+
+    def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig):
+        h, w, d = f_b.shape
+        ha, wa = f_a.shape[:2]
+        match_dtype = jnp.dtype(cfg.match_dtype)
+        idx, dist = exact_nn(
+            f_b.reshape(-1, d),
+            f_a.reshape(-1, d),
+            chunk=min(cfg.brute_chunk, h * w),
+            match_dtype=match_dtype,
+        )
+        return flat_to_nnf(idx, wa, (h, w)), dist.reshape(h, w)
+
+
+register_matcher("brute", BruteForceMatcher())

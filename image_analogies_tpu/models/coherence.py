@@ -1,0 +1,104 @@
+"""Ashikhmin coherence search as a composable wrapper (SURVEY.md §2 C10).
+
+The reference composes coherence search on top of its approximate matcher
+[BASELINE.json north star: "ANN/PatchMatch ... plus Ashikhmin coherence
+search"].  Here it is a `Matcher` wrapper: run the base matcher, then do
+Jacobi sweeps in which each pixel considers its neighbors' matches shifted
+by the relative offset (r* = s(r) + (q - r), Hertzmann §3.2 / Ashikhmin
+2001) and adopts one when
+
+    d_coherent < d_incumbent_effective
+
+where an *approximate* incumbent defends with d * (1 + 2^-level * kappa)
+and a *coherent* incumbent defends with its raw distance.  This is the
+paper's acceptance rule with scan-order recursion replaced by parallel
+sweeps (SURVEY.md §7 "sequential-vs-parallel tension").
+
+For the PatchMatch matcher coherence is already fused into propagation
+(models/patchmatch.py), so this wrapper is registered over the brute-force
+matcher only — giving the exact-NN + coherence combination the reference
+reaches with `--matcher brute --kappa K`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..config import SynthConfig
+from .matcher import (
+    Matcher,
+    candidate_dist,
+    clamp_nnf,
+    nnf_to_flat,
+    register_matcher,
+)
+from .brute import BruteForceMatcher
+from .patchmatch import _DELTAS, _shifted, kappa_factor
+
+
+def coherence_sweeps(
+    f_b: jnp.ndarray,
+    f_a: jnp.ndarray,
+    nnf: jnp.ndarray,
+    dist: jnp.ndarray,
+    *,
+    factor: float,
+    sweeps: int,
+) -> tuple:
+    """Bias an existing match field toward coherent source regions.
+
+    Faithful parallelization of the per-pixel rule: the approximate match
+    distance d_app fixes a per-pixel acceptance *ceiling* factor * d_app; a
+    coherent candidate is adopted iff it (a) clears the ceiling and (b)
+    beats the best coherent candidate seen so far (raw distance).  Jacobi
+    sweeps extend coherent chains the way scan order does — candidates in
+    later sweeps derive from already-adopted coherent matches.
+    """
+    h, w, d = f_b.shape
+    ha, wa = f_a.shape[:2]
+    f_b_flat = f_b.reshape(-1, d)
+    f_a_flat = f_a.reshape(-1, d)
+
+    ceiling = dist * factor
+    best_coh = jnp.full_like(dist, jnp.inf)
+
+    for _ in range(sweeps):
+        for dy, dx in _DELTAS:
+            cand = clamp_nnf(_shifted(nnf, dy, dx), ha, wa)
+            d_cand = candidate_dist(
+                f_b_flat, f_a_flat, nnf_to_flat(cand, wa)
+            ).reshape(h, w)
+            accept = (d_cand < best_coh) & (d_cand <= ceiling)
+            nnf = jnp.where(accept[..., None], cand, nnf)
+            dist = jnp.where(accept, d_cand, dist)
+            best_coh = jnp.where(accept, d_cand, best_coh)
+    return nnf, dist
+
+
+class CoherenceWrapper(Matcher):
+    """base matcher + kappa-biased coherence sweeps (no-op at kappa=0)."""
+
+    def __init__(self, base: Matcher, sweeps: int = 2):
+        self.base = base
+        self.name = base.name
+        self.sweeps = sweeps
+
+    def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig):
+        nnf, dist = self.base.match(
+            f_b, f_a, nnf, key=key, level=level, cfg=cfg
+        )
+        if cfg.kappa > 0.0:
+            nnf, dist = coherence_sweeps(
+                f_b,
+                f_a,
+                nnf,
+                dist,
+                factor=kappa_factor(cfg.kappa, level),
+                sweeps=self.sweeps,
+            )
+        return nnf, dist
+
+
+# 'brute' resolves to exact NN with the kappa rule available on top —
+# matching the reference's matcher x kappa flag matrix.
+register_matcher("brute", CoherenceWrapper(BruteForceMatcher()))

@@ -1,0 +1,121 @@
+"""`Matcher` plugin interface + registry (SURVEY.md §2 C6).
+
+The reference selects its search strategy (brute-force NN vs ANN) through a
+`Matcher` plugin interface [BASELINE.json north star]; this module is that
+interface for the TPU build.  A matcher maps feature fields to a
+nearest-neighbor field:
+
+    match(f_b (H,W,D), f_a (Ha,Wa,D), nnf (H,W,2), key, level) -> (nnf, dist)
+
+where nnf[q] = (py, px) into A and dist[q] is the (weighted, squared) L2
+feature distance of that correspondence.  Matchers are pure functions of
+their inputs — jit-safe, vmap-able for the batched runner (SURVEY.md C15).
+
+Shared distance helpers live here so every matcher (and the coherence
+wrapper) agrees on the metric exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SynthConfig
+
+# ---------------------------------------------------------------------------
+# Shared geometry / distance helpers
+
+
+def flatten_field(f: jnp.ndarray) -> jnp.ndarray:
+    """(H, W, D) -> (H*W, D)."""
+    return f.reshape(-1, f.shape[-1])
+
+
+def nnf_to_flat(nnf: jnp.ndarray, wa: int) -> jnp.ndarray:
+    """(H, W, 2) int (py, px) -> (H*W,) flat row-major indices into A."""
+    return (nnf[..., 0] * wa + nnf[..., 1]).reshape(-1)
+
+
+def flat_to_nnf(idx: jnp.ndarray, wa: int, shape) -> jnp.ndarray:
+    """(H*W,) flat A indices -> (H, W, 2)."""
+    return jnp.stack([idx // wa, idx % wa], axis=-1).reshape(*shape, 2)
+
+
+def clamp_nnf(nnf: jnp.ndarray, ha: int, wa: int) -> jnp.ndarray:
+    return jnp.stack(
+        [
+            jnp.clip(nnf[..., 0], 0, ha - 1),
+            jnp.clip(nnf[..., 1], 0, wa - 1),
+        ],
+        axis=-1,
+    )
+
+
+def candidate_dist(
+    f_b_flat: jnp.ndarray,
+    f_a_flat: jnp.ndarray,
+    idx: jnp.ndarray,
+) -> jnp.ndarray:
+    """Distance between each query row and A-row `idx[q]`; (N,)."""
+    rows = jnp.take(f_a_flat, idx, axis=0)
+    diff = f_b_flat - rows
+    return jnp.sum(diff * diff, axis=-1)
+
+
+def nnf_dist(
+    f_b: jnp.ndarray,
+    f_a_flat: jnp.ndarray,
+    nnf: jnp.ndarray,
+    wa: int,
+) -> jnp.ndarray:
+    """Squared feature distance of each correspondence; (H, W)."""
+    h, w, d = f_b.shape
+    idx = nnf_to_flat(nnf, wa)
+    return candidate_dist(f_b.reshape(-1, d), f_a_flat, idx).reshape(h, w)
+
+
+# ---------------------------------------------------------------------------
+# Registry
+
+MatchFn = Callable[..., Tuple[jnp.ndarray, jnp.ndarray]]
+_REGISTRY: Dict[str, "Matcher"] = {}
+
+
+class Matcher:
+    """Base class: subclasses implement `match` (pure, jit-safe)."""
+
+    name: str = "base"
+
+    def match(
+        self,
+        f_b: jnp.ndarray,
+        f_a: jnp.ndarray,
+        nnf: jnp.ndarray,
+        *,
+        key: jax.Array,
+        level: int,
+        cfg: SynthConfig,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(name={self.name!r})"
+
+
+def register_matcher(name: str, matcher: Matcher) -> None:
+    _REGISTRY[name] = matcher
+
+
+def get_matcher(name: str) -> Matcher:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown matcher {name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def available_matchers():
+    return sorted(_REGISTRY)

@@ -1,0 +1,163 @@
+"""PatchMatch NN-field matcher (SURVEY.md §2 C9 + C10; Barnes 2009).
+
+The reference accelerates matching with a host-side ANN library (kd-tree
+family, C++) [SURVEY.md C8].  Pointer-chasing trees are anti-idiomatic on
+TPU; the TPU-native ANN for nearest-neighbor *fields* is PatchMatch, whose
+sweeps are whole-image vectorized ops (SURVEY.md §2 C8->C9 mapping).
+
+Each sweep evaluates, per pixel, a fixed-size candidate set (TPU wants no
+divergence — SURVEY.md §7 "ragged candidate sets"):
+
+  - 4 propagation candidates  nnf(q -/+ delta) + delta  — these are exactly
+    Ashikhmin's coherence candidates r* = s(r) + (q - r) (Hertzmann §3.2),
+    so coherence search is fused into propagation rather than bolted on;
+  - `pm_random_candidates` random-search candidates at exponentially
+    shrinking radii around the current match (Barnes §3.2).
+
+The kappa rule (Hertzmann §3.2): a *non-coherent* (random-search) candidate
+must beat the incumbent by the factor 1 + 2^-level * kappa (level 0 =
+finest, so the coherence bias is strongest at full resolution).  With
+kappa=0 this is plain PatchMatch and converges to the exact NN field — the
+basis of the PSNR-vs-brute oracle tests (SURVEY.md §4).
+
+This module is the pure-JAX (XLA gather) formulation; it is both the
+reference implementation for the Pallas kernel (kernels/) and the portable
+path for CPU tests.  Sweeps are a `lax.scan` over iteration keys, so the
+whole per-level matching is one compiled loop [north star: no per-pixel
+Python steps].
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SynthConfig
+from .matcher import (
+    Matcher,
+    candidate_dist,
+    clamp_nnf,
+    flat_to_nnf,
+    nnf_dist,
+    nnf_to_flat,
+    register_matcher,
+)
+
+# Propagation neighborhood: left, right, up, down.
+_DELTAS = ((0, -1), (0, 1), (-1, 0), (1, 0))
+
+
+def random_init(key: jax.Array, h: int, w: int, ha: int, wa: int) -> jnp.ndarray:
+    """Uniform random NNF (H, W, 2) over A's domain."""
+    ky, kx = jax.random.split(key)
+    py = jax.random.randint(ky, (h, w), 0, ha)
+    px = jax.random.randint(kx, (h, w), 0, wa)
+    return jnp.stack([py, px], axis=-1)
+
+
+def _shifted(nnf: jnp.ndarray, dy: int, dx: int) -> jnp.ndarray:
+    """Propagation candidate field: nnf(q - delta) + delta.
+
+    Implemented as a roll; wrapped-around rows/cols produce harmless
+    candidates that simply lose the accept test after clamping.
+    """
+    cand = jnp.roll(nnf, shift=(dy, dx), axis=(0, 1))
+    return cand + jnp.array([dy, dx], dtype=nnf.dtype)
+
+
+def patchmatch_sweeps(
+    f_b: jnp.ndarray,
+    f_a: jnp.ndarray,
+    nnf: jnp.ndarray,
+    key: jax.Array,
+    *,
+    iters: int,
+    n_random: int,
+    coh_factor: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Run `iters` propagate+random-search sweeps; returns (nnf, dist).
+
+    `coh_factor` >= 1 biases acceptance toward coherent (propagation)
+    candidates: random candidates must satisfy d * coh_factor < d_current.
+    """
+    h, w, d = f_b.shape
+    ha, wa = f_a.shape[:2]
+    f_b_flat = f_b.reshape(-1, d)
+    f_a_flat = f_a.reshape(-1, d)
+
+    nnf = clamp_nnf(nnf, ha, wa)
+    dist = nnf_dist(f_b, f_a_flat, nnf, wa)
+
+    # Exponential random-search radii: max dim, halving per scale (Barnes
+    # alpha = 0.5), floored at 1 px.
+    max_radius = max(ha, wa)
+    radii = [max(1, int(max_radius * (0.5**s))) for s in range(n_random)]
+
+    def try_candidates(state, cand, factor):
+        nnf_cur, dist_cur = state
+        cand = clamp_nnf(cand, ha, wa)
+        idx = nnf_to_flat(cand, wa)
+        d_cand = candidate_dist(f_b_flat, f_a_flat, idx).reshape(h, w)
+        # Exact ties break toward the lower flat index — the same canonical
+        # representative `jnp.argmin` picks in the brute-force oracle.  In
+        # flat feature regions (ubiquitous in texture-by-numbers label maps)
+        # ties are massive, and without a shared canonicalization the
+        # approximate and exact paths would diverge on valid-but-different
+        # matches, sinking the PSNR-vs-oracle metric for no quality reason.
+        idx_cur = nnf_to_flat(nnf_cur, wa).reshape(h, w)
+        better = d_cand * factor < dist_cur
+        tie_lower = (d_cand == dist_cur) & (idx.reshape(h, w) < idx_cur)
+        accept = better | tie_lower
+        nnf_new = jnp.where(accept[..., None], cand, nnf_cur)
+        dist_new = jnp.where(accept, d_cand, dist_cur)
+        return nnf_new, dist_new
+
+    def sweep(state, it_key):
+        # Propagation (= fused Ashikhmin coherence candidates): unbiased.
+        for dy, dx in _DELTAS:
+            state = try_candidates(state, _shifted(state[0], dy, dx), 1.0)
+        # Unshifted neighbor matches: in tied (flat) regions the canonical
+        # lowest-index match floods outward through these, mirroring the
+        # uniform assignment the exact oracle produces there.
+        for dy, dx in _DELTAS:
+            cand = jnp.roll(state[0], shift=(dy, dx), axis=(0, 1))
+            state = try_candidates(state, cand, 1.0)
+        # Random search around the current best: kappa-biased.
+        keys = jax.random.split(it_key, len(radii))
+        for r, rk in zip(radii, keys):
+            off = jax.random.randint(rk, (h, w, 2), -r, r + 1)
+            state = try_candidates(state, state[0] + off, coh_factor)
+        return state, None
+
+    (nnf, dist), _ = jax.lax.scan(
+        sweep, (nnf, dist), jax.random.split(key, iters)
+    )
+    return nnf, dist
+
+
+def kappa_factor(kappa: float, level: int) -> float:
+    """Hertzmann §3.2 acceptance factor, level 0 = finest."""
+    return 1.0 + kappa * (2.0 ** (-level))
+
+
+class PatchMatchMatcher(Matcher):
+    """Pure-JAX PatchMatch; seeds from the incoming NNF (upsampled from the
+    coarser level by the driver, or random at the coarsest level)."""
+
+    name = "patchmatch"
+
+    def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig):
+        return patchmatch_sweeps(
+            f_b,
+            f_a,
+            nnf,
+            key,
+            iters=cfg.pm_iters,
+            n_random=cfg.pm_random_candidates,
+            coh_factor=kappa_factor(cfg.kappa, level),
+        )
+
+
+register_matcher("patchmatch", PatchMatchMatcher())

@@ -1,0 +1,24 @@
+"""Device-side ops: color, remapping, pyramids, steerable features,
+feature assembly (SURVEY.md §2 C1-C5)."""
+
+from .color import rgb_to_yiq, yiq_to_rgb, luminance
+from .remap import remap_luminance, luminance_stats
+from .pyramid import gaussian_blur, downsample, upsample, build_pyramid
+from .steerable import steerable_responses
+from .features import extract_patches, assemble_features, feature_weights
+
+__all__ = [
+    "rgb_to_yiq",
+    "yiq_to_rgb",
+    "luminance",
+    "remap_luminance",
+    "luminance_stats",
+    "gaussian_blur",
+    "downsample",
+    "upsample",
+    "build_pyramid",
+    "steerable_responses",
+    "extract_patches",
+    "assemble_features",
+    "feature_weights",
+]

@@ -1,0 +1,51 @@
+"""Color-space ops: RGB <-> YIQ as 3x3 matmuls (SURVEY.md §2 C1).
+
+The reference does color conversion on CPU with NumPy/PIL [RECONSTRUCTED];
+here it is a jitted matmul so it fuses into device-side preprocessing and the
+image never round-trips to host between load and synthesis.
+
+All images are float arrays in [0, 1], shape (H, W, 3) for color or (H, W)
+for single-channel luminance.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# NTSC YIQ transform (the color space Hertzmann §3.4 prescribes for
+# luminance-only matching: Y carries luminance, I/Q carry chroma).  The
+# inverse is the exact matrix inverse, not the truncated textbook
+# constants, so the round trip is lossless to float32 precision.
+# Kept as host numpy at module scope: materializing jnp arrays at import
+# time would initialize the device backend for every importer, including
+# host-only code paths (and blocks when another process holds the TPU).
+_RGB2YIQ = np.array(
+    [
+        [0.299, 0.587, 0.114],
+        [0.595716, -0.274453, -0.321263],
+        [0.211456, -0.522591, 0.311135],
+    ],
+    dtype=np.float64,
+)
+_YIQ2RGB = np.linalg.inv(_RGB2YIQ)
+
+
+def rgb_to_yiq(rgb: jnp.ndarray) -> jnp.ndarray:
+    """(..., 3) RGB in [0,1] -> (..., 3) YIQ."""
+    m = jnp.asarray(_RGB2YIQ, dtype=jnp.float32)
+    return jnp.einsum("...c,dc->...d", rgb, m, precision="highest")
+
+
+def yiq_to_rgb(yiq: jnp.ndarray) -> jnp.ndarray:
+    """(..., 3) YIQ -> (..., 3) RGB (not clipped)."""
+    m = jnp.asarray(_YIQ2RGB, dtype=jnp.float32)
+    return jnp.einsum("...c,dc->...d", yiq, m, precision="highest")
+
+
+def luminance(img: jnp.ndarray) -> jnp.ndarray:
+    """Y channel of an (H, W, 3) RGB image, or the image itself if 2D."""
+    if img.ndim == 2:
+        return img
+    y_row = jnp.asarray(_RGB2YIQ[0], dtype=jnp.float32)
+    return jnp.einsum("...c,c->...", img, y_row, precision="highest")

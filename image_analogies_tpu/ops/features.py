@@ -1,0 +1,124 @@
+"""Feature-vector assembly (SURVEY.md §2 C5; Hertzmann §3.1).
+
+Per pixel q at level l the feature vector concatenates:
+  - a `patch` x `patch` neighborhood of the *source* channels at level l
+    (B-side: B; A-side: A),
+  - the same neighborhood of the *filtered* channels at level l
+    (B-side: current B' estimate; A-side: A'),
+  - a `coarse_patch` x `coarse_patch` neighborhood of both at level l+1,
+    sampled at q//2 (absent at the coarsest level).
+
+Neighborhood extraction is one `jax.lax.conv_general_dilated_patches` call
+per image (an im2col conv — XLA tiles it onto the MXU/VPU, no Python
+per-pixel loop), on edge-padded inputs so border pixels get full windows.
+
+The Gaussian-weighted norm of the paper is baked in by scaling each feature
+channel by sqrt(w): plain L2 on the assembled vectors then equals the
+weighted patch distance, so every matcher (brute matmul, PatchMatch kernel)
+inherits the weighting for free.
+
+Sequential-vs-parallel note (SURVEY.md §7 "hard parts"): the paper's B'
+windows are *causal* (only already-synthesized pixels, scan order).  The TPU
+reformulation synthesizes whole levels iteratively (EM over full windows of
+the previous B' estimate), so windows here are full; parity with the causal
+formulation is asserted via PSNR, not pixel equality [BASELINE.json metric].
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..config import SynthConfig
+
+
+def extract_patches(img: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """(H, W) or (H, W, C) -> (H, W, C*patch*patch) edge-padded windows.
+
+    Channel-major layout: index [c*patch*patch + dy*patch + dx] is channel c
+    at window offset (dy, dx).
+    """
+    if img.ndim == 2:
+        img = img[..., jnp.newaxis]
+    h, w, c = img.shape
+    r = patch // 2
+    x = jnp.pad(img, ((r, r), (r, r), (0, 0)), mode="edge")
+    x = jnp.moveaxis(x, -1, 0)[jnp.newaxis]  # (1, C, H+2r, W+2r)
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (patch, patch), (1, 1), "VALID"
+    )  # (1, C*patch*patch, H, W), channel-major spatial minor
+    return jnp.moveaxis(patches[0], 0, -1)
+
+
+def _gauss_weights(patch: int, sigma_frac: float = 0.4) -> np.ndarray:
+    """Per-offset Gaussian weights for one window, normalized to sum 1."""
+    r = patch // 2
+    sigma = max(patch * sigma_frac, 1e-3)
+    y, x = np.mgrid[-r : r + 1, -r : r + 1].astype(np.float32)
+    w = np.exp(-(x**2 + y**2) / (2 * sigma**2))
+    return (w / w.sum()).reshape(-1)
+
+
+def feature_weights(
+    n_src: int,
+    n_flt: int,
+    cfg: SynthConfig,
+    has_coarse: bool,
+    coarse_scale: float = 1.0,
+) -> np.ndarray:
+    """sqrt-weight vector matching the layout of `assemble_features`.
+
+    `n_src`/`n_flt` are the channel counts of the source/filtered images
+    (they differ in steerable mode: the bank augments source images only).
+    Windows are Gaussian-weighted and normalized per window; the
+    coarse-level block is scaled by `coarse_scale` relative to the fine
+    block.  Returned as sqrt so it multiplies features directly.
+    """
+    if cfg.gaussian_weighting:
+        wf = _gauss_weights(cfg.patch_size)
+        wc = _gauss_weights(cfg.coarse_patch_size)
+    else:
+        wf = np.full(cfg.patch_size**2, 1.0 / cfg.patch_size**2, np.float32)
+        wc = np.full(
+            cfg.coarse_patch_size**2, 1.0 / cfg.coarse_patch_size**2, np.float32
+        )
+    blocks = [np.tile(wf, n_src + n_flt)]  # src block then filtered block
+    if has_coarse:
+        blocks.append(np.tile(wc, n_src + n_flt) * coarse_scale)
+    return np.sqrt(np.concatenate(blocks)).astype(np.float32)
+
+
+def assemble_features(
+    src: jnp.ndarray,
+    flt: jnp.ndarray,
+    cfg: SynthConfig,
+    src_coarse: Optional[jnp.ndarray] = None,
+    flt_coarse: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Build the per-pixel feature tensor (H, W, D) for one pyramid level.
+
+    `src`/`flt` are (H, W[, C]) match-channel images at level l; the coarse
+    pair, when given, is the level-(l+1) images ((H+1)//2, (W+1)//2[, C]).
+    The l+1 windows are sampled at q//2 via nearest-neighbor upsampling of
+    the coarse patch tensor — exactly the paper's parent-pixel lookup.
+    """
+    h, w = src.shape[:2]
+    n_src = 1 if src.ndim == 2 else src.shape[-1]
+    n_flt = 1 if flt.ndim == 2 else flt.shape[-1]
+    parts = [
+        extract_patches(src, cfg.patch_size),
+        extract_patches(flt, cfg.patch_size),
+    ]
+    has_coarse = src_coarse is not None
+    if has_coarse:
+        for img in (src_coarse, flt_coarse):
+            p = extract_patches(img, cfg.coarse_patch_size)
+            # q -> q//2 lookup == nearest-neighbor 2x upsample, cropped.
+            p = jnp.repeat(jnp.repeat(p, 2, axis=0), 2, axis=1)[:h, :w]
+            parts.append(p)
+    feats = jnp.concatenate(parts, axis=-1)
+    wvec = jnp.asarray(feature_weights(n_src, n_flt, cfg, has_coarse))
+    return feats * wvec

@@ -1,0 +1,91 @@
+"""Gaussian pyramids (SURVEY.md §2 C3).
+
+The reference builds pyramids with scipy/cv2.pyrDown-style host calls
+[RECONSTRUCTED]; here the whole pyramid is built under `jit` with separable
+convolutions (`jax.lax.conv_general_dilated`) and stays HBM-resident for the
+entire run [BASELINE.json north star].
+
+Conventions:
+  - level 0 is the *finest* level (full resolution); level L-1 the coarsest.
+  - images are (H, W) or (H, W, C) float32.
+  - downsampling is blur + stride-2; upsampling is resize + blur (classic
+    Burt-Adelson pyrUp without the x4 gain since we interpolate, not inject).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# 5-tap binomial approximation to a Gaussian (Burt & Adelson kernel).
+# Host-side constant; converted lazily so importing never touches a device.
+_KERNEL_1D = np.array([1.0, 4.0, 6.0, 4.0, 1.0], dtype=np.float32) / 16.0
+
+
+def _to_nchw(img: jnp.ndarray):
+    """(H,W) or (H,W,C) -> (1, C, H, W), remembering the original rank."""
+    if img.ndim == 2:
+        return img[jnp.newaxis, jnp.newaxis], True
+    return jnp.moveaxis(img, -1, 0)[jnp.newaxis], False
+
+
+def _from_nchw(x: jnp.ndarray, was_2d: bool) -> jnp.ndarray:
+    if was_2d:
+        return x[0, 0]
+    return jnp.moveaxis(x[0], 0, -1)
+
+
+def _sep_conv(x: jnp.ndarray, k1d: jnp.ndarray) -> jnp.ndarray:
+    """Depthwise separable 2D convolution of (1,C,H,W) with SAME edge pad."""
+    c = x.shape[1]
+    r = k1d.shape[0] // 2
+    # Reflect-pad so borders don't darken (edge-consistent with feature
+    # extraction, ops/features.py).
+    x = jnp.pad(x, ((0, 0), (0, 0), (r, r), (r, r)), mode="edge")
+    kv = jnp.tile(k1d.reshape(1, 1, -1, 1), (c, 1, 1, 1))
+    kh = jnp.tile(k1d.reshape(1, 1, 1, -1), (c, 1, 1, 1))
+    dn = jax.lax.conv_dimension_numbers(x.shape, kv.shape, ("NCHW", "OIHW", "NCHW"))
+    x = jax.lax.conv_general_dilated(
+        x, kv, (1, 1), "VALID", dimension_numbers=dn, feature_group_count=c
+    )
+    x = jax.lax.conv_general_dilated(
+        x, kh, (1, 1), "VALID", dimension_numbers=dn, feature_group_count=c
+    )
+    return x
+
+
+def gaussian_blur(img: jnp.ndarray) -> jnp.ndarray:
+    """Binomial 5x5 Gaussian blur, edge-padded, any (H,W[,C]) image."""
+    x, was_2d = _to_nchw(img)
+    return _from_nchw(_sep_conv(x, _KERNEL_1D), was_2d)
+
+
+def downsample(img: jnp.ndarray) -> jnp.ndarray:
+    """Blur + stride-2 subsample (pyrDown)."""
+    blurred = gaussian_blur(img)
+    return blurred[::2, ::2]
+
+
+def upsample(img: jnp.ndarray, target_shape) -> jnp.ndarray:
+    """Bilinear resize to `target_shape` (H, W) — used for B'/s-map
+    initialization when moving a level finer."""
+    if img.ndim == 2:
+        return jax.image.resize(img, target_shape, method="bilinear")
+    return jax.image.resize(
+        img, (*target_shape, img.shape[-1]), method="bilinear"
+    )
+
+
+def build_pyramid(img: jnp.ndarray, levels: int) -> List[jnp.ndarray]:
+    """[level0(finest), ..., level_{L-1}(coarsest)].
+
+    A plain Python loop: `levels` is static (<= ~6) so this unrolls into one
+    XLA graph; every level stays on device.
+    """
+    pyr = [img]
+    for _ in range(levels - 1):
+        pyr.append(downsample(pyr[-1]))
+    return pyr

@@ -1,0 +1,44 @@
+"""Luminance remapping (SURVEY.md §2 C2; Hertzmann §3.4).
+
+Affine-matches the A/A' luminance statistics to B's before any matching:
+
+    Y_A <- (sigma_B / sigma_A) * (Y_A - mu_A) + mu_B
+
+Both A and A' are remapped with *A's* statistics (they must move together so
+the analogy A:A' is preserved).  Pure `jax.numpy` reductions — runs on device
+as part of preprocessing [BASELINE.json north star: "luminance remapping
+moves to jax.scipy"].
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def luminance_stats(y: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Mean and standard deviation of a luminance image."""
+    mu = jnp.mean(y)
+    sigma = jnp.std(y)
+    return mu, sigma
+
+
+def remap_luminance(
+    y_a: jnp.ndarray,
+    y_ap: jnp.ndarray,
+    y_b: jnp.ndarray,
+    eps: float = 1e-6,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Remap (Y_A, Y_A') to B's luminance statistics using A's statistics.
+
+    Returns the remapped (Y_A, Y_A').  `eps` guards flat images
+    (sigma_A ~ 0), where the scale collapses to 0 instead of exploding.
+    """
+    mu_a, sigma_a = luminance_stats(y_a)
+    mu_b, sigma_b = luminance_stats(y_b)
+    scale = sigma_b / jnp.maximum(sigma_a, eps)
+    return (
+        scale * (y_a - mu_a) + mu_b,
+        scale * (y_ap - mu_a) + mu_b,
+    )

@@ -1,0 +1,46 @@
+"""Steerable-filter feature bank (SURVEY.md §2 C4; Hertzmann §3.1).
+
+Oriented first-derivative-of-Gaussian responses appended to the feature
+vectors for config 4 [BASELINE.json]. One batched
+`jax.lax.conv_general_dilated` per level computes all orientations at once —
+the filters are expressed as one OIHW weight tensor so XLA maps the whole
+bank onto a single conv (MXU-friendly) instead of n_orient separate passes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _dog_bank(n_orientations: int, size: int = 5, sigma: float = 1.0) -> np.ndarray:
+    """(n_orient, 1, size, size) bank of oriented derivative-of-Gaussian
+    filters G_theta = cos(theta) Gx + sin(theta) Gy (steerable basis)."""
+    r = size // 2
+    y, x = np.mgrid[-r : r + 1, -r : r + 1].astype(np.float32)
+    g = np.exp(-(x**2 + y**2) / (2 * sigma**2))
+    gx = -x / sigma**2 * g
+    gy = -y / sigma**2 * g
+    # Normalize the basis so responses are O(1) on [0,1] images.
+    norm = np.sqrt((gx**2).sum())
+    gx, gy = gx / norm, gy / norm
+    filters = []
+    for i in range(n_orientations):
+        theta = np.pi * i / n_orientations
+        filters.append(np.cos(theta) * gx + np.sin(theta) * gy)
+    return np.stack(filters)[:, None]  # OIHW with I=1
+
+
+def steerable_responses(
+    y: jnp.ndarray, n_orientations: int = 4, size: int = 5
+) -> jnp.ndarray:
+    """(H, W) luminance -> (H, W, n_orientations) oriented responses."""
+    bank = jnp.asarray(_dog_bank(n_orientations, size=size))
+    r = size // 2
+    x = jnp.pad(y, ((r, r), (r, r)), mode="edge")[jnp.newaxis, jnp.newaxis]
+    dn = jax.lax.conv_dimension_numbers(x.shape, bank.shape, ("NCHW", "OIHW", "NCHW"))
+    out = jax.lax.conv_general_dilated(
+        x, bank, (1, 1), "VALID", dimension_numbers=dn
+    )
+    return jnp.moveaxis(out[0], 0, -1)

@@ -1,0 +1,1 @@
+"""Multi-chip parallelism (SURVEY.md C15): mesh, batch, spatial."""

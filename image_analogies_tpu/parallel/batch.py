@@ -1,0 +1,202 @@
+"""Batched NPR runner — config 5 (SURVEY.md §2 C15, §3.4).
+
+Synthesizes B' for a batch of video frames against one shared (A, A')
+style pair: frames are sharded over the mesh's "batch" axis (ICI moves
+nothing per-frame — synthesis is embarrassingly parallel), the A-side
+feature tables are replicated once.  The per-level EM step is the same
+pure function the single-image driver uses, `vmap`-ed over the frame axis
+and jitted with `NamedSharding` constraints — XLA/pjit partitions it over
+the mesh [north star: data-parallel on v5e-8].
+
+Degrades to a 1-chip mesh on a single device; tested on the 8-virtual-CPU
+mesh (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SynthConfig
+from ..models.analogy import (
+    _finalize,
+    _with_steerable,
+    make_em_step,
+    upsample_nnf,
+)
+from ..models.patchmatch import random_init
+from ..ops.color import rgb_to_yiq
+from ..ops.features import assemble_features
+from ..ops.pyramid import build_pyramid, upsample
+from .mesh import BATCH_AXIS, batch_sharding, make_mesh, replicated
+
+
+@functools.lru_cache(maxsize=64)
+def _batch_step_fn(cfg: SynthConfig, level: int, has_coarse: bool, mesh_key):
+    mesh = _MESHES[mesh_key]
+    step = make_em_step(cfg, level, has_coarse)
+    # Frame-carried args are vmapped; the A-side (f_a, copy_a) is shared.
+    vstep = jax.vmap(step, in_axes=(0, 0, 0, 0, None, None, 0, 0))
+    shard = batch_sharding(mesh)
+    repl = replicated(mesh)
+    return jax.jit(
+        vstep,
+        in_shardings=(shard, shard, shard, shard, repl, repl, shard, shard),
+        out_shardings=(shard, shard, shard),
+    )
+
+
+# jit caches need hashable mesh handles; Mesh objects are hashable but we
+# key the lru_cache on a stable token so reruns reuse compilations.
+_MESHES = {}
+
+
+def _mesh_token(mesh) -> tuple:
+    token = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+    _MESHES[token] = mesh
+    return token
+
+
+def synthesize_batch(
+    a,
+    ap,
+    frames,
+    cfg: Optional[SynthConfig] = None,
+    mesh=None,
+    progress=None,
+):
+    """B' for every frame in `frames` ((F,H,W,3) or (F,H,W)) against the
+    shared style pair (a, ap).  Returns stacked B' shaped like `frames`.
+
+    Frame counts that don't divide the mesh are padded (last frame
+    repeated) and trimmed after synthesis, so every device stays busy.
+    `progress` is an optional `utils.progress.ProgressWriter`.
+    """
+    cfg = cfg or SynthConfig()
+    mesh = mesh or make_mesh()
+    token = _mesh_token(mesh)
+    n_frames = frames.shape[0]
+    n_pad = (-n_frames) % mesh.devices.size
+
+    a = jnp.asarray(a, jnp.float32)
+    ap = jnp.asarray(ap, jnp.float32)
+    frames = jnp.asarray(frames, jnp.float32)
+    if n_pad:
+        frames = jnp.concatenate(
+            [frames, jnp.repeat(frames[-1:], n_pad, axis=0)], axis=0
+        )
+    frames = jax.device_put(frames, batch_sharding(mesh))
+
+    src_a, flt_a, src_b, copy_a, yiq_b = _batched_channels(a, ap, frames, cfg)
+
+    levels = cfg.clamp_levels(a.shape[:2], frames.shape[1:3])
+    pyr_src_a = [_with_steerable(x, cfg) for x in build_pyramid(src_a, levels)]
+    pyr_flt_a = build_pyramid(flt_a, levels)
+    pyr_copy_a = build_pyramid(copy_a, levels)
+
+    vpyr = jax.vmap(lambda x: tuple(build_pyramid(x, levels)))
+    pyr_src_b = [
+        jax.vmap(lambda x: _with_steerable(x, cfg))(lvl)
+        for lvl in vpyr(src_b)
+    ]
+    pyr_raw_b = list(vpyr(src_b))
+
+    key = jax.random.PRNGKey(cfg.seed)
+    bp = flt_bp = flt_bp_coarse = nnf = None
+
+    for level in range(levels - 1, -1, -1):
+        f_a_src = pyr_src_a[level]
+        h, w = pyr_src_b[level].shape[1:3]
+        ha, wa = f_a_src.shape[:2]
+        has_coarse = level < levels - 1
+
+        f_a = assemble_features(
+            f_a_src,
+            pyr_flt_a[level],
+            cfg,
+            pyr_src_a[level + 1] if has_coarse else None,
+            pyr_flt_a[level + 1] if has_coarse else None,
+        )
+
+        level_key = jax.random.fold_in(key, level)
+        if has_coarse:
+            nnf = jax.vmap(lambda n: upsample_nnf(n, (h, w), ha, wa))(nnf)
+            flt_bp_coarse = flt_bp
+            flt_bp = jax.vmap(lambda x: upsample(x, (h, w)))(flt_bp)
+        else:
+            frame_keys = jax.random.split(level_key, frames.shape[0])
+            nnf = jax.vmap(
+                lambda k: random_init(k, h, w, ha, wa)
+            )(frame_keys)
+            flt_bp = pyr_raw_b[level]
+
+        step = _batch_step_fn(cfg, level, has_coarse, token)
+        for em in range(cfg.em_iters):
+            em_keys = jax.random.split(
+                jax.random.fold_in(level_key, em), frames.shape[0]
+            )
+            nnf, dist, bp = step(
+                pyr_src_b[level],
+                flt_bp,
+                pyr_src_b[level + 1] if has_coarse else pyr_src_b[level],
+                flt_bp_coarse if has_coarse else flt_bp,
+                f_a,
+                pyr_copy_a[level],
+                nnf,
+                em_keys,
+            )
+            flt_bp = bp
+
+        if progress is not None:
+            progress.emit(
+                "level_done", level=level, shape=[int(h), int(w)],
+                nnf_energy=float(dist.mean()),
+            )
+        if cfg.save_level_artifacts:
+            _save_batch_level(cfg.save_level_artifacts, level, nnf, dist, bp)
+
+    if yiq_b is not None:
+        out = jax.vmap(
+            lambda bp_f, yiq_f, b_f: _finalize(bp_f, yiq_f, b_f, cfg)
+        )(bp, yiq_b, frames)
+    else:
+        out = jax.vmap(lambda bp_f, b_f: _finalize(bp_f, None, b_f, cfg))(
+            bp, frames
+        )
+    return out[:n_frames]
+
+
+def _save_batch_level(path: str, level: int, nnf, dist, bp) -> None:
+    """Per-level checkpoint artifacts for the whole batch (SURVEY.md §5)."""
+    import os
+
+    import numpy as np
+
+    os.makedirs(path, exist_ok=True)
+    np.savez(
+        os.path.join(path, f"batch_level_{level}.npz"),
+        nnf=np.asarray(nnf),
+        dist=np.asarray(dist),
+        bp=np.asarray(bp),
+    )
+
+
+def _batched_channels(a, ap, frames, cfg: SynthConfig):
+    """Channel split with a leading frame axis on the B side."""
+    if cfg.color_mode == "luminance":
+        color = frames.ndim == 4
+        yiq_b = jax.vmap(rgb_to_yiq)(frames) if color else None
+        y_b = yiq_b[..., 0] if color else frames
+        y_a = rgb_to_yiq(a)[..., 0] if a.ndim == 3 else a
+        y_ap = rgb_to_yiq(ap)[..., 0] if ap.ndim == 3 else ap
+        if cfg.luminance_remap:
+            from ..ops.remap import remap_luminance
+
+            # Remap A to the statistics of the whole frame stack (shared
+            # style must stay fixed across frames for temporal coherence).
+            y_a, y_ap = remap_luminance(y_a, y_ap, y_b)
+        return y_a, y_ap, y_b, y_ap, yiq_b
+    return a, ap, frames, ap, None

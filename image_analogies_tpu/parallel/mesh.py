@@ -1,0 +1,53 @@
+"""Device-mesh helpers (SURVEY.md §2 parallelism table).
+
+All multi-chip behavior is expressed through a `jax.sharding.Mesh` + named
+shardings; XLA inserts the ICI collectives.  The code degrades to a 1-chip
+mesh on this box (v5e-1) and scales to v5e-8 unchanged — and runs on the
+tests' 8 virtual CPU devices the same way [SURVEY.md §4 'multi-node
+without a cluster'].
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+BATCH_AXIS = "batch"
+SPACE_AXIS = "space"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Sequence[str] = (BATCH_AXIS,),
+    shape: Optional[Tuple[int, ...]] = None,
+) -> Mesh:
+    """Mesh over the first `n_devices` devices (default: all).
+
+    `shape` splits the devices over multiple named axes, e.g.
+    shape=(2, 4), axis_names=("batch", "space") on 8 chips.
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:n_devices]
+    if shape is None:
+        shape = (len(devices),)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def batch_sharding(mesh: Mesh, axis: str = BATCH_AXIS) -> NamedSharding:
+    """Leading-axis sharding for per-frame arrays."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully replicated sharding (the shared A / A' side)."""
+    return NamedSharding(mesh, P())

@@ -1,0 +1,15 @@
+"""Host-edge utilities: image I/O, metrics, progress logging, procedural
+example assets (SURVEY.md §2 C1/C14, §5)."""
+
+from .io import load_image, save_image
+from .metrics import psnr, nnf_energy
+from .progress import ProgressWriter, logger
+
+__all__ = [
+    "load_image",
+    "save_image",
+    "psnr",
+    "nnf_energy",
+    "ProgressWriter",
+    "logger",
+]

@@ -1,0 +1,21 @@
+"""Persistent XLA compilation cache (SURVEY.md §7 step 8, host-sync
+minimization).  First compiles on the tunneled TPU platform cost 20-40 s
+per jitted level step; caching them on disk makes every later process
+(bench reruns, CLI invocations) start warm."""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.join(os.path.dirname(__file__), "..", "..", ".jax_cache")
+
+
+def enable_compilation_cache(path: str | None = None) -> None:
+    import jax
+
+    cache_dir = os.path.abspath(path or os.environ.get(
+        "IA_TPU_COMPILE_CACHE", _DEFAULT_DIR
+    ))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,28 @@
+"""Image I/O at the host edge (SURVEY.md §2 C1).
+
+PIL handles codec work on the host; everything after `load_image` is device
+arrays in [0, 1] float32.  This is the only host<->device boundary of the
+pipeline (one transfer in, one out — SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def load_image(path: str, gray: bool = False) -> np.ndarray:
+    """PNG/JPEG -> float32 [0,1], (H,W,3) or (H,W) when `gray`."""
+    from PIL import Image
+
+    img = Image.open(path)
+    img = img.convert("L" if gray else "RGB")
+    return np.asarray(img, dtype=np.float32) / 255.0
+
+
+def save_image(path: str, img) -> None:
+    """float [0,1] array -> 8-bit PNG/JPEG."""
+    from PIL import Image
+
+    arr = np.asarray(img)
+    arr = np.clip(arr * 255.0 + 0.5, 0, 255).astype(np.uint8)
+    Image.fromarray(arr).save(path)

@@ -1,0 +1,22 @@
+"""Quality metrics — PSNR is the framework's acceptance currency
+(north-star: ">= 35 dB PSNR vs CPU ref", BASELINE.json:2)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def psnr(x, y, peak: float = 1.0) -> float:
+    """Peak signal-to-noise ratio in dB between two [0,peak] images."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    mse = float(np.mean((x - y) ** 2))
+    if mse == 0.0:
+        return float("inf")
+    return 10.0 * np.log10(peak * peak / mse)
+
+
+def nnf_energy(dist) -> float:
+    """Mean match distance — the PatchMatch convergence monitor
+    (SURVEY.md §4 'iteration monotonicity')."""
+    return float(np.mean(np.asarray(dist)))

@@ -1,0 +1,27 @@
+"""Observability: stdlib logging + JSON-lines progress events
+(SURVEY.md §5 metrics/logging)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Optional
+
+logger = logging.getLogger("image_analogies_tpu")
+
+
+class ProgressWriter:
+    """Append one JSON object per event to a .jsonl file (or log only)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._t0 = time.perf_counter()
+
+    def emit(self, event: str, **fields) -> None:
+        rec = {"event": event, "t": round(time.perf_counter() - self._t0, 4)}
+        rec.update(fields)
+        logger.info("%s %s", event, fields)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")

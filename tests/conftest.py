@@ -1,0 +1,31 @@
+"""Test harness config (SURVEY.md §4).
+
+Tests run on the CPU backend with 8 virtual devices so the multi-chip
+sharding code paths (config 5 data parallelism, spatial sharding) are
+exercised without real hardware — the JAX-idiomatic fake-backend trick.
+Must set env before the first jax import anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment's axon boot hook (sitecustomize) force-sets
+# jax_platforms="axon,cpu" at interpreter start, overriding JAX_PLATFORMS;
+# override it back before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

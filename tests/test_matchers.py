@@ -1,0 +1,229 @@
+"""Matcher tests (SURVEY.md §4 'Kernel'): brute oracle, PatchMatch
+convergence/monotonicity/determinism, the kappa acceptance rule."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from image_analogies_tpu.config import SynthConfig
+from image_analogies_tpu.models import (
+    coherence_sweeps,
+    exact_nn,
+    get_matcher,
+    patchmatch_sweeps,
+    random_init,
+    upsample_nnf,
+)
+from image_analogies_tpu.models.matcher import nnf_dist
+from image_analogies_tpu.models.patchmatch import kappa_factor
+
+
+def _feature_fields(rng, h, w, ha, wa, d, near_duplicate=False):
+    f_a = rng.standard_normal((ha, wa, d)).astype(np.float32)
+    if near_duplicate:
+        # B features are noisy copies of a random permutation of A's — the
+        # exact NN field is then non-trivial but well-separated.
+        flat = f_a.reshape(-1, d)
+        pick = rng.integers(0, ha * wa, size=h * w)
+        f_b = flat[pick] + 0.01 * rng.standard_normal((h * w, d)).astype(
+            np.float32
+        )
+        return jnp.asarray(f_b.reshape(h, w, d)), jnp.asarray(f_a), pick
+    f_b = rng.standard_normal((h, w, d)).astype(np.float32)
+    return jnp.asarray(f_b), jnp.asarray(f_a), None
+
+
+class TestBrute:
+    def test_matches_numpy_oracle(self, rng):
+        f_b, f_a, _ = _feature_fields(rng, 6, 7, 8, 9, 12)
+        idx, dist = exact_nn(f_b.reshape(-1, 12), f_a.reshape(-1, 12), chunk=16)
+        fb = np.asarray(f_b).reshape(-1, 12)
+        fa = np.asarray(f_a).reshape(-1, 12)
+        d2 = ((fb[:, None] - fa[None]) ** 2).sum(-1)
+        np.testing.assert_array_equal(np.asarray(idx), d2.argmin(1))
+        np.testing.assert_allclose(np.asarray(dist), d2.min(1), rtol=1e-4)
+
+    def test_recovers_planted_matches(self, rng):
+        f_b, f_a, pick = _feature_fields(
+            rng, 8, 8, 10, 10, 16, near_duplicate=True
+        )
+        idx, _ = exact_nn(f_b.reshape(-1, 16), f_a.reshape(-1, 16), chunk=64)
+        assert (np.asarray(idx) == pick).mean() > 0.95
+
+    def test_chunk_padding(self, rng):
+        """N not divisible by chunk must still return all rows correctly."""
+        f_b, f_a, _ = _feature_fields(rng, 5, 5, 6, 6, 8)
+        idx_a, _ = exact_nn(f_b.reshape(-1, 8), f_a.reshape(-1, 8), chunk=7)
+        idx_b, _ = exact_nn(f_b.reshape(-1, 8), f_a.reshape(-1, 8), chunk=25)
+        np.testing.assert_array_equal(np.asarray(idx_a), np.asarray(idx_b))
+
+
+class TestPatchMatch:
+    def test_converges_on_coherent_field(self, rng):
+        """A spatially shifted copy of A is PatchMatch's home turf: the
+        exact NNF is a constant offset that propagation spreads from any
+        lucky seed — the field energy must land at the exact optimum."""
+        f_a = jnp.asarray(rng.standard_normal((16, 16, 8)).astype(np.float32))
+        f_b = jnp.roll(f_a, shift=(3, 5), axis=(0, 1))
+        key = jax.random.PRNGKey(0)
+        nnf0 = random_init(key, 16, 16, 16, 16)
+        nnf, dist = patchmatch_sweeps(
+            f_b, f_a, nnf0, key, iters=24, n_random=8, coh_factor=1.0
+        )
+        _, d_exact = exact_nn(f_b.reshape(-1, 8), f_a.reshape(-1, 8), chunk=256)
+        assert float(dist.mean()) <= 1.05 * float(d_exact.mean())
+
+    def test_converges_within_factor_on_iid(self, rng):
+        """iid features (no coherence to exploit) — worst case: random
+        search alone must still get within ~50% of the exact optimum."""
+        f_b, f_a, _ = _feature_fields(rng, 16, 16, 16, 16, 8)
+        key = jax.random.PRNGKey(0)
+        nnf0 = random_init(key, 16, 16, 16, 16)
+        _, dist = patchmatch_sweeps(
+            f_b, f_a, nnf0, key, iters=24, n_random=8, coh_factor=1.0
+        )
+        _, d_exact = exact_nn(f_b.reshape(-1, 8), f_a.reshape(-1, 8), chunk=256)
+        assert float(dist.mean()) <= 1.5 * float(d_exact.mean())
+
+    def test_energy_monotone_in_iterations(self, rng):
+        f_b, f_a, _ = _feature_fields(rng, 12, 12, 12, 12, 8)
+        key = jax.random.PRNGKey(1)
+        nnf0 = random_init(key, 12, 12, 12, 12)
+        energies = []
+        for iters in [1, 4, 8, 16]:
+            _, dist = patchmatch_sweeps(
+                f_b, f_a, nnf0, key, iters=iters, n_random=6, coh_factor=1.0
+            )
+            energies.append(float(dist.mean()))
+        assert all(b <= a + 1e-6 for a, b in zip(energies, energies[1:]))
+
+    def test_deterministic_with_fixed_key(self, rng):
+        f_b, f_a, _ = _feature_fields(rng, 10, 10, 10, 10, 8)
+        key = jax.random.PRNGKey(7)
+        nnf0 = random_init(key, 10, 10, 10, 10)
+        out1, d1 = patchmatch_sweeps(
+            f_b, f_a, nnf0, key, iters=4, n_random=4, coh_factor=1.0
+        )
+        out2, d2 = patchmatch_sweeps(
+            f_b, f_a, nnf0, key, iters=4, n_random=4, coh_factor=1.0
+        )
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+    def test_dist_consistent_with_nnf(self, rng):
+        f_b, f_a, _ = _feature_fields(rng, 9, 9, 9, 9, 8)
+        key = jax.random.PRNGKey(3)
+        nnf0 = random_init(key, 9, 9, 9, 9)
+        nnf, dist = patchmatch_sweeps(
+            f_b, f_a, nnf0, key, iters=3, n_random=3, coh_factor=1.0
+        )
+        recomputed = nnf_dist(f_b, f_a.reshape(-1, 8), nnf, 9)
+        np.testing.assert_allclose(
+            np.asarray(dist), np.asarray(recomputed), rtol=1e-4, atol=1e-5
+        )
+
+    def test_planted_piecewise_field_recovered(self, rng):
+        """A piecewise-coherent planted NNF (two regions, two shifts) is
+        recovered almost everywhere: random search seeds each region,
+        propagation floods it."""
+        h = w = 16
+        d = 8
+        f_a = rng.standard_normal((h, w, d)).astype(np.float32)
+        yy, xx = np.mgrid[0:h, 0:w]
+        shift = np.where(yy < h // 2, 3, 9)
+        py = (yy + shift) % h
+        px = (xx + 5) % w
+        f_b = f_a[py, px] + 0.01 * rng.standard_normal((h, w, d)).astype(
+            np.float32
+        )
+        key = jax.random.PRNGKey(5)
+        nnf0 = random_init(key, h, w, h, w)
+        nnf, _ = patchmatch_sweeps(
+            jnp.asarray(f_b), jnp.asarray(f_a), nnf0, key,
+            iters=32, n_random=8, coh_factor=1.0,
+        )
+        planted = np.stack([py, px], axis=-1)
+        assert (np.asarray(nnf) == planted).all(axis=-1).mean() > 0.8
+
+
+class TestKappaRule:
+    def test_factor_values(self):
+        # Hertzmann §3.2: strongest coherence bias at the finest level.
+        assert kappa_factor(5.0, 0) == pytest.approx(6.0)
+        assert kappa_factor(5.0, 2) == pytest.approx(1.0 + 5.0 / 4)
+        assert kappa_factor(0.0, 0) == pytest.approx(1.0)
+
+    def test_truth_table(self):
+        """Coherent candidate adopted iff d_coh <= d_app * factor.
+
+        Setup: every pixel's approximate match is A entry (2,2) (the only
+        good approx entry, d_app); most shifted approx matches land on
+        terrible entries, but the upward shift lands in A row 1 — a
+        uniformly mediocre 'coherent region' with d_coh > d_app.  One seed
+        pixel is pre-matched into that region.  Pixels may adopt the
+        coherent-region candidate only when the kappa factor clears the
+        d_coh/d_app gap.
+        """
+        d = 4
+        h = w = 3
+        f_a = np.full((4, 7, d), 3.0, np.float32)
+        f_a[2, 2] = 0.0  # the unique good approx entry
+        f_a[1, :] = 1.0  # the coherent region
+        f_b = np.full((h, w, d), 0.45, np.float32)
+        nnf = np.zeros((h, w, 2), np.int32)
+        nnf[..., 0] = 2
+        nnf[..., 1] = 2          # all pixels -> (2, 2)
+        nnf[1, 1] = [1, 3]       # seed -> coherent region
+        f_b_j = jnp.asarray(f_b)
+        f_a_j = jnp.asarray(f_a)
+        dist = nnf_dist(f_b_j, f_a_j.reshape(-1, d), jnp.asarray(nnf), 7)
+
+        d_app = 0.45**2 * d
+        d_coh = 0.55**2 * d
+        # factor below the gap: the seed stays alone
+        small = (d_coh / d_app) * 0.99
+        nnf_out, _ = coherence_sweeps(
+            f_b_j, f_a_j, jnp.asarray(nnf), dist, factor=small, sweeps=1
+        )
+        assert int((np.asarray(nnf_out)[..., 0] == 1).sum()) == 1
+        # factor above the gap: the seed's neighbors adopt coherent matches
+        big = (d_coh / d_app) * 1.01
+        nnf_out, _ = coherence_sweeps(
+            f_b_j, f_a_j, jnp.asarray(nnf), dist, factor=big, sweeps=1
+        )
+        assert int((np.asarray(nnf_out)[..., 0] == 1).sum()) > 1
+
+
+class TestNNFUpsample:
+    def test_offsets_doubled_with_parity(self):
+        nnf = jnp.asarray(np.array([[[1, 2]]], np.int32))  # 1x1 coarse
+        up = np.asarray(upsample_nnf(nnf, (2, 2), 8, 8))
+        np.testing.assert_array_equal(up[0, 0], [2, 4])
+        np.testing.assert_array_equal(up[0, 1], [2, 5])
+        np.testing.assert_array_equal(up[1, 0], [3, 4])
+        np.testing.assert_array_equal(up[1, 1], [3, 5])
+
+    def test_clamped_to_bounds(self):
+        nnf = jnp.asarray(np.array([[[7, 7]]], np.int32))
+        up = np.asarray(upsample_nnf(nnf, (2, 2), 8, 8))
+        assert up.max() <= 7
+
+
+class TestRegistry:
+    def test_known_matchers(self):
+        assert get_matcher("brute") is not None
+        assert get_matcher("patchmatch") is not None
+        with pytest.raises(KeyError):
+            get_matcher("kd_tree")
+
+    def test_brute_matcher_end_to_end(self, rng):
+        cfg = SynthConfig(matcher="brute", kappa=0.0)
+        f_b, f_a, _ = _feature_fields(rng, 6, 6, 6, 6, 10)
+        m = get_matcher("brute")
+        nnf, dist = m.match(
+            f_b, f_a, jnp.zeros((6, 6, 2), jnp.int32),
+            key=jax.random.PRNGKey(0), level=0, cfg=cfg,
+        )
+        assert nnf.shape == (6, 6, 2)
+        assert float(dist.min()) >= 0.0

@@ -1,0 +1,180 @@
+"""Unit tests for the substrate ops (SURVEY.md §4 'Unit')."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from image_analogies_tpu.config import SynthConfig
+from image_analogies_tpu.ops import (
+    assemble_features,
+    build_pyramid,
+    downsample,
+    extract_patches,
+    feature_weights,
+    gaussian_blur,
+    luminance,
+    luminance_stats,
+    remap_luminance,
+    rgb_to_yiq,
+    steerable_responses,
+    upsample,
+    yiq_to_rgb,
+)
+
+
+class TestColor:
+    def test_yiq_round_trip(self, rng):
+        rgb = rng.random((17, 23, 3)).astype(np.float32)
+        back = yiq_to_rgb(rgb_to_yiq(rgb))
+        np.testing.assert_allclose(back, rgb, atol=2e-3)
+
+    def test_luminance_matches_y_channel(self, rng):
+        rgb = rng.random((8, 8, 3)).astype(np.float32)
+        np.testing.assert_allclose(
+            luminance(rgb), rgb_to_yiq(rgb)[..., 0], atol=1e-6
+        )
+
+    def test_gray_luminance_is_identity(self, rng):
+        g = rng.random((8, 8)).astype(np.float32)
+        np.testing.assert_allclose(luminance(g), g)
+
+    def test_known_values(self):
+        # Pure white -> Y=1, I=Q=0.
+        white = jnp.ones((1, 1, 3))
+        yiq = rgb_to_yiq(white)
+        np.testing.assert_allclose(np.asarray(yiq[0, 0]), [1.0, 0.0, 0.0], atol=1e-5)
+
+
+class TestRemap:
+    def test_hits_target_stats(self, rng):
+        y_a = (rng.random((32, 32)) * 0.3 + 0.1).astype(np.float32)
+        y_ap = (rng.random((32, 32)) * 0.3 + 0.2).astype(np.float32)
+        y_b = (rng.random((32, 32)) * 0.8).astype(np.float32)
+        ra, _ = remap_luminance(y_a, y_ap, y_b)
+        mu_b, sigma_b = luminance_stats(y_b)
+        mu_r, sigma_r = luminance_stats(ra)
+        assert abs(float(mu_r - mu_b)) < 1e-4
+        assert abs(float(sigma_r - sigma_b)) < 1e-4
+
+    def test_ap_moves_with_a(self, rng):
+        """A' must be remapped with A's statistics, preserving A-A' offsets."""
+        y_a = (rng.random((16, 16))).astype(np.float32)
+        y_ap = y_a + 0.1
+        y_b = (rng.random((16, 16)) * 2).astype(np.float32)
+        ra, rap = remap_luminance(y_a, y_ap, y_b)
+        _, sigma_a = luminance_stats(y_a)
+        _, sigma_b = luminance_stats(y_b)
+        expected_offset = 0.1 * float(sigma_b) / float(sigma_a)
+        np.testing.assert_allclose(
+            np.asarray(rap - ra), expected_offset, atol=1e-4
+        )
+
+    def test_flat_image_guard(self):
+        y_a = np.full((8, 8), 0.5, np.float32)
+        ra, _ = remap_luminance(y_a, y_a, np.linspace(0, 1, 64).reshape(8, 8))
+        assert np.all(np.isfinite(np.asarray(ra)))
+
+
+class TestPyramid:
+    def test_blur_preserves_dc(self):
+        const = jnp.full((16, 16), 0.37)
+        np.testing.assert_allclose(np.asarray(gaussian_blur(const)), 0.37, atol=1e-6)
+
+    def test_downsample_shapes(self):
+        x = jnp.zeros((64, 48, 3))
+        assert downsample(x).shape == (32, 24, 3)
+
+    def test_pyramid_levels(self):
+        pyr = build_pyramid(jnp.zeros((64, 64)), 4)
+        assert [p.shape for p in pyr] == [(64, 64), (32, 32), (16, 16), (8, 8)]
+
+    def test_upsample_round_trip_smooth(self):
+        yy, xx = np.mgrid[0:32, 0:32] / 32.0
+        smooth = (yy + xx).astype(np.float32) / 2
+        rec = upsample(downsample(smooth), (32, 32))
+        assert float(np.abs(np.asarray(rec) - smooth).mean()) < 0.02
+
+    def test_blur_reduces_variance(self, rng):
+        x = rng.random((64, 64)).astype(np.float32)
+        assert float(jnp.var(gaussian_blur(x))) < float(np.var(x))
+
+
+class TestSteerable:
+    def test_shapes(self, rng):
+        y = rng.random((32, 32)).astype(np.float32)
+        r = steerable_responses(y, 4)
+        assert r.shape == (32, 32, 4)
+
+    def test_oriented_edge_selectivity(self):
+        # A vertical edge responds to the 0-deg (d/dx) filter, not 90-deg.
+        y = np.zeros((32, 32), np.float32)
+        y[:, 16:] = 1.0
+        r = np.asarray(steerable_responses(y, 4))
+        horiz = np.abs(r[:, :, 0]).max()
+        vert = np.abs(r[:, :, 2]).max()
+        assert horiz > 10 * vert
+
+    def test_constant_image_zero_response(self):
+        r = steerable_responses(jnp.full((16, 16), 0.5), 4)
+        np.testing.assert_allclose(np.asarray(r), 0.0, atol=1e-5)
+
+
+class TestFeatures:
+    def test_patch_layout_oracle(self):
+        """Hand-computed oracle: center pixel's window must equal the
+        neighborhood, channel-major then row-major offsets."""
+        img = np.arange(25, dtype=np.float32).reshape(5, 5)
+        p = np.asarray(extract_patches(img, 3))
+        assert p.shape == (5, 5, 9)
+        # Window at (2,2): rows 1..3 x cols 1..3 of img.
+        np.testing.assert_allclose(p[2, 2], img[1:4, 1:4].reshape(-1))
+
+    def test_edge_padding_replicates(self):
+        img = np.arange(9, dtype=np.float32).reshape(3, 3)
+        p = np.asarray(extract_patches(img, 3))
+        # Corner (0,0): top-left window replicates the corner pixel.
+        np.testing.assert_allclose(
+            p[0, 0], [0, 0, 1, 0, 0, 1, 3, 3, 4]
+        )
+
+    def test_multichannel_layout(self, rng):
+        img = rng.random((6, 7, 2)).astype(np.float32)
+        p = np.asarray(extract_patches(img, 3))
+        assert p.shape == (6, 7, 18)
+        # channel 1 block follows channel 0 block
+        np.testing.assert_allclose(p[3, 3, 9:], img[2:5, 2:5, 1].reshape(-1))
+
+    def test_assemble_dims(self, rng):
+        cfg = SynthConfig(levels=2)
+        src = rng.random((16, 16)).astype(np.float32)
+        flt = rng.random((16, 16)).astype(np.float32)
+        src_c = rng.random((8, 8)).astype(np.float32)
+        flt_c = rng.random((8, 8)).astype(np.float32)
+        f = assemble_features(src, flt, cfg, src_c, flt_c)
+        assert f.shape == (16, 16, 2 * 25 + 2 * 9)
+        f0 = assemble_features(src, flt, cfg)
+        assert f0.shape == (16, 16, 50)
+
+    def test_weights_normalized_per_window(self):
+        cfg = SynthConfig()
+        w = feature_weights(1, 1, cfg, has_coarse=True) ** 2
+        np.testing.assert_allclose(w[:25].sum(), 1.0, atol=1e-5)
+        np.testing.assert_allclose(w[25:50].sum(), 1.0, atol=1e-5)
+        np.testing.assert_allclose(w[50:68].sum(), 2.0, atol=1e-5)
+
+    def test_coarse_lookup_is_parent_pixel(self, rng):
+        """The coarse block of q must be the window at q//2."""
+        cfg = SynthConfig(gaussian_weighting=False)
+        src = rng.random((8, 8)).astype(np.float32)
+        flt = np.zeros((8, 8), np.float32)
+        src_c = rng.random((4, 4)).astype(np.float32)
+        flt_c = np.zeros((4, 4), np.float32)
+        f = np.asarray(assemble_features(src, flt, cfg, src_c, flt_c))
+        pc = np.asarray(extract_patches(src_c, 3))
+        w_coarse = 1.0 / 3
+        for q in [(0, 0), (3, 5), (7, 7)]:
+            np.testing.assert_allclose(
+                f[q[0], q[1], 50:59],
+                pc[q[0] // 2, q[1] // 2] * w_coarse,
+                rtol=1e-5,
+            )

@@ -1,0 +1,131 @@
+"""Integration tests (SURVEY.md §4): the five benchmark configs at reduced
+size on CPU, asserting PSNR of the PatchMatch path against the brute-force
+oracle — the reduced-size mirror of the north-star acceptance metric
+[BASELINE.json:2]."""
+
+import os
+
+import numpy as np
+import jax
+import pytest
+
+from image_analogies_tpu import SynthConfig, create_image_analogy, psnr
+from image_analogies_tpu.utils.examples import (
+    artistic_filter,
+    npr_frames,
+    super_resolution,
+    texture_by_numbers,
+)
+
+
+def _run(a, ap, b, **kw):
+    return np.asarray(create_image_analogy(a, ap, b, SynthConfig(**kw)))
+
+
+class TestEndToEnd:
+    def test_config1_texture_by_numbers_brute(self):
+        """Config 1 at reduced size: brute NN, 3-level pyramid."""
+        a, ap, b = texture_by_numbers(48)
+        bp = _run(a, ap, b, levels=3, matcher="brute", em_iters=2)
+        assert bp.shape == b.shape
+        assert bp.min() >= 0.0 and bp.max() <= 1.0
+        # B' must draw its pixel statistics from A' (textured), not B
+        # (flat labels): mean per-pixel distance to nearest flat label
+        # color should be well above zero somewhere.
+        assert bp.std() > 0.05
+
+    def test_config2_artistic_filter_patchmatch_kappa(self):
+        """Config 2 at reduced size: PatchMatch + kappa coherence."""
+        a, ap, b = artistic_filter(64)
+        bp = _run(
+            a, ap, b, levels=3, matcher="patchmatch", kappa=5.0,
+            em_iters=2, pm_iters=8,
+        )
+        assert bp.shape == b.shape
+        assert np.isfinite(bp).all()
+
+    def test_config3_super_resolution_psnr_vs_oracle(self):
+        """Config 3 at reduced size: the PSNR-vs-CPU-ref acceptance gate."""
+        a, ap, b = super_resolution(64)
+        kw = dict(levels=3, em_iters=3)
+        bp_oracle = _run(a, ap, b, matcher="brute", **kw)
+        bp_pm = _run(a, ap, b, matcher="patchmatch", pm_iters=10, **kw)
+        assert psnr(bp_pm, bp_oracle) >= 33.0
+
+    def test_config4_steerable_luminance_only(self):
+        """Config 4 at reduced size: steerable features, luminance-only."""
+        a, ap, b = artistic_filter(64)
+        bp = _run(
+            a, ap, b, levels=3, matcher="patchmatch", steerable=True,
+            color_mode="luminance", em_iters=2, pm_iters=6,
+        )
+        assert bp.shape == b.shape
+        assert np.isfinite(bp).all()
+
+    def test_luminance_mode_preserves_chroma(self):
+        """Hertzmann §3.4: I/Q channels of B' come from B."""
+        from image_analogies_tpu.ops.color import rgb_to_yiq
+
+        a, ap, b = artistic_filter(48)
+        bp = _run(a, ap, b, levels=2, matcher="brute", em_iters=2)
+        iq_b = np.asarray(rgb_to_yiq(b))[..., 1:]
+        iq_bp = np.asarray(rgb_to_yiq(bp))[..., 1:]
+        # Clipping to [0,1] RGB can perturb chroma slightly; compare where
+        # the output wasn't clipped.
+        unclipped = (bp > 1e-3).all(-1) & (bp < 1 - 1e-3).all(-1)
+        assert unclipped.mean() > 0.2
+        np.testing.assert_allclose(
+            iq_bp[unclipped], iq_b[unclipped], atol=5e-3
+        )
+
+    def test_gray_inputs(self):
+        rng = np.random.default_rng(0)
+        a = rng.random((32, 32)).astype(np.float32)
+        ap = 1.0 - a
+        b = rng.random((32, 32)).astype(np.float32)
+        bp = _run(a, ap, b, levels=2, matcher="brute", em_iters=2)
+        assert bp.shape == (32, 32)
+
+    def test_rgb_color_mode(self):
+        a, ap, b = texture_by_numbers(32)
+        bp = _run(
+            a, ap, b, levels=2, matcher="brute", color_mode="rgb",
+            em_iters=2, luminance_remap=False,
+        )
+        assert bp.shape == b.shape
+
+    def test_deterministic_given_seed(self):
+        a, ap, b = artistic_filter(32)
+        kw = dict(levels=2, matcher="patchmatch", em_iters=2, pm_iters=4, seed=3)
+        bp1 = _run(a, ap, b, **kw)
+        bp2 = _run(a, ap, b, **kw)
+        np.testing.assert_array_equal(bp1, bp2)
+
+    def test_different_b_size(self):
+        """B may differ in size from A (the usual analogy use-case)."""
+        a, ap, _ = artistic_filter(32)
+        _, _, b = artistic_filter(48, seed=9)
+        bp = _run(a, ap, b, levels=2, matcher="brute", em_iters=2)
+        assert bp.shape == b.shape
+
+    def test_level_artifacts_written(self, tmp_path):
+        a, ap, b = artistic_filter(32)
+        out = str(tmp_path / "artifacts")
+        _run(
+            a, ap, b, levels=2, matcher="brute", em_iters=1,
+            save_level_artifacts=out,
+        )
+        files = sorted(os.listdir(out))
+        assert files == ["level_0.npz", "level_1.npz"]
+        data = np.load(os.path.join(out, "level_0.npz"))
+        assert set(data.files) == {"nnf", "dist", "bp"}
+
+    def test_aux_outputs(self):
+        a, ap, b = artistic_filter(32)
+        r = create_image_analogy(
+            a, ap, b, SynthConfig(levels=2, matcher="brute", em_iters=1),
+            return_aux=True,
+        )
+        assert len(r["nnf"]) == 2
+        assert r["nnf"][0].shape == (32, 32, 2)
+        assert float(r["dist"][0].min()) >= 0.0
