@@ -34,6 +34,13 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--pm-iters", type=int, default=6)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--device", default=None, choices=["cpu", "tpu"])
+    p.add_argument(
+        "--pallas-mode",
+        default="auto",
+        choices=["auto", "off", "interpret"],
+        help="Pallas kernel selection: auto (compiled on TPU, XLA twin "
+        "elsewhere) | off (pure XLA) | interpret (debug)",
+    )
     p.add_argument("--save-level-artifacts", default=None)
     p.add_argument("--progress", default=None, help="JSONL progress path")
 
@@ -53,6 +60,7 @@ def _config_from(args) -> "SynthConfig":
         em_iters=args.em_iters,
         pm_iters=args.pm_iters,
         seed=args.seed,
+        pallas_mode=args.pallas_mode,
         save_level_artifacts=args.save_level_artifacts,
     )
 

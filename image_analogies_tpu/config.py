@@ -52,6 +52,12 @@ class SynthConfig:
     # 'bfloat16' halves HBM traffic for the distance evaluations).
     match_dtype: str = "float32"
 
+    # Pallas kernel selection: 'auto' compiles the kernels when an
+    # accelerator backs the run (XLA twins on CPU), 'off' forces the
+    # pure-XLA paths, 'interpret' runs kernels in interpreter mode
+    # (CPU tests; catches OOB indexing — SURVEY.md §5 sanitizers).
+    pallas_mode: str = "auto"
+
     # Brute-force matcher query chunk (rows of the distance matrix computed
     # per step; bounds peak HBM for the (chunk, N_A) distance tile).
     brute_chunk: int = 4096
@@ -73,6 +79,8 @@ class SynthConfig:
             raise ValueError("levels must be >= 1")
         if self.em_iters < 1 or self.pm_iters < 1:
             raise ValueError("em_iters and pm_iters must be >= 1")
+        if self.pallas_mode not in ("auto", "off", "interpret"):
+            raise ValueError(f"unknown pallas_mode {self.pallas_mode!r}")
 
     def clamp_levels(self, *shapes: Tuple[int, int]) -> int:
         """Number of usable pyramid levels for the given image shapes."""

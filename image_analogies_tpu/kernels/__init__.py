@@ -1,1 +1,55 @@
-"""Pallas TPU kernels (SURVEY.md C9): filled in by kernels modules."""
+"""Pallas TPU kernels (SURVEY.md §2 C7/C9/C10, §3.3).
+
+Kernel selection contract: every kernel here has a pure-XLA twin in
+`models/` with identical semantics (same argmin tie-breaking, same
+metric).  `resolve_pallas(cfg)` decides per call site whether to run the
+Pallas kernel compiled, interpreted (CPU tests — catches OOB indexing,
+SURVEY.md §5 "race detection/sanitizers"), or not at all:
+
+  - cfg.pallas_mode == "auto":      compiled kernels iff a TPU backs the
+                                    computation; XLA twin otherwise (CPU,
+                                    GPU — the kernels use pltpu memory
+                                    spaces and TPU sequential-grid
+                                    accumulation, so only TPU qualifies).
+  - cfg.pallas_mode == "off":       always the XLA twin.
+  - cfg.pallas_mode == "interpret": Pallas in interpreter mode (tests).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# Platform names that run Mosaic-compiled kernels.  "axon" is the
+# tunnelled v5e PJRT platform in this environment (SURVEY.md §7).
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def _computation_platform() -> str:
+    """Platform of the device that will back newly-traced computations.
+
+    Honors a `jax.default_device(...)` override (e.g. bench.py's CPU
+    oracle phase on a TPU host) before falling back to the process-wide
+    default backend.  Evaluated per call — no caching — so platform
+    changes (`jax.config.update("jax_platforms", ...)`) are respected.
+    """
+    import jax
+
+    try:
+        default = jax.config.jax_default_device
+        if default is not None:
+            return default.platform
+        return jax.default_backend()
+    except RuntimeError:
+        return "cpu"
+
+
+def resolve_pallas(cfg) -> Optional[bool]:
+    """None = use XLA twin; False = compiled Pallas; True = interpreted."""
+    mode = cfg.pallas_mode
+    if mode == "off":
+        return None
+    if mode == "interpret":
+        return True
+    if mode == "auto":
+        return False if _computation_platform() in _TPU_PLATFORMS else None
+    raise ValueError(f"unknown pallas_mode {mode!r}")

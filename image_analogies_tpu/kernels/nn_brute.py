@@ -1,0 +1,140 @@
+"""Streaming exact-NN Pallas kernel (SURVEY.md §2 C7, §3.3).
+
+The brute-force matcher's hot loop is `argmin_p ||f_b[q] - f_a[p]||^2`.
+The XLA formulation (models/brute.py) computes it as chunked distance
+tiles that round-trip through HBM.  This kernel is the TPU-native
+streaming version: the grid walks (query-tile, A-tile) pairs, each step
+does one (TQ, D) x (D, TA) contraction on the MXU and folds the tile's
+row-minima into a VMEM accumulator — the (N_B, N_A) distance matrix is
+never materialized anywhere.  TPU grids execute sequentially, so the
+scratch accumulator carries the running (best distance, best index) per
+query across all A tiles [pallas_guide: scratch + grid accumulation].
+
+Distances use the expansion ||a||^2 - 2 b.a (the ||b||^2 term is constant
+per query row and cannot change the argmin).  Tie-breaking is
+lowest-flat-index, matching `jnp.argmin` in the XLA path bit-for-bit so
+the two backends are interchangeable oracles.
+
+Feature rows are zero-padded to lane multiples (128) and A rows to tile
+multiples with +inf guard distances, so arbitrary (N, D) shapes tile
+cleanly onto the 128x128 systolic array.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Tile sizes: TQ query rows x TA database rows per grid step.  (256, 512)
+# keeps the f32 operand tiles (TQ*D + TA*D + TQ*TA) well under VMEM for
+# D <= 512 while saturating the MXU.
+_TQ = 256
+_TA = 512
+
+
+def _nn_kernel(fb_ref, fa_ref, asq_ref, idx_ref, dist_ref, best_d, best_i):
+    """One (query-tile i, A-tile j) grid step."""
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        best_d[:] = jnp.full_like(best_d, jnp.inf)
+        best_i[:] = jnp.zeros_like(best_i)
+
+    # (TQ, D) x (D, TA) on the MXU; f32 accumulation.
+    cross = jax.lax.dot_general(
+        fb_ref[:],
+        fa_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    d = asq_ref[:] - 2.0 * cross  # (TQ, TA); asq broadcasts from (1, TA)
+
+    local_min = jnp.min(d, axis=1, keepdims=True)  # (TQ, 1)
+    local_arg = jnp.argmin(d, axis=1).astype(jnp.int32)[:, None] + j * _TA
+
+    better = local_min < best_d[:]
+    best_i[:] = jnp.where(better, local_arg, best_i[:])
+    best_d[:] = jnp.where(better, local_min, best_d[:])
+
+    @pl.when(j == n_j - 1)
+    def _write():
+        idx_ref[:] = best_i[:]
+        dist_ref[:] = best_d[:]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("match_dtype", "interpret")
+)
+def exact_nn_pallas(
+    f_b_flat: jnp.ndarray,
+    f_a_flat: jnp.ndarray,
+    match_dtype=jnp.float32,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact NN via the streaming kernel; mirrors `brute.exact_nn`.
+
+    Returns (idx (N,), dist (N,)) with `dist` recomputed exactly (direct
+    subtraction in f32) for the winning rows, like the XLA path, so the
+    kappa accept tests downstream see a cancellation-free metric.
+    """
+    n, d_feat = f_b_flat.shape
+    n_a = f_a_flat.shape[0]
+    match_dtype = jnp.dtype(match_dtype)
+
+    # Pad D to lanes, N_B/N_A to tile multiples.
+    d_pad = (-d_feat) % 128
+    q_pad = (-n) % _TQ
+    a_pad = (-n_a) % _TA
+    fb = jnp.pad(f_b_flat, ((0, q_pad), (0, d_pad))).astype(match_dtype)
+    fa = jnp.pad(f_a_flat, ((0, a_pad), (0, d_pad))).astype(match_dtype)
+    # ||a||^2 in f32; +inf on padded rows so they never win the argmin.
+    a_sq = jnp.sum(
+        f_a_flat.astype(jnp.float32) ** 2, axis=-1
+    )
+    a_sq = jnp.pad(a_sq, (0, a_pad), constant_values=jnp.inf)[None, :]
+
+    grid = (fb.shape[0] // _TQ, fa.shape[0] // _TA)
+    idx, dist = pl.pallas_call(
+        _nn_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (_TQ, fb.shape[1]), lambda i, j: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (_TA, fa.shape[1]), lambda i, j: (j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, _TA), lambda i, j: (0, j), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((_TQ, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TQ, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((fb.shape[0], 1), jnp.int32),
+            jax.ShapeDtypeStruct((fb.shape[0], 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_TQ, 1), jnp.float32),
+            pltpu.VMEM((_TQ, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(fb, fa, a_sq)
+
+    idx = idx[:n, 0]
+    # Exact winner distance (direct subtraction, f32), immune to the
+    # ||a||^2 - 2ab expansion's cancellation error.
+    rows = jnp.take(f_a_flat, idx, axis=0)
+    diff = f_b_flat.astype(jnp.float32) - rows.astype(jnp.float32)
+    return idx, jnp.sum(diff * diff, axis=-1)

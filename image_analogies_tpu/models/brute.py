@@ -70,20 +70,32 @@ def exact_nn(
 
 
 class BruteForceMatcher(Matcher):
-    """Exact NN via chunked MXU distance tiles; ignores the incoming NNF."""
+    """Exact NN; streaming Pallas kernel on TPU, chunked XLA twin on CPU."""
 
     name = "brute"
 
     def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig):
+        from ..kernels import resolve_pallas
+        from ..kernels.nn_brute import exact_nn_pallas
+
         h, w, d = f_b.shape
         ha, wa = f_a.shape[:2]
         match_dtype = jnp.dtype(cfg.match_dtype)
-        idx, dist = exact_nn(
-            f_b.reshape(-1, d),
-            f_a.reshape(-1, d),
-            chunk=min(cfg.brute_chunk, h * w),
-            match_dtype=match_dtype,
-        )
+        interpret = resolve_pallas(cfg)
+        if interpret is None:
+            idx, dist = exact_nn(
+                f_b.reshape(-1, d),
+                f_a.reshape(-1, d),
+                chunk=min(cfg.brute_chunk, h * w),
+                match_dtype=match_dtype,
+            )
+        else:
+            idx, dist = exact_nn_pallas(
+                f_b.reshape(-1, d),
+                f_a.reshape(-1, d),
+                match_dtype=match_dtype,
+                interpret=interpret,
+            )
         return flat_to_nnf(idx, wa, (h, w)), dist.reshape(h, w)
 
 

@@ -1,0 +1,93 @@
+"""Pallas kernel tests (SURVEY.md §4 "Kernel").
+
+Kernels run in interpreter mode on the CPU backend — semantics-exact,
+catches OOB indexing — and are asserted bit-identical to their XLA twins
+(same argmin winners incl. tie-breaking, same distances).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from image_analogies_tpu.config import SynthConfig
+from image_analogies_tpu.kernels import resolve_pallas
+from image_analogies_tpu.kernels.nn_brute import exact_nn_pallas
+from image_analogies_tpu.models.brute import exact_nn
+
+
+@pytest.mark.parametrize(
+    "n_b,n_a,d",
+    [
+        (100, 300, 50),     # nothing aligned
+        (256, 512, 128),    # exactly one tile pair
+        (513, 1025, 68),    # off-by-one over tile boundaries
+    ],
+)
+def test_streaming_nn_matches_xla_twin(rng, n_b, n_a, d):
+    f_b = jnp.asarray(rng.standard_normal((n_b, d)), jnp.float32)
+    f_a = jnp.asarray(rng.standard_normal((n_a, d)), jnp.float32)
+
+    idx_ref, dist_ref = exact_nn(f_b, f_a, chunk=256)
+    idx_k, dist_k = exact_nn_pallas(f_b, f_a, interpret=True)
+
+    np.testing.assert_array_equal(np.asarray(idx_k), np.asarray(idx_ref))
+    np.testing.assert_allclose(
+        np.asarray(dist_k), np.asarray(dist_ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_streaming_nn_tie_breaks_to_lowest_index(rng):
+    # Duplicate A rows across tile boundaries: winner must be the lowest
+    # flat index, matching jnp.argmin in the XLA twin.
+    base = rng.standard_normal((600, 32)).astype(np.float32)
+    base[550] = base[3]  # duplicate in a later tile
+    f_a = jnp.asarray(base)
+    f_b = jnp.asarray(base[[3, 550, 100]])
+
+    idx_k, _ = exact_nn_pallas(f_b, f_a, interpret=True)
+    idx_ref, _ = exact_nn(f_b, f_a, chunk=256)
+    np.testing.assert_array_equal(np.asarray(idx_k), np.asarray(idx_ref))
+    assert int(idx_k[0]) == 3 and int(idx_k[1]) == 3
+
+
+def test_streaming_nn_bf16(rng):
+    # bf16 matching: winners may differ on near-ties; assert the chosen
+    # distances are within bf16 tolerance of the true minima.
+    f_b = jnp.asarray(rng.standard_normal((64, 40)), jnp.float32)
+    f_a = jnp.asarray(rng.standard_normal((200, 40)), jnp.float32)
+    idx_k, dist_k = exact_nn_pallas(
+        f_b, f_a, match_dtype=jnp.bfloat16, interpret=True
+    )
+    _, dist_ref = exact_nn(f_b, f_a, chunk=64)
+    assert np.all(
+        np.asarray(dist_k) <= np.asarray(dist_ref) + 0.15 * (1 + np.asarray(dist_ref))
+    )
+
+
+def test_brute_matcher_uses_kernel_in_interpret_mode(rng):
+    # End-to-end through the Matcher interface with pallas_mode=interpret.
+    from image_analogies_tpu.models.matcher import get_matcher
+
+    f_b = jnp.asarray(rng.random((12, 13, 20)), jnp.float32)
+    f_a = jnp.asarray(rng.random((9, 11, 20)), jnp.float32)
+    nnf0 = jnp.zeros((12, 13, 2), jnp.int32)
+    import jax
+
+    key = jax.random.PRNGKey(0)
+
+    cfg_k = SynthConfig(matcher="brute", pallas_mode="interpret")
+    cfg_x = SynthConfig(matcher="brute", pallas_mode="off")
+    m = get_matcher("brute")
+    nnf_k, dist_k = m.match(f_b, f_a, nnf0, key=key, level=0, cfg=cfg_k)
+    nnf_x, dist_x = m.match(f_b, f_a, nnf0, key=key, level=0, cfg=cfg_x)
+    np.testing.assert_array_equal(np.asarray(nnf_k), np.asarray(nnf_x))
+    np.testing.assert_allclose(
+        np.asarray(dist_k), np.asarray(dist_x), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_resolve_pallas_modes():
+    assert resolve_pallas(SynthConfig(pallas_mode="off")) is None
+    assert resolve_pallas(SynthConfig(pallas_mode="interpret")) is True
+    # On the CPU test backend, auto must fall back to the XLA twin.
+    assert resolve_pallas(SynthConfig(pallas_mode="auto")) is None
