@@ -32,6 +32,11 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--no-luminance-remap", action="store_true")
     p.add_argument("--em-iters", type=int, default=3)
     p.add_argument("--pm-iters", type=int, default=6)
+    p.add_argument(
+        "--pca-dims", type=int, default=None,
+        help="project features to this many principal components before "
+        "matching (Hertzmann-style PCA; default off)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--device", default=None, choices=["cpu", "tpu"])
     p.add_argument(
@@ -59,6 +64,7 @@ def _config_from(args) -> "SynthConfig":
         luminance_remap=not args.no_luminance_remap,
         em_iters=args.em_iters,
         pm_iters=args.pm_iters,
+        pca_dims=args.pca_dims,
         seed=args.seed,
         pallas_mode=args.pallas_mode,
         save_level_artifacts=args.save_level_artifacts,
@@ -89,7 +95,15 @@ def cmd_synth(args) -> int:
     cfg = _config_from(args)
     progress.emit("start", shape=list(b.shape), matcher=cfg.matcher)
     t0 = time.perf_counter()
-    bp = create_image_analogy(a, ap, b, cfg)
+    if args.spatial:
+        from .parallel.mesh import make_mesh
+        from .parallel.spatial import synthesize_spatial
+
+        bp = synthesize_spatial(
+            a, ap, b, cfg, make_mesh(args.n_devices), progress=progress
+        )
+    else:
+        bp = create_image_analogy(a, ap, b, cfg)
     bp.block_until_ready()
     progress.emit("done", wall_s=round(time.perf_counter() - t0, 3))
     save_image(args.out, bp)
@@ -164,6 +178,12 @@ def main(argv=None) -> int:
     p.add_argument("--ap", required=True)
     p.add_argument("--b", required=True)
     p.add_argument("--out", required=True)
+    p.add_argument(
+        "--spatial", action="store_true",
+        help="shard B' row-slabs over the device mesh (halo-exchange "
+        "spatial parallelism) instead of single-device synthesis",
+    )
+    p.add_argument("--n-devices", type=int, default=None)
     _add_synth_flags(p)
     p.set_defaults(fn=cmd_synth)
 

@@ -48,6 +48,12 @@ class SynthConfig:
     # Feature weighting: Gaussian falloff over the neighborhood window.
     gaussian_weighting: bool = True
 
+    # PCA projection of feature vectors before matching (Hertzmann §3.1):
+    # None disables; an int keeps that many principal components, fit per
+    # level on the A-side feature database.  Cuts matcher HBM traffic by
+    # D/pca_dims at the cost of approximate distances.
+    pca_dims: Optional[int] = None
+
     # Matching precision on device ('float32' is the oracle-faithful default;
     # 'bfloat16' halves HBM traffic for the distance evaluations).
     match_dtype: str = "float32"
@@ -81,6 +87,8 @@ class SynthConfig:
             raise ValueError("em_iters and pm_iters must be >= 1")
         if self.pallas_mode not in ("auto", "off", "interpret"):
             raise ValueError(f"unknown pallas_mode {self.pallas_mode!r}")
+        if self.pca_dims is not None and self.pca_dims < 1:
+            raise ValueError("pca_dims must be >= 1 (or None to disable)")
 
     def clamp_levels(self, *shapes: Tuple[int, int]) -> int:
         """Number of usable pyramid levels for the given image shapes."""

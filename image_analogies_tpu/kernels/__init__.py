@@ -37,7 +37,8 @@ def _computation_platform() -> str:
     try:
         default = jax.config.jax_default_device
         if default is not None:
-            return default.platform
+            # jax.default_device accepts a Device or a platform-name str.
+            return default if isinstance(default, str) else default.platform
         return jax.default_backend()
     except RuntimeError:
         return "cpu"

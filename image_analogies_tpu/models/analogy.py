@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from ..config import SynthConfig
 from ..ops.color import luminance, rgb_to_yiq, yiq_to_rgb
 from ..ops.features import assemble_features
+from ..ops.pca import pca_basis, project as pca_project
 from ..ops.pyramid import build_pyramid, upsample
 from ..ops.remap import remap_luminance
 from ..ops.steerable import steerable_responses
@@ -88,11 +89,14 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool):
     """One EM step at one pyramid level: features -> match -> render.
 
     Pure function of its array arguments (vmap-able over a frame axis for
-    the batched runner, SURVEY.md C15).
+    the batched runner, SURVEY.md C15).  With `cfg.pca_dims`, `f_a` is
+    the already-projected database and `proj` the (D, k) basis applied to
+    the B-side features in-step (Hertzmann §3.1 PCA).
     """
     matcher = get_matcher(cfg.matcher)
 
-    def em_step(src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf, key):
+    def em_step(src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf, key,
+                proj=None):
         f_b = assemble_features(
             src_b,
             flt_b,
@@ -100,6 +104,8 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool):
             src_b_c if has_coarse else None,
             flt_b_c if has_coarse else None,
         )
+        if cfg.pca_dims:
+            f_b = pca_project(f_b, proj)
         nnf, dist = matcher.match(
             f_b, f_a, nnf, key=key, level=level, cfg=cfg
         )
@@ -182,6 +188,10 @@ def create_image_analogy(
             pyr_src_a[level + 1] if has_coarse else None,
             pyr_flt_a[level + 1] if has_coarse else None,
         )
+        proj = None
+        if cfg.pca_dims:
+            proj = pca_basis(f_a.reshape(-1, f_a.shape[-1]), cfg.pca_dims)
+            f_a = pca_project(f_a, proj)
 
         level_key = jax.random.fold_in(key, level)
         if has_coarse:
@@ -196,7 +206,7 @@ def create_image_analogy(
 
         step = _em_step_fn(cfg, level, has_coarse)
         for em in range(cfg.em_iters):
-            nnf, dist, bp = step(
+            args = (
                 pyr_src_b[level],
                 flt_bp,
                 pyr_src_b[level + 1] if has_coarse else pyr_src_b[level],
@@ -206,6 +216,9 @@ def create_image_analogy(
                 nnf,
                 jax.random.fold_in(level_key, em),
             )
+            if cfg.pca_dims:
+                args = args + (proj,)
+            nnf, dist, bp = step(*args)
             # The filtered-side match channels of B' are the synthesized
             # copy channels (luminance mode) or their luminance (rgb mode).
             flt_bp = bp

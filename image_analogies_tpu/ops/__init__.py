@@ -6,8 +6,11 @@ from .remap import remap_luminance, luminance_stats
 from .pyramid import gaussian_blur, downsample, upsample, build_pyramid
 from .steerable import steerable_responses
 from .features import extract_patches, assemble_features, feature_weights
+from .pca import pca_basis, project
 
 __all__ = [
+    "pca_basis",
+    "project",
     "rgb_to_yiq",
     "yiq_to_rgb",
     "luminance",

@@ -1,0 +1,52 @@
+"""PCA projection of feature vectors (SURVEY.md §2 C5; Hertzmann §3.1).
+
+The paper projects concatenated neighborhood vectors onto their top
+principal components before matching ("we use PCA to reduce the
+dimensionality of the feature vectors", Hertzmann §3.1) — on CPU that
+cut ANN query cost; on TPU it cuts the matcher's HBM traffic (the
+dominant cost of NN-field evaluation, SURVEY.md §3 hot loop 2) by
+D/pca_dims while the projection itself is one (N, D) x (D, k) MXU
+matmul per EM step.
+
+The basis is fit per level on the A-side features (the search database);
+B-side features are projected with the same basis inside the jitted EM
+step.  Features arrive pre-scaled by the sqrt-Gaussian window weights
+(ops/features.py), so the PCA operates in the weighted metric and
+projected L2 distances approximate the weighted patch distances the
+matchers optimize.
+
+Centering note: matching compares feature *differences*, and for an
+orthonormal basis P, P^T(x - y) is identical whether or not x and y were
+mean-centered first — so the basis is fit on centered data (the
+covariance), but raw features are projected without re-centering.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pca_basis(x_flat: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Top-k principal directions of (N, D) rows; returns (D, k).
+
+    Uses the D x D covariance eigendecomposition — D is a few dozen
+    neighborhood taps, so the eigh is negligible next to the (D, N)x(N, D)
+    covariance matmul (MXU).  Columns are orthonormal, ordered by
+    decreasing eigenvalue.  `k` is clamped to D.
+    """
+    n, d = x_flat.shape
+    k = min(k, d)
+    x = x_flat.astype(jnp.float32)
+    mu = jnp.mean(x, axis=0, keepdims=True)
+    xc = x - mu
+    cov = (xc.T @ xc) / jnp.maximum(n - 1, 1)
+    _, vecs = jnp.linalg.eigh(cov)  # ascending eigenvalues
+    return vecs[:, ::-1][:, :k]
+
+
+def project(f: jnp.ndarray, basis: jnp.ndarray) -> jnp.ndarray:
+    """(..., D) features -> (..., k) in the PCA basis (one MXU matmul)."""
+    return jnp.einsum(
+        "...d,dk->...k", f, basis, preferred_element_type=jnp.float32
+    )

@@ -38,13 +38,19 @@ from .mesh import BATCH_AXIS, batch_sharding, make_mesh, replicated
 def _batch_step_fn(cfg: SynthConfig, level: int, has_coarse: bool, mesh_key):
     mesh = _MESHES[mesh_key]
     step = make_em_step(cfg, level, has_coarse)
-    # Frame-carried args are vmapped; the A-side (f_a, copy_a) is shared.
-    vstep = jax.vmap(step, in_axes=(0, 0, 0, 0, None, None, 0, 0))
+    # Frame-carried args are vmapped; the A-side (f_a, copy_a) and the
+    # PCA basis are shared across frames.
+    in_axes = (0, 0, 0, 0, None, None, 0, 0)
     shard = batch_sharding(mesh)
     repl = replicated(mesh)
+    shardings = (shard, shard, shard, shard, repl, repl, shard, shard)
+    if cfg.pca_dims:
+        in_axes = in_axes + (None,)
+        shardings = shardings + (repl,)
+    vstep = jax.vmap(step, in_axes=in_axes)
     return jax.jit(
         vstep,
-        in_shardings=(shard, shard, shard, shard, repl, repl, shard, shard),
+        in_shardings=shardings,
         out_shardings=(shard, shard, shard),
     )
 
@@ -120,6 +126,12 @@ def synthesize_batch(
             pyr_src_a[level + 1] if has_coarse else None,
             pyr_flt_a[level + 1] if has_coarse else None,
         )
+        proj = None
+        if cfg.pca_dims:
+            from ..ops.pca import pca_basis, project as pca_project
+
+            proj = pca_basis(f_a.reshape(-1, f_a.shape[-1]), cfg.pca_dims)
+            f_a = pca_project(f_a, proj)
 
         level_key = jax.random.fold_in(key, level)
         if has_coarse:
@@ -138,7 +150,7 @@ def synthesize_batch(
             em_keys = jax.random.split(
                 jax.random.fold_in(level_key, em), frames.shape[0]
             )
-            nnf, dist, bp = step(
+            args = (
                 pyr_src_b[level],
                 flt_bp,
                 pyr_src_b[level + 1] if has_coarse else pyr_src_b[level],
@@ -148,6 +160,9 @@ def synthesize_batch(
                 nnf,
                 em_keys,
             )
+            if cfg.pca_dims:
+                args = args + (proj,)
+            nnf, dist, bp = step(*args)
             flt_bp = bp
 
         if progress is not None:

@@ -1,0 +1,203 @@
+"""Spatial parallelism: one large B' sharded across the mesh
+(SURVEY.md §2 "Spatial (tensor) parallelism" row).
+
+The reference is single-process and cannot scale past one image's
+synthesis cost; this runner splits B' into row slabs — one per device —
+and runs the per-level EM step vmapped over the slab axis, which pjit
+shards over the mesh like the batch runner shards frames (parallel/
+batch.py).  The analogy-specific twist is *halos*: feature windows
+(5x5 at l, 3x3 at l+1, Hertzmann §3.1) read a few rows past each slab
+boundary, so every slab carries `_HALO` extra rows on each side, and
+after every EM iteration the slab cores are re-stitched into the global
+B' estimate and re-split with fresh halos.  Under `jit` + shardings that
+stitch/split pair lowers to exactly the boundary-row exchanges between
+ICI neighbors — the "halo exchange" is expressed as global-array
+semantics and the compiler inserts the collectives (the XLA-idiomatic
+formulation; no hand-written send/recv).
+
+Exactness: with halo >= the feature-window reach, per-pixel matchers see
+bit-identical features in slab cores, so the brute matcher's spatial
+output equals the single-device output exactly (tested).  PatchMatch
+propagation is slab-local between stitches (sweep chains don't cross a
+boundary within one EM iteration), which the PSNR-based acceptance
+absorbs [BASELINE.json metric].
+
+A-side features are replicated: matches may land anywhere in A, and A'
+style images are small next to B' at the scales this runner targets.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SynthConfig
+from ..models.analogy import (
+    _finalize,
+    _resolve_channels,
+    _save_level,
+    _with_steerable,
+    upsample_nnf,
+)
+from ..models.patchmatch import random_init
+from ..ops.features import assemble_features
+from ..ops.pyramid import build_pyramid, upsample
+from .batch import _batch_step_fn as _spatial_step_fn, _mesh_token
+from .mesh import batch_sharding, make_mesh
+
+# Rows of context on each side of a slab.  Feature reach per EM step:
+# fine window r=2, plus the l+1 coarse window (r=1 coarse row = 2 fine
+# rows, parity-aligned because slab cores are even-sized).  4 covers
+# both; kept even so coarse slabs split at exactly half resolution.
+_HALO = 4
+
+
+def _split_slabs(x: jnp.ndarray, n_slabs: int, halo: int) -> jnp.ndarray:
+    """(H, ...) -> (n_slabs, H//n_slabs + 2*halo, ...) edge-clamped."""
+    h = x.shape[0]
+    hs = h // n_slabs
+    pad = [(halo, halo)] + [(0, 0)] * (x.ndim - 1)
+    xp = jnp.pad(x, pad, mode="edge")
+    return jnp.stack([xp[i * hs : i * hs + hs + 2 * halo] for i in range(n_slabs)])
+
+
+def _merge_cores(slabs: jnp.ndarray, halo: int) -> jnp.ndarray:
+    """Inverse of `_split_slabs`: drop halos, concatenate cores."""
+    core = slabs[:, halo : slabs.shape[1] - halo]
+    return core.reshape(-1, *core.shape[2:])
+
+
+def synthesize_spatial(
+    a,
+    ap,
+    b,
+    cfg: Optional[SynthConfig] = None,
+    mesh=None,
+    progress=None,
+):
+    """B' for one (large) `b`, rows sharded over the mesh's batch axis.
+
+    `b`'s height is padded (edge rows) to n_devices * 2^(levels-1)
+    granularity so every level splits into equal, parity-aligned slabs;
+    the pad is cropped from the result.
+    """
+    cfg = cfg or SynthConfig()
+    mesh = mesh or make_mesh()
+    token = _mesh_token(mesh)
+    n_slabs = int(mesh.devices.size)
+
+    a = jnp.asarray(a, jnp.float32)
+    ap = jnp.asarray(ap, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    h0 = b.shape[0]
+
+    levels = cfg.clamp_levels(a.shape[:2], b.shape[:2])
+    # Pad rows so each pyramid level splits evenly into even-sized cores.
+    grain = n_slabs * (2 ** (levels - 1)) * 2
+    pad_h = (-h0) % grain
+    if pad_h:
+        b = jnp.pad(
+            b, [(0, pad_h)] + [(0, 0)] * (b.ndim - 1), mode="edge"
+        )
+
+    src_a, flt_a, src_b, copy_a, yiq_b = _resolve_channels(a, ap, b, cfg)
+
+    pyr_src_a = [_with_steerable(x, cfg) for x in build_pyramid(src_a, levels)]
+    pyr_flt_a = build_pyramid(flt_a, levels)
+    pyr_copy_a = build_pyramid(copy_a, levels)
+    pyr_src_b = [_with_steerable(x, cfg) for x in build_pyramid(src_b, levels)]
+    pyr_raw_b = build_pyramid(src_b, levels)
+
+    key = jax.random.PRNGKey(cfg.seed)
+    bp = flt_bp = nnf = None  # global (H_l, W[, C]) state per level
+
+    for level in range(levels - 1, -1, -1):
+        f_a_src = pyr_src_a[level]
+        h, w = pyr_src_b[level].shape[:2]
+        ha, wa = f_a_src.shape[:2]
+        has_coarse = level < levels - 1
+
+        f_a = assemble_features(
+            f_a_src,
+            pyr_flt_a[level],
+            cfg,
+            pyr_src_a[level + 1] if has_coarse else None,
+            pyr_flt_a[level + 1] if has_coarse else None,
+        )
+        proj = None
+        if cfg.pca_dims:
+            from ..ops.pca import pca_basis, project as pca_project
+
+            proj = pca_basis(f_a.reshape(-1, f_a.shape[-1]), cfg.pca_dims)
+            f_a = pca_project(f_a, proj)
+
+        level_key = jax.random.fold_in(key, level)
+        if has_coarse:
+            nnf = upsample_nnf(nnf, (h, w), ha, wa)
+            flt_bp_coarse_g = flt_bp
+            flt_bp = upsample(flt_bp, (h, w))
+        else:
+            nnf = random_init(level_key, h, w, ha, wa)
+            flt_bp = pyr_raw_b[level]
+            flt_bp_coarse_g = None
+
+        # Level-invariant slab views of the match-side images (the
+        # coarse B' estimate is frozen for the whole level, so its slab
+        # split is hoisted with them).
+        slab_src_b = _split_slabs(pyr_src_b[level], n_slabs, _HALO)
+        slab_src_b_c = _split_slabs(
+            pyr_src_b[level + 1] if has_coarse else pyr_src_b[level],
+            n_slabs,
+            _HALO // 2 if has_coarse else _HALO,
+        )
+        slab_flt_c = (
+            _split_slabs(flt_bp_coarse_g, n_slabs, _HALO // 2)
+            if has_coarse
+            else None
+        )
+
+        step = _spatial_step_fn(cfg, level, has_coarse, token)
+        shard = batch_sharding(mesh)
+        for em in range(cfg.em_iters):
+            em_key = jax.random.fold_in(level_key, em)
+            slab_keys = jax.random.split(em_key, n_slabs)
+            slab_flt = _split_slabs(flt_bp, n_slabs, _HALO)
+            args = (
+                slab_src_b,
+                slab_flt,
+                slab_src_b_c,
+                slab_flt_c if has_coarse else slab_flt,
+                f_a,
+                pyr_copy_a[level],
+                _split_slabs(nnf, n_slabs, _HALO),
+                slab_keys,
+            )
+            # Slab-axis args onto the mesh (the split above computes on
+            # the replicated global array; this placement is the halo
+            # scatter, its merge below the gather).
+            args = tuple(
+                jax.device_put(x, shard) if i not in (4, 5) else x
+                for i, x in enumerate(args)
+            )
+            if cfg.pca_dims:
+                args = args + (proj,)
+            nnf_s, dist_s, bp_s = step(*args)
+            # Re-stitch cores -> fresh halos next iteration (the
+            # compiler-lowered halo exchange).
+            nnf = _merge_cores(nnf_s, _HALO)
+            dist = _merge_cores(dist_s, _HALO)
+            bp = _merge_cores(bp_s, _HALO)
+            flt_bp = bp
+
+        if progress is not None:
+            progress.emit(
+                "level_done", level=level, shape=[int(h), int(w)],
+                nnf_energy=float(dist.mean()), spatial_slabs=n_slabs,
+            )
+        if cfg.save_level_artifacts:
+            _save_level(cfg.save_level_artifacts, level, nnf, dist, bp)
+
+    out = _finalize(bp, yiq_b, b, cfg)
+    return out[:h0]

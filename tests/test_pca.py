@@ -1,0 +1,71 @@
+"""PCA feature projection tests (SURVEY.md §4 unit; Hertzmann §3.1)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from image_analogies_tpu.config import SynthConfig
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.ops.pca import pca_basis, project
+from image_analogies_tpu.utils.examples import texture_by_numbers
+from image_analogies_tpu.utils.metrics import psnr
+
+
+def test_basis_orthonormal(rng):
+    x = jnp.asarray(rng.standard_normal((500, 30)), jnp.float32)
+    p = pca_basis(x, 8)
+    assert p.shape == (30, 8)
+    np.testing.assert_allclose(
+        np.asarray(p.T @ p), np.eye(8), atol=1e-4
+    )
+
+
+def test_low_rank_data_preserves_nn_exactly(rng):
+    # Rows living in a k-dim subspace: projecting to k dims must keep all
+    # pairwise distances, hence the exact NN of every query.
+    k, d = 6, 40
+    basis = rng.standard_normal((k, d)).astype(np.float32)
+    f_a = jnp.asarray(rng.standard_normal((300, k)).astype(np.float32) @ basis)
+    f_b = jnp.asarray(rng.standard_normal((50, k)).astype(np.float32) @ basis)
+    p = pca_basis(f_a, k)
+    from image_analogies_tpu.models.brute import exact_nn
+
+    idx_full, _ = exact_nn(f_b, f_a, chunk=64)
+    idx_proj, _ = exact_nn(project(f_b, p), project(f_a, p), chunk=64)
+    np.testing.assert_array_equal(np.asarray(idx_full), np.asarray(idx_proj))
+
+
+def test_variance_ordering(rng):
+    # Components come back in decreasing explained-variance order.
+    n = 2000
+    scales = np.array([10.0, 5.0, 1.0, 0.1], np.float32)
+    x = jnp.asarray(rng.standard_normal((n, 4)).astype(np.float32) * scales)
+    p = np.asarray(pca_basis(x, 4))
+    xc = np.asarray(x) - np.asarray(x).mean(0)
+    var = ((xc @ p) ** 2).mean(0)
+    assert np.all(np.diff(var) <= 1e-3)
+
+
+def test_synthesis_with_pca_close_to_full(rng):
+    a, ap, b = texture_by_numbers(48)
+    base = dict(levels=2, matcher="patchmatch", em_iters=2, pm_iters=4, seed=1)
+    full = np.asarray(create_image_analogy(a, ap, b, SynthConfig(**base)))
+    pca = np.asarray(
+        create_image_analogy(a, ap, b, SynthConfig(pca_dims=16, **base))
+    )
+    # PCA matching is approximate but must stay visually equivalent.
+    assert psnr(pca, full) > 20.0
+    assert pca.std() > 0.05  # still textured, not collapsed
+
+
+def test_batch_runner_with_pca(rng):
+    from image_analogies_tpu.parallel.batch import synthesize_batch
+    from image_analogies_tpu.parallel.mesh import make_mesh
+    from image_analogies_tpu.utils.examples import npr_frames
+
+    a, ap, frames = npr_frames(n_frames=2, size=32)
+    cfg = SynthConfig(
+        levels=2, matcher="patchmatch", em_iters=1, pm_iters=2, pca_dims=8
+    )
+    out = synthesize_batch(a, ap, frames, cfg, make_mesh(2))
+    assert out.shape == frames.shape
+    assert np.asarray(out).std() > 0.01

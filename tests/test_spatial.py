@@ -1,0 +1,71 @@
+"""Spatial-parallel runner tests (SURVEY.md §2 spatial parallelism row).
+
+Runs on the 8-virtual-CPU-device mesh (conftest).  The brute matcher is
+per-pixel deterministic, so with halos >= the feature-window reach the
+sharded run must be BIT-IDENTICAL to the single-device run — the
+strongest possible check that the halo geometry is right.
+"""
+
+import numpy as np
+import jax
+
+from image_analogies_tpu.config import SynthConfig
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.parallel.mesh import make_mesh
+from image_analogies_tpu.parallel.spatial import (
+    _merge_cores,
+    _split_slabs,
+    synthesize_spatial,
+)
+from image_analogies_tpu.utils.examples import texture_by_numbers
+from image_analogies_tpu.utils.metrics import psnr
+
+
+def test_split_merge_roundtrip(rng):
+    import jax.numpy as jnp
+
+    x = jnp.asarray(rng.random((64, 9, 3)), jnp.float32)
+    slabs = _split_slabs(x, 4, 4)
+    assert slabs.shape == (4, 64 // 4 + 8, 9, 3)
+    np.testing.assert_array_equal(np.asarray(_merge_cores(slabs, 4)), np.asarray(x))
+    # Halos replicate neighbours' rows (interior) / edges (boundary).
+    np.testing.assert_array_equal(
+        np.asarray(slabs[1, :4]), np.asarray(x[16 - 4 : 16])
+    )
+    np.testing.assert_array_equal(np.asarray(slabs[0, 0]), np.asarray(x[0]))
+
+
+def test_spatial_brute_bit_identical_to_single_device(rng):
+    a, ap, b = texture_by_numbers(64)
+    cfg = SynthConfig(levels=2, matcher="brute", em_iters=2, pallas_mode="off")
+    single = np.asarray(create_image_analogy(a, ap, b, cfg))
+    sharded = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(4)))
+    np.testing.assert_array_equal(sharded, single)
+
+
+def test_spatial_patchmatch_quality(rng):
+    a, ap, b = texture_by_numbers(64)
+    cfg = SynthConfig(levels=2, matcher="patchmatch", em_iters=2, pm_iters=4)
+    oracle = np.asarray(
+        create_image_analogy(
+            a, ap, b, SynthConfig(levels=2, matcher="brute", em_iters=2)
+        )
+    )
+    sharded = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(4)))
+    assert sharded.std() > 0.05
+    assert psnr(sharded, oracle) > 20.0
+
+
+def test_spatial_pads_odd_heights(rng):
+    a, ap, b = texture_by_numbers(64)
+    b = b[:50]  # height not divisible by slabs * 2^(levels-1)
+    cfg = SynthConfig(levels=2, matcher="brute", em_iters=1)
+    out = synthesize_spatial(a, ap, b, cfg, make_mesh(4))
+    assert out.shape == b.shape
+
+
+def test_spatial_single_device_mesh(rng):
+    a, ap, b = texture_by_numbers(32)
+    cfg = SynthConfig(levels=1, matcher="brute", em_iters=1)
+    out = synthesize_spatial(a, ap, b, cfg, make_mesh(1))
+    assert out.shape == b.shape
