@@ -1,0 +1,553 @@
+"""Pallas PatchMatch propagate + random-search kernel (SURVEY.md §2 C9+C10,
+§3.3 — the centerpiece kernel the north star prescribes).
+
+TPU reformulation
+-----------------
+GPU/CPU PatchMatch evaluates per-pixel candidate matches with random
+gathers.  Mosaic's gather support is a single vreg along the gather
+dimension (verified on this toolchain: `tpu.dynamic_gather` rejects larger
+tables with "Multiple source vregs along gather dimension"), so per-pixel
+gathers cannot be the TPU kernel's inner loop.  This kernel restructures
+the algorithm around what the hardware is good at (SURVEY.md §7 "TPU hates
+divergence"):
+
+  - **Tile-shared candidates.**  Each 64x124 B'-tile evaluates K candidate
+    *offsets* shared by every pixel in the tile.  Candidate evaluation for
+    one offset is then a *dense* windowed-SSD between the B-tile and one
+    contiguous slice of A — vector ops, no divergence, no gather.  The
+    per-pixel NN-field still emerges: every pixel argmins over the K
+    candidates independently, and candidates are resampled from the
+    per-pixel state each sweep.
+  - **Raw planes, not feature vectors.**  Distances are computed from the
+    raw (source, filtered, upsampled-coarse) image planes with the
+    separable Gaussian window applied in-kernel, so the VMEM-resident
+    A-side is C planes of (Ha, Wa) f32 instead of a (Ha*Wa, D) feature
+    table (200 MB at 1024^2).  Planes are f32, not bf16: Mosaic on this
+    toolchain cannot dynamically slice bf16 arrays on sublane dims at all
+    (vector.load internal error even 8-aligned — verified).  To stay
+    inside VMEM the channel set adapts per level (`plan_channels`): all
+    channels when they fit (every level <= 512^2 of the north-star
+    config), fine channels only at the finest 1024^2 level — where the
+    exact-metric merge + polish still applies the full feature metric.
+  - **Lane alignment via dynamic rotate.**  Mosaic cannot dynamically
+    slice the lane (minor) dimension at unaligned offsets.  A-planes are
+    stored as (C, Hp, Wq, 128); a candidate column range [sx, sx+128) is
+    materialized by slicing two adjacent 128-lane blocks and combining
+    them with `pltpu.roll` (tpu.dynamic_rotate) + an iota select.  The
+    5x5 window sum is separable (Gaussian/uniform), applied as static
+    lane/sublane rolls — no lane slicing anywhere.
+  - **Candidate generation stays in XLA.**  Sampling offsets from the
+    NN-field state (own-tile samples = Ashikhmin coherence candidates,
+    neighbor-tile samples = PatchMatch propagation, shrinking-radius
+    perturbations = Barnes random search) is integer work on tiny
+    (n_tiles, K) tensors — XLA does it between kernel sweeps, which also
+    keeps PRNG in ordinary `jax.random` (deterministic under fixed keys).
+
+The kernel is the bulk global-search engine; `models/patchmatch.py` merges
+its result with the incoming field under the exact feature metric and runs
+one per-pixel XLA polish sweep, so the matcher's output contract (exact
+f32 distances, canonical tie-breaking) is identical to the pure-XLA twin.
+
+Approximation note: coarse-level context is evaluated on 2x
+repeat-upsampled coarse planes with a dilation-2 window at q rather than
+the exact parent lookup at q//2 — an off-by-parity approximation of the
+paper's metric, corrected by the exact-metric merge + polish.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..config import SynthConfig
+
+LANE = 128
+# Tile geometry: the padded tile is exactly one lane block wide so the
+# separable window never needs lane slicing.  P is the union halo of the
+# fine window (patch//2) and the dilated coarse window (2*(coarse//2)).
+TILE_H = 64
+
+# Candidate budget per tile per sweep (static; SMEM-resident per tile).
+K_OWN = 16     # samples of the tile's own per-pixel offsets (coherence)
+K_PROP = 16    # samples from the 4 neighbor tiles (propagation)
+K_LOCAL = 12   # shrinking-radius perturbations (random search)
+K_GLOBAL = 4   # uniform over A (random restart)
+K_TOTAL = K_OWN + K_PROP + K_LOCAL + K_GLOBAL
+K_COHERENT = K_OWN + K_PROP  # accepted at factor 1; rest at the kappa factor
+
+
+class ChannelSpec(NamedTuple):
+    """Static per-channel window description (hashable)."""
+
+    dilation: int
+    wy: Tuple[float, ...]
+    wx: Tuple[float, ...]
+
+
+class TileGeometry(NamedTuple):
+    halo: int
+    tile_h: int
+    tile_w: int
+    n_ty: int
+    n_tx: int
+
+    @property
+    def thp(self) -> int:
+        """Blocked tile rows: tile + halos, padded up to the 8-sublane
+        granularity compiled Pallas requires.  The pad rows ([tile_h +
+        2*halo, thp)) hold junk; window rolls with |dy| <= halo never pull
+        them into interior rows, and from_blocked drops them."""
+        return -(-(self.tile_h + 2 * self.halo) // 8) * 8
+
+
+def _gauss1d(n: int, sigma_frac: float = 0.4) -> np.ndarray:
+    """1-D factor of ops.features._gauss_weights (exactly separable)."""
+    r = n // 2
+    sigma = max(n * sigma_frac, 1e-3)
+    x = np.arange(-r, r + 1, dtype=np.float32)
+    g = np.exp(-(x**2) / (2 * sigma**2))
+    return g / g.sum()
+
+
+def channel_specs(
+    n_src: int, n_flt: int, cfg: SynthConfig, has_coarse: bool,
+    coarse_scale: float = 1.0,
+) -> Tuple[ChannelSpec, ...]:
+    """Window spec per plane, matching ops.features.feature_weights: fine
+    src+flt channels get the patch_size window (weight mass 1 each), the
+    upsampled-coarse channels get the dilated coarse window scaled by
+    `coarse_scale`."""
+    if cfg.gaussian_weighting:
+        wf = _gauss1d(cfg.patch_size)
+        wc = _gauss1d(cfg.coarse_patch_size)
+    else:
+        wf = np.full(cfg.patch_size, 1.0 / cfg.patch_size, np.float32)
+        wc = np.full(
+            cfg.coarse_patch_size, 1.0 / cfg.coarse_patch_size, np.float32
+        )
+    fine = ChannelSpec(1, tuple(wf.tolist()), tuple(wf.tolist()))
+    specs = [fine] * (n_src + n_flt)
+    if has_coarse:
+        # sqrt(coarse_scale) on each 1-D factor => coarse_scale on the mass.
+        s = math.sqrt(coarse_scale)
+        wcy = tuple((wc * s).tolist())
+        coarse = ChannelSpec(2, wcy, wcy)
+        specs += [coarse] * (n_src + n_flt)
+    return tuple(specs)
+
+
+def halo_for(specs: Sequence[ChannelSpec]) -> int:
+    return max(sp.dilation * (len(sp.wy) // 2) for sp in specs)
+
+
+def tile_geometry(h: int, w: int, specs: Sequence[ChannelSpec]) -> TileGeometry:
+    p = halo_for(specs)
+    tile_w = LANE - 2 * p
+    return TileGeometry(
+        halo=p,
+        tile_h=TILE_H,
+        tile_w=tile_w,
+        n_ty=-(-h // TILE_H),
+        n_tx=-(-w // tile_w),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Plane preparation (XLA side)
+
+
+def _split_channels(img: jnp.ndarray) -> list:
+    if img.ndim == 2:
+        return [img]
+    return [img[..., c] for c in range(img.shape[-1])]
+
+
+def _upsample2x(img: jnp.ndarray, h: int, w: int) -> jnp.ndarray:
+    """Nearest 2x repeat-upsample, cropped — the same parent-pixel lookup
+    ops.features.assemble_features uses for the coarse block."""
+    return jnp.repeat(jnp.repeat(img, 2, axis=0), 2, axis=1)[:h, :w]
+
+
+def channel_images(
+    src: jnp.ndarray,
+    flt: jnp.ndarray,
+    src_coarse: Optional[jnp.ndarray],
+    flt_coarse: Optional[jnp.ndarray],
+) -> list:
+    """Ordered 2-D channel planes: fine src, fine flt, upsampled coarse
+    src, upsampled coarse flt — the layout channel_specs describes."""
+    h, w = src.shape[:2]
+    chans = _split_channels(src) + _split_channels(flt)
+    if src_coarse is not None:
+        for img in (src_coarse, flt_coarse):
+            chans += [
+                _upsample2x(c, h, w) for c in _split_channels(img)
+            ]
+    return chans
+
+
+def prepare_a_planes(
+    src: jnp.ndarray,
+    flt: jnp.ndarray,
+    src_coarse: Optional[jnp.ndarray],
+    flt_coarse: Optional[jnp.ndarray],
+    specs: Sequence[ChannelSpec],
+) -> jnp.ndarray:
+    """A-side planes packed for the kernel: (C, Ha+2P+pad, Wq, 128) f32.
+
+    Edge padding mirrors ops.features.extract_patches (windows at A's
+    border replicate edge pixels).  One guard lane-block on the right
+    keeps the two-block candidate load in bounds for any clamped sx.
+    Pass `src_coarse=None` to build the fine-only channel subset
+    (plan_channels decides per level).
+    """
+    p = halo_for(specs)
+    chans = channel_images(src, flt, src_coarse, flt_coarse)
+    ha, wa = chans[0].shape
+    wq = -(-(wa + 2 * p) // LANE) + 1
+    # Bottom rows beyond ha+2p feed only the blocked-tile pad rows (see
+    # TileGeometry.thp) — content there is never read into interior
+    # output, edge values just keep the slice in bounds.
+    geom = tile_geometry(ha, wa, specs)
+    extra = geom.thp - (geom.tile_h + 2 * p)
+    out = []
+    for c in chans:
+        c = jnp.pad(
+            c, ((p, p + extra), (p, wq * LANE - wa - p)), mode="edge"
+        )
+        out.append(c.reshape(ha + 2 * p + extra, wq, LANE))
+    return jnp.stack(out).astype(jnp.float32)
+
+
+def to_blocked(plane: jnp.ndarray, geom: TileGeometry) -> jnp.ndarray:
+    """Compact (h, w) -> halo-blocked (n_ty*(TH+2P), n_tx*LANE) layout:
+    tile (i, j) occupies rows [i*THP, (i+1)*THP) and owns compact rows
+    [i*TH - P, i*TH + TH + P) (edge-padded), similarly columns."""
+    p, th, tw = geom.halo, geom.tile_h, geom.tile_w
+    thp = geom.thp
+    h, w = plane.shape
+    plane = jnp.pad(
+        plane,
+        (
+            (p, geom.n_ty * th - h + p + (thp - th - 2 * p)),
+            (p, geom.n_tx * tw - w + p),
+        ),
+        mode="edge",
+    )
+    rows = []
+    for i in range(geom.n_ty):
+        cols = []
+        for j in range(geom.n_tx):
+            cols.append(
+                jax.lax.slice(
+                    plane,
+                    (i * th, j * tw),
+                    (i * th + thp, j * tw + LANE),
+                )
+            )
+        rows.append(jnp.concatenate(cols, axis=1))
+    return jnp.concatenate(rows, axis=0)
+
+
+def from_blocked(
+    blocked: jnp.ndarray, geom: TileGeometry, h: int, w: int
+) -> jnp.ndarray:
+    """Inverse of to_blocked: keep each tile's interior, crop to (h, w)."""
+    p, th, tw = geom.halo, geom.tile_h, geom.tile_w
+    thp = geom.thp
+    x = blocked.reshape(geom.n_ty, thp, geom.n_tx, LANE)
+    x = x[:, p : p + th, :, p : p + tw]
+    x = x.transpose(0, 1, 2, 3).reshape(geom.n_ty * th, geom.n_tx * tw)
+    return x[:h, :w]
+
+
+# ---------------------------------------------------------------------------
+# Candidate sampling (XLA side)
+
+
+def sample_candidates(
+    off_y: jnp.ndarray,
+    off_x: jnp.ndarray,
+    key: jax.Array,
+    geom: TileGeometry,
+    ha: int,
+    wa: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-tile candidate offsets (n_ty, n_tx, K_TOTAL) int32.
+
+    Layout (matching the kernel's static kappa split):
+      [0, K_OWN)                 own-tile state samples     (coherent)
+      [K_OWN, K_OWN+K_PROP)      neighbor-tile samples      (propagation)
+      [.., +K_LOCAL)             shrinking-radius perturbations (random)
+      [.., +K_GLOBAL)            uniform restarts           (random)
+    """
+    h, w = off_y.shape
+    th, tw = geom.tile_h, geom.tile_w
+    n_ty, n_tx = geom.n_ty, geom.n_tx
+    k_jit, k_loc, k_gy, k_gx = jax.random.split(key, 4)
+
+    # Own-tile samples: a jittered 4x4 subgrid of each tile's offsets.
+    side = int(math.isqrt(K_OWN))
+    jy = jax.random.randint(k_jit, (2,), 0, min(th, tw))
+    uy = (jy[0] + (th // side) * jnp.arange(side)) % th
+    ux = (jy[1] + (tw // side) * jnp.arange(side)) % tw
+    py = jnp.clip(
+        (jnp.arange(n_ty) * th)[:, None, None, None] + uy[None, None, :, None],
+        0, h - 1,
+    )
+    px = jnp.clip(
+        (jnp.arange(n_tx) * tw)[None, :, None, None] + ux[None, None, None, :],
+        0, w - 1,
+    )
+    own_y = off_y[py, px].reshape(n_ty, n_tx, K_OWN)
+    own_x = off_x[py, px].reshape(n_ty, n_tx, K_OWN)
+
+    # Propagation: the 4 neighbor tiles' first K_PROP//4 samples each.
+    per = K_PROP // 4
+    prop_y, prop_x = [], []
+    for shift in ((1, 0), (-1, 0), (0, 1), (0, -1)):
+        prop_y.append(jnp.roll(own_y[..., :per], shift, axis=(0, 1)))
+        prop_x.append(jnp.roll(own_x[..., :per], shift, axis=(0, 1)))
+    prop_y = jnp.concatenate(prop_y, axis=-1)
+    prop_x = jnp.concatenate(prop_x, axis=-1)
+
+    # Random search: exponentially shrinking radii around own samples
+    # (Barnes alpha = 0.5), one candidate per scale.
+    m = max(ha, wa)
+    radii = np.array(
+        [max(1, m >> (s + 1)) for s in range(K_LOCAL)], np.int32
+    )
+    centers_y = jnp.concatenate(
+        [own_y] * (-(-K_LOCAL // K_OWN)), axis=-1
+    )[..., :K_LOCAL]
+    centers_x = jnp.concatenate(
+        [own_x] * (-(-K_LOCAL // K_OWN)), axis=-1
+    )[..., :K_LOCAL]
+    pert = jax.random.randint(
+        k_loc, (2, n_ty, n_tx, K_LOCAL), -radii.max(), radii.max() + 1
+    )
+    scale = jnp.asarray(radii)[None, None, :]
+    loc_y = centers_y + jnp.clip(pert[0], -scale, scale)
+    loc_x = centers_x + jnp.clip(pert[1], -scale, scale)
+
+    # Uniform restarts over A's valid tile-origin range.
+    ty0 = (jnp.arange(n_ty) * th)[:, None, None]
+    tx0 = (jnp.arange(n_tx) * tw)[None, :, None]
+    glob_y = jax.random.randint(
+        k_gy, (n_ty, n_tx, K_GLOBAL), 0, max(ha - th, 1)
+    ) - ty0
+    glob_x = jax.random.randint(
+        k_gx, (n_ty, n_tx, K_GLOBAL), 0, max(wa - tw, 1)
+    ) - tx0
+
+    cand_y = jnp.concatenate([own_y, prop_y, loc_y, glob_y], axis=-1)
+    cand_x = jnp.concatenate([own_x, prop_x, loc_x, glob_x], axis=-1)
+    return cand_y.astype(jnp.int32), cand_x.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# The kernel
+
+
+def _make_kernel(
+    specs: Tuple[ChannelSpec, ...],
+    geom: TileGeometry,
+    ha: int,
+    wa: int,
+    coh_factor: float,
+):
+    p, th, tw = geom.halo, geom.tile_h, geom.tile_w
+    thp = geom.thp
+    n_chan = len(specs)
+    sy_max = ha - th
+    sx_max = wa - tw
+
+    def kernel(cy_ref, cx_ref, a_ref, b_ref, oyi_ref, oxi_ref, di_ref,
+               oyo_ref, oxo_ref, do_ref):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        ty0 = i * th
+        tx0 = j * tw
+
+        b_blk = b_ref[:].astype(jnp.float32)  # (C, THP, LANE)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (thp, LANE), 1)
+
+        def eval_candidate(k, carry):
+            best_d, best_y, best_x = carry
+            oy = cy_ref[i, j, k]
+            ox = cx_ref[i, j, k]
+            # Clamp the tile's match origin into A; the *actual* offset
+            # after clamping is what gets recorded on acceptance.
+            sy = jnp.clip(ty0 + oy, 0, sy_max)
+            sx = jnp.clip(tx0 + ox, 0, sx_max)
+            xq = sx // LANE
+            xr = sx % LANE
+            rot_amt = (LANE - xr) % LANE
+
+            d = jnp.zeros((thp, LANE), jnp.float32)
+            for c in range(n_chan):
+                sp = specs[c]
+                r = len(sp.wy) // 2
+                # Two adjacent lane blocks -> rotate -> select: the
+                # unaligned 128-lane window [sx, sx+128) of plane c.
+                blk = a_ref[c, pl.ds(sy, thp), pl.ds(xq, 2), :]
+                rot = pltpu.roll(blk, rot_amt, 2)
+                al = jnp.where(
+                    lane < LANE - xr, rot[:, 0, :], rot[:, 1, :]
+                ).astype(jnp.float32)
+                dq = b_blk[c] - al
+                dq = dq * dq
+                # Separable window: static lane rolls then sublane rolls.
+                xs = jnp.zeros_like(dq)
+                for t, wgt in enumerate(sp.wx):
+                    dx = (t - r) * sp.dilation
+                    xs = xs + wgt * pltpu.roll(dq, (LANE - dx) % LANE, 1)
+                for t, wgt in enumerate(sp.wy):
+                    dy = (t - r) * sp.dilation
+                    d = d + wgt * pltpu.roll(xs, (thp - dy) % thp, 0)
+
+            factor = jnp.where(k < K_COHERENT, 1.0, coh_factor)
+            accept = d * factor < best_d
+            best_d = jnp.where(accept, d, best_d)
+            best_y = jnp.where(accept, sy - ty0, best_y)
+            best_x = jnp.where(accept, sx - tx0, best_x)
+            return best_d, best_y, best_x
+
+        best = jax.lax.fori_loop(
+            0,
+            K_TOTAL,
+            eval_candidate,
+            (di_ref[:], oyi_ref[:], oxi_ref[:]),
+        )
+        do_ref[:] = best[0]
+        oyo_ref[:] = best[1]
+        oxo_ref[:] = best[2]
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("specs", "geom", "ha", "wa", "coh_factor", "interpret"),
+)
+def tile_sweep(
+    a_planes: jnp.ndarray,
+    b_blocked: jnp.ndarray,
+    cand_y: jnp.ndarray,
+    cand_x: jnp.ndarray,
+    off_y: jnp.ndarray,
+    off_x: jnp.ndarray,
+    dist: jnp.ndarray,
+    *,
+    specs: Tuple[ChannelSpec, ...],
+    geom: TileGeometry,
+    ha: int,
+    wa: int,
+    coh_factor: float,
+    interpret: bool = False,
+):
+    """One propagate+random-search sweep over every tile.
+
+    `off_y/off_x/dist` are halo-blocked state planes; `dist` is carried in
+    the kernel's metric across sweeps (monotone non-increasing per pixel).
+    """
+    thp = geom.thp
+    n_ty, n_tx = geom.n_ty, geom.n_tx
+    n_chan = a_planes.shape[0]
+
+    kernel = _make_kernel(specs, geom, ha, wa, coh_factor)
+    state_blk = lambda i, j: (i, j)  # noqa: E731
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_ty, n_tx),
+        in_specs=[
+            # Whole candidate tables in SMEM (a few tens of KB): compiled
+            # Pallas requires full-array or (8,128)-divisible blocks, so
+            # the kernel indexes them by program_id instead of blocking.
+            pl.BlockSpec(
+                (n_ty, n_tx, K_TOTAL), lambda i, j: (0, 0, 0),
+                memory_space=pltpu.SMEM,
+            ),
+            pl.BlockSpec(
+                (n_ty, n_tx, K_TOTAL), lambda i, j: (0, 0, 0),
+                memory_space=pltpu.SMEM,
+            ),
+            pl.BlockSpec(
+                a_planes.shape, lambda i, j: (0, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (n_chan, thp, LANE), lambda i, j: (0, i, j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((thp, LANE), state_blk, memory_space=pltpu.VMEM),
+            pl.BlockSpec((thp, LANE), state_blk, memory_space=pltpu.VMEM),
+            pl.BlockSpec((thp, LANE), state_blk, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((thp, LANE), state_blk, memory_space=pltpu.VMEM),
+            pl.BlockSpec((thp, LANE), state_blk, memory_space=pltpu.VMEM),
+            pl.BlockSpec((thp, LANE), state_blk, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_ty * thp, n_tx * LANE), jnp.int32),
+            jax.ShapeDtypeStruct((n_ty * thp, n_tx * LANE), jnp.int32),
+            jax.ShapeDtypeStruct((n_ty * thp, n_tx * LANE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(cand_y, cand_x, a_planes, b_blocked, off_y, off_x, dist)
+    return out  # (off_y, off_x, dist) blocked
+
+
+# ---------------------------------------------------------------------------
+# VMEM budgeting / eligibility
+
+
+def vmem_estimate(specs, ha: int, wa: int) -> int:
+    """Bytes of VMEM the resident A side needs (f32 planes)."""
+    p = halo_for(specs)
+    wq = -(-(wa + 2 * p) // LANE) + 1
+    geom = tile_geometry(ha, wa, specs)
+    extra = geom.thp - (geom.tile_h + 2 * p)
+    return len(specs) * (ha + 2 * p + extra) * wq * LANE * 4
+
+
+# Leave headroom below the ~16 MB/core VMEM for tiles/state/temporaries.
+VMEM_BUDGET = 11 * 1024 * 1024
+
+
+def tile_eligible(h: int, w: int, ha: int, wa: int, specs) -> bool:
+    geom_ok = (
+        min(h, w) >= LANE
+        and ha >= TILE_H + 2 * halo_for(specs)
+        and wa >= LANE
+    )
+    return geom_ok and vmem_estimate(specs, ha, wa) <= VMEM_BUDGET
+
+
+def plan_channels(
+    n_src: int, n_flt: int, cfg: SynthConfig, has_coarse: bool,
+    h: int, w: int, ha: int, wa: int,
+):
+    """Pick the largest channel set that fits the VMEM budget.
+
+    Returns (specs, use_coarse) or None when the level is ineligible for
+    the kernel.  Both the driver (A-plane prep) and the matcher (B-side
+    prep) derive the same plan from the same static shapes, so the two
+    sides always agree on the channel layout.
+    """
+    if has_coarse:
+        specs = channel_specs(n_src, n_flt, cfg, True)
+        if tile_eligible(h, w, ha, wa, specs):
+            return specs, True
+    specs = channel_specs(n_src, n_flt, cfg, False)
+    if tile_eligible(h, w, ha, wa, specs):
+        return specs, False
+    return None

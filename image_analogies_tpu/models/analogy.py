@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from ..config import SynthConfig
 from ..ops.color import luminance, rgb_to_yiq, yiq_to_rgb
 from ..ops.features import assemble_features
-from ..ops.pca import pca_basis, project as pca_project
+from ..ops.pca import fit_and_project as pca_fit_and_project, project as pca_project
 from ..ops.pyramid import build_pyramid, upsample
 from ..ops.remap import remap_luminance
 from ..ops.steerable import steerable_responses
@@ -96,7 +96,7 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool):
     matcher = get_matcher(cfg.matcher)
 
     def em_step(src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf, key,
-                proj=None):
+                proj=None, a_planes=None):
         f_b = assemble_features(
             src_b,
             flt_b,
@@ -106,8 +106,19 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool):
         )
         if cfg.pca_dims:
             f_b = pca_project(f_b, proj)
+        raw = None
+        if a_planes is not None:
+            from .patchmatch import RawPlanes
+
+            raw = RawPlanes(
+                src_b,
+                flt_b,
+                src_b_c if has_coarse else None,
+                flt_b_c if has_coarse else None,
+                a_planes,
+            )
         nnf, dist = matcher.match(
-            f_b, f_a, nnf, key=key, level=level, cfg=cfg
+            f_b, f_a, nnf, key=key, level=level, cfg=cfg, raw=raw
         )
         bp = _gather_image(copy_a, nnf)
         return nnf, dist, bp
@@ -119,6 +130,37 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool):
 def _em_step_fn(cfg: SynthConfig, level: int, has_coarse: bool):
     """Compiled EM step for one pyramid level (cached per config+level)."""
     return jax.jit(make_em_step(cfg, level, has_coarse))
+
+
+def _maybe_a_planes(cfg, pyr_src_a, pyr_flt_a, level, has_coarse, b_shape):
+    """A-side raw planes for the Pallas tile kernel, when the level
+    qualifies (patchmatch matcher, pallas enabled, tile-eligible shapes)
+    — None otherwise, which routes the matcher to its pure-XLA path."""
+    if cfg.matcher != "patchmatch":
+        return None
+    from ..kernels import resolve_pallas
+
+    if resolve_pallas(cfg) is None:
+        return None
+    from ..kernels.patchmatch_tile import plan_channels, prepare_a_planes
+
+    src = pyr_src_a[level]
+    flt = pyr_flt_a[level]
+    n_src = 1 if src.ndim == 2 else src.shape[-1]
+    n_flt = 1 if flt.ndim == 2 else flt.shape[-1]
+    h, w = b_shape
+    ha, wa = src.shape[:2]
+    plan = plan_channels(n_src, n_flt, cfg, has_coarse, h, w, ha, wa)
+    if plan is None:
+        return None
+    specs, use_coarse = plan
+    return prepare_a_planes(
+        src,
+        flt,
+        pyr_src_a[level + 1] if use_coarse else None,
+        pyr_flt_a[level + 1] if use_coarse else None,
+        specs,
+    )
 
 
 def _resolve_channels(a, ap, b, cfg: SynthConfig):
@@ -188,10 +230,11 @@ def create_image_analogy(
             pyr_src_a[level + 1] if has_coarse else None,
             pyr_flt_a[level + 1] if has_coarse else None,
         )
-        proj = None
-        if cfg.pca_dims:
-            proj = pca_basis(f_a.reshape(-1, f_a.shape[-1]), cfg.pca_dims)
-            f_a = pca_project(f_a, proj)
+        f_a, proj = pca_fit_and_project(f_a, cfg.pca_dims)
+
+        a_planes = _maybe_a_planes(
+            cfg, pyr_src_a, pyr_flt_a, level, has_coarse, (h, w)
+        )
 
         level_key = jax.random.fold_in(key, level)
         if has_coarse:
@@ -215,9 +258,9 @@ def create_image_analogy(
                 pyr_copy_a[level],
                 nnf,
                 jax.random.fold_in(level_key, em),
+                proj,
+                a_planes,
             )
-            if cfg.pca_dims:
-                args = args + (proj,)
             nnf, dist, bp = step(*args)
             # The filtered-side match channels of B' are the synthesized
             # copy channels (luminance mode) or their luminance (rgb mode).

@@ -74,7 +74,8 @@ class BruteForceMatcher(Matcher):
 
     name = "brute"
 
-    def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig):
+    def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig,
+              raw=None):
         from ..kernels import resolve_pallas
         from ..kernels.nn_brute import exact_nn_pallas
 

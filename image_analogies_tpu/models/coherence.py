@@ -83,9 +83,10 @@ class CoherenceWrapper(Matcher):
         self.name = base.name
         self.sweeps = sweeps
 
-    def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig):
+    def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig,
+              raw=None):
         nnf, dist = self.base.match(
-            f_b, f_a, nnf, key=key, level=level, cfg=cfg
+            f_b, f_a, nnf, key=key, level=level, cfg=cfg, raw=raw
         )
         if cfg.kappa > 0.0:
             nnf, dist = coherence_sweeps(

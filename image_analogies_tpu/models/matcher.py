@@ -97,7 +97,11 @@ class Matcher:
         key: jax.Array,
         level: int,
         cfg: SynthConfig,
+        raw=None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """`raw` optionally carries the raw channel planes
+        (models.patchmatch.RawPlanes) backing the Pallas tile kernel;
+        matchers that work on assembled features ignore it."""
         raise NotImplementedError
 
     def __repr__(self):

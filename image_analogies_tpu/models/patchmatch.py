@@ -29,7 +29,7 @@ Python steps].
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +44,18 @@ from .matcher import (
     nnf_to_flat,
     register_matcher,
 )
+
+
+class RawPlanes(NamedTuple):
+    """Raw channel images backing the Pallas tile kernel's metric
+    (kernels/patchmatch_tile.py): the kernel computes windowed SSDs from
+    these planes directly instead of gathering assembled feature rows."""
+
+    src_b: jnp.ndarray
+    flt_b: jnp.ndarray
+    src_b_coarse: Optional[jnp.ndarray]
+    flt_b_coarse: Optional[jnp.ndarray]
+    a_planes: jnp.ndarray  # (C, Ha+2P, Wq, 128) bf16, prepare_a_planes
 
 # Propagation neighborhood: left, right, up, down.
 _DELTAS = ((0, -1), (0, 1), (-1, 0), (1, 0))
@@ -142,13 +154,138 @@ def kappa_factor(kappa: float, level: int) -> float:
     return 1.0 + kappa * (2.0 ** (-level))
 
 
+def tile_patchmatch(
+    f_b: jnp.ndarray,
+    f_a: jnp.ndarray,
+    nnf: jnp.ndarray,
+    key: jax.Array,
+    *,
+    raw: RawPlanes,
+    cfg: SynthConfig,
+    level: int,
+    interpret: bool,
+    plan,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pallas tile-kernel PatchMatch (kernels/patchmatch_tile.py).
+
+    Sweeps run in the kernel's raw-plane metric (bulk global search); the
+    result is then merged with the incoming field under the *exact*
+    feature metric (so the field never regresses) and polished with one
+    per-pixel XLA sweep, which restores the pure-XLA twin's output
+    contract: exact f32 distances and canonical tie-breaking.
+
+    `plan` is the (specs, use_coarse) channel plan the dispatcher already
+    resolved — passed through so dispatch and kernel cannot disagree.
+    """
+    from ..kernels.patchmatch_tile import (
+        channel_images,
+        sample_candidates,
+        tile_geometry,
+        tile_sweep,
+        to_blocked,
+        from_blocked,
+    )
+
+    h, w, _ = f_b.shape
+    ha, wa = f_a.shape[:2]
+    f_a_flat = f_a.reshape(-1, f_a.shape[-1])
+    specs, use_coarse = plan
+    geom = tile_geometry(h, w, specs)
+    coh = kappa_factor(cfg.kappa, level)
+
+    chans_b = channel_images(
+        raw.src_b,
+        raw.flt_b,
+        raw.src_b_coarse if use_coarse else None,
+        raw.flt_b_coarse if use_coarse else None,
+    )
+    b_blocked = jnp.stack(
+        [to_blocked(c.astype(jnp.float32), geom) for c in chans_b]
+    )
+
+    nnf = clamp_nnf(nnf, ha, wa)
+    qy = jax.lax.broadcasted_iota(jnp.int32, (h, w), 0)
+    qx = jax.lax.broadcasted_iota(jnp.int32, (h, w), 1)
+    off_y = nnf[..., 0] - qy
+    off_x = nnf[..., 1] - qx
+    dist0 = nnf_dist(f_b, f_a_flat, nnf, wa)
+
+    oy_b = to_blocked(off_y, geom)
+    ox_b = to_blocked(off_x, geom)
+    # Incumbent distances start at +inf, NOT at dist0: dist0 lives in the
+    # (possibly PCA-projected, exactly coarse-sampled) feature metric,
+    # which is not the kernel's raw-plane metric — mixing them would make
+    # the accept test incoherent (with PCA, projected distances are
+    # systematically smaller, so raw-metric candidates would almost never
+    # win).  The incoming field still defends itself: its offsets are in
+    # every sweep's own-tile candidate samples (evaluated under the
+    # kernel metric), and the final merge below is exact-metric.
+    d_b = jnp.full(
+        (geom.n_ty * geom.thp, geom.n_tx * 128), jnp.inf, jnp.float32
+    )
+    for t in range(cfg.pm_iters):
+        cand_y, cand_x = sample_candidates(
+            off_y, off_x, jax.random.fold_in(key, t), geom, ha, wa
+        )
+        oy_b, ox_b, d_b = tile_sweep(
+            raw.a_planes, b_blocked, cand_y, cand_x, oy_b, ox_b, d_b,
+            specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=coh,
+            interpret=interpret,
+        )
+        off_y = from_blocked(oy_b, geom, h, w)
+        off_x = from_blocked(ox_b, geom, h, w)
+
+    nnf_k = clamp_nnf(
+        jnp.stack([qy + off_y, qx + off_x], axis=-1), ha, wa
+    )
+    # Exact-metric merge: adopt the kernel's match only where it wins.
+    d_k = nnf_dist(f_b, f_a_flat, nnf_k, wa)
+    better = d_k < dist0
+    nnf_m = jnp.where(better[..., None], nnf_k, nnf)
+    # Per-pixel polish sweep (propagation + ties canonicalization).
+    return patchmatch_sweeps(
+        f_b,
+        f_a,
+        nnf_m,
+        jax.random.fold_in(key, cfg.pm_iters),
+        iters=1,
+        n_random=2,
+        coh_factor=coh,
+    )
+
+
 class PatchMatchMatcher(Matcher):
-    """Pure-JAX PatchMatch; seeds from the incoming NNF (upsampled from the
-    coarser level by the driver, or random at the coarsest level)."""
+    """PatchMatch NN-field matcher; seeds from the incoming NNF (upsampled
+    from the coarser level by the driver, or random at the coarsest
+    level).  Dispatch (kernels/__init__.py contract): the Pallas tile
+    kernel when raw planes are provided, the level is tile-eligible, and
+    pallas_mode resolves to compiled/interpret; the pure-XLA sweeps
+    otherwise."""
 
     name = "patchmatch"
 
-    def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig):
+    def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig,
+              raw: Optional[RawPlanes] = None):
+        from ..kernels import resolve_pallas
+
+        interpret = resolve_pallas(cfg)
+        if raw is not None and interpret is not None:
+            from ..kernels.patchmatch_tile import plan_channels
+
+            h, w = f_b.shape[:2]
+            ha, wa = f_a.shape[:2]
+            n_src = 1 if raw.src_b.ndim == 2 else raw.src_b.shape[-1]
+            n_flt = 1 if raw.flt_b.ndim == 2 else raw.flt_b.shape[-1]
+            plan = plan_channels(
+                n_src, n_flt, cfg, raw.src_b_coarse is not None,
+                h, w, ha, wa,
+            )
+            if plan is not None:
+                return tile_patchmatch(
+                    f_b, f_a, nnf, key,
+                    raw=raw, cfg=cfg, level=level, interpret=interpret,
+                    plan=plan,
+                )
         return patchmatch_sweeps(
             f_b,
             f_a,

@@ -50,3 +50,14 @@ def project(f: jnp.ndarray, basis: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum(
         "...d,dk->...k", f, basis, preferred_element_type=jnp.float32
     )
+
+
+def fit_and_project(f_a: jnp.ndarray, k) -> tuple:
+    """Per-level A-side PCA: fit the basis on the (H, W, D) feature field
+    and project it.  Returns (f_a_projected, basis) — or (f_a, None) when
+    `k` is falsy.  Single entry point for every synthesis driver so the
+    fit policy cannot diverge between them."""
+    if not k:
+        return f_a, None
+    basis = pca_basis(f_a.reshape(-1, f_a.shape[-1]), k)
+    return project(f_a, basis), basis

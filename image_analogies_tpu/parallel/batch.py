@@ -38,15 +38,14 @@ from .mesh import BATCH_AXIS, batch_sharding, make_mesh, replicated
 def _batch_step_fn(cfg: SynthConfig, level: int, has_coarse: bool, mesh_key):
     mesh = _MESHES[mesh_key]
     step = make_em_step(cfg, level, has_coarse)
-    # Frame-carried args are vmapped; the A-side (f_a, copy_a) and the
-    # PCA basis are shared across frames.
-    in_axes = (0, 0, 0, 0, None, None, 0, 0)
+    # Frame-carried args are vmapped; the A-side (f_a, copy_a), the PCA
+    # basis, and the (unused here) kernel planes are shared across frames.
+    in_axes = (0, 0, 0, 0, None, None, 0, 0, None, None)
     shard = batch_sharding(mesh)
     repl = replicated(mesh)
-    shardings = (shard, shard, shard, shard, repl, repl, shard, shard)
-    if cfg.pca_dims:
-        in_axes = in_axes + (None,)
-        shardings = shardings + (repl,)
+    shardings = (
+        shard, shard, shard, shard, repl, repl, shard, shard, repl, repl,
+    )
     vstep = jax.vmap(step, in_axes=in_axes)
     return jax.jit(
         vstep,
@@ -126,12 +125,9 @@ def synthesize_batch(
             pyr_src_a[level + 1] if has_coarse else None,
             pyr_flt_a[level + 1] if has_coarse else None,
         )
-        proj = None
-        if cfg.pca_dims:
-            from ..ops.pca import pca_basis, project as pca_project
+        from ..ops.pca import fit_and_project
 
-            proj = pca_basis(f_a.reshape(-1, f_a.shape[-1]), cfg.pca_dims)
-            f_a = pca_project(f_a, proj)
+        f_a, proj = fit_and_project(f_a, cfg.pca_dims)
 
         level_key = jax.random.fold_in(key, level)
         if has_coarse:
@@ -159,9 +155,9 @@ def synthesize_batch(
                 pyr_copy_a[level],
                 nnf,
                 em_keys,
+                proj,
+                None,  # a_planes: the tile kernel is single-image for now
             )
-            if cfg.pca_dims:
-                args = args + (proj,)
             nnf, dist, bp = step(*args)
             flt_bp = bp
 

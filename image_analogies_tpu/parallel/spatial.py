@@ -28,6 +28,7 @@ style images are small next to B' at the scales this runner targets.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -67,6 +68,36 @@ def _merge_cores(slabs: jnp.ndarray, halo: int) -> jnp.ndarray:
     """Inverse of `_split_slabs`: drop halos, concatenate cores."""
     core = slabs[:, halo : slabs.shape[1] - halo]
     return core.reshape(-1, *core.shape[2:])
+
+
+@functools.lru_cache(maxsize=32)
+def _reslab_fn(halo: int, n_slabs: int, mesh_key):
+    """Jitted stitch-cores + re-split-with-fresh-halos, slab-sharded in
+    and out.
+
+    Between EM iterations only the halo rows actually change hands; with
+    input and output pinned to the slab sharding, XLA lowers the
+    merge+split pair to the boundary-row exchanges between mesh neighbors
+    instead of re-materializing the global arrays on the host every
+    iteration (the module docstring's halo-exchange claim is made true
+    here)."""
+    from .batch import _MESHES
+
+    shard = batch_sharding(_MESHES[mesh_key])
+
+    def reslab(nnf_s, bp_s):
+        nnf = _merge_cores(nnf_s, halo)
+        bp = _merge_cores(bp_s, halo)
+        return (
+            _split_slabs(nnf, n_slabs, halo),
+            _split_slabs(bp, n_slabs, halo),
+        )
+
+    return jax.jit(
+        reslab,
+        in_shardings=(shard, shard),
+        out_shardings=(shard, shard),
+    )
 
 
 def synthesize_spatial(
@@ -126,12 +157,9 @@ def synthesize_spatial(
             pyr_src_a[level + 1] if has_coarse else None,
             pyr_flt_a[level + 1] if has_coarse else None,
         )
-        proj = None
-        if cfg.pca_dims:
-            from ..ops.pca import pca_basis, project as pca_project
+        from ..ops.pca import fit_and_project
 
-            proj = pca_basis(f_a.reshape(-1, f_a.shape[-1]), cfg.pca_dims)
-            f_a = pca_project(f_a, proj)
+        f_a, proj = fit_and_project(f_a, cfg.pca_dims)
 
         level_key = jax.random.fold_in(key, level)
         if has_coarse:
@@ -145,25 +173,41 @@ def synthesize_spatial(
 
         # Level-invariant slab views of the match-side images (the
         # coarse B' estimate is frozen for the whole level, so its slab
-        # split is hoisted with them).
-        slab_src_b = _split_slabs(pyr_src_b[level], n_slabs, _HALO)
-        slab_src_b_c = _split_slabs(
-            pyr_src_b[level + 1] if has_coarse else pyr_src_b[level],
-            n_slabs,
-            _HALO // 2 if has_coarse else _HALO,
+        # split is hoisted with them), placed on the mesh once per level.
+        shard = batch_sharding(mesh)
+        slab_src_b = jax.device_put(
+            _split_slabs(pyr_src_b[level], n_slabs, _HALO), shard
+        )
+        slab_src_b_c = jax.device_put(
+            _split_slabs(
+                pyr_src_b[level + 1] if has_coarse else pyr_src_b[level],
+                n_slabs,
+                _HALO // 2 if has_coarse else _HALO,
+            ),
+            shard,
         )
         slab_flt_c = (
-            _split_slabs(flt_bp_coarse_g, n_slabs, _HALO // 2)
+            jax.device_put(
+                _split_slabs(flt_bp_coarse_g, n_slabs, _HALO // 2), shard
+            )
             if has_coarse
             else None
         )
 
         step = _spatial_step_fn(cfg, level, has_coarse, token)
-        shard = batch_sharding(mesh)
+        # One host-side slab placement per level; between EM iterations
+        # the state stays in (sharded) slab form and is re-haloed by the
+        # jitted _reslab, so per-iteration traffic is boundary rows only.
+        slab_nnf = jax.device_put(
+            _split_slabs(nnf, n_slabs, _HALO), shard
+        )
+        slab_flt = jax.device_put(
+            _split_slabs(flt_bp, n_slabs, _HALO), shard
+        )
+        nnf_s = dist_s = bp_s = None
         for em in range(cfg.em_iters):
             em_key = jax.random.fold_in(level_key, em)
             slab_keys = jax.random.split(em_key, n_slabs)
-            slab_flt = _split_slabs(flt_bp, n_slabs, _HALO)
             args = (
                 slab_src_b,
                 slab_flt,
@@ -171,25 +215,22 @@ def synthesize_spatial(
                 slab_flt_c if has_coarse else slab_flt,
                 f_a,
                 pyr_copy_a[level],
-                _split_slabs(nnf, n_slabs, _HALO),
+                slab_nnf,
                 slab_keys,
+                # proj replicated; a_planes None (slab-local tile origins
+                # would skew the kernel's tile->A coordinates).
+                proj,
+                None,
             )
-            # Slab-axis args onto the mesh (the split above computes on
-            # the replicated global array; this placement is the halo
-            # scatter, its merge below the gather).
-            args = tuple(
-                jax.device_put(x, shard) if i not in (4, 5) else x
-                for i, x in enumerate(args)
-            )
-            if cfg.pca_dims:
-                args = args + (proj,)
             nnf_s, dist_s, bp_s = step(*args)
-            # Re-stitch cores -> fresh halos next iteration (the
-            # compiler-lowered halo exchange).
-            nnf = _merge_cores(nnf_s, _HALO)
-            dist = _merge_cores(dist_s, _HALO)
-            bp = _merge_cores(bp_s, _HALO)
-            flt_bp = bp
+            if em < cfg.em_iters - 1:
+                slab_nnf, slab_flt = _reslab_fn(_HALO, n_slabs, token)(
+                    nnf_s, bp_s
+                )
+        nnf = _merge_cores(nnf_s, _HALO)
+        dist = _merge_cores(dist_s, _HALO)
+        bp = _merge_cores(bp_s, _HALO)
+        flt_bp = bp
 
         if progress is not None:
             progress.emit(

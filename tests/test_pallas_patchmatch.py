@@ -1,0 +1,318 @@
+"""Pallas tile-PatchMatch kernel tests (SURVEY.md §4 'Kernel'), run in
+interpreter mode on the CPU backend — which also OOB-checks every slice
+(SURVEY.md §5 sanitizers).  Covers: blocked-layout round trip, the
+kernel's windowed-SSD metric against a NumPy oracle, candidate sampling
+invariants, and the full kernel-path matcher against the exact oracle.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from image_analogies_tpu.config import SynthConfig
+from image_analogies_tpu.kernels.patchmatch_tile import (
+    K_TOTAL,
+    LANE,
+    TILE_H,
+    channel_images,
+    channel_specs,
+    halo_for,
+    prepare_a_planes,
+    sample_candidates,
+    tile_eligible,
+    tile_geometry,
+    tile_sweep,
+    to_blocked,
+    from_blocked,
+    vmem_estimate,
+)
+from image_analogies_tpu.models.patchmatch import RawPlanes
+from image_analogies_tpu.models.matcher import get_matcher
+from image_analogies_tpu.models.brute import exact_nn
+from image_analogies_tpu.ops.features import assemble_features
+
+
+def _specs(cfg=None, has_coarse=False, n_src=1, n_flt=1):
+    cfg = cfg or SynthConfig()
+    return channel_specs(n_src, n_flt, cfg, has_coarse)
+
+
+class TestBlockedLayout:
+    def test_round_trip_identity(self, rng):
+        specs = _specs()
+        for (h, w) in [(128, 128), (130, 250), (64, 128)]:
+            geom = tile_geometry(h, w, specs)
+            plane = jnp.asarray(
+                rng.standard_normal((h, w)).astype(np.float32)
+            )
+            back = from_blocked(to_blocked(plane, geom), geom, h, w)
+            np.testing.assert_array_equal(np.asarray(back), np.asarray(plane))
+
+    def test_blocked_halo_is_neighbor_content(self, rng):
+        """A tile's halo rows must replicate the adjacent tile's edge
+        content (not padding) for interior tiles."""
+        specs = _specs()
+        h = w = 2 * TILE_H + 60  # > 1 tile each way
+        geom = tile_geometry(h, w, specs)
+        p, th = geom.halo, geom.tile_h
+        thp = geom.thp
+        plane = rng.standard_normal((h, w)).astype(np.float32)
+        blocked = np.asarray(to_blocked(jnp.asarray(plane), geom))
+        # Tile (1, 0): rows [th-p, th-p+thp), cols [0-p, LANE-p) edge-padded.
+        tile = blocked[thp : 2 * thp, :LANE]
+        np.testing.assert_array_equal(
+            tile[:, p:], plane[th - p : th - p + thp, : LANE - p]
+        )
+
+
+class TestKernelMetric:
+    """Force every candidate to one shared offset: the kernel's output
+    distance must equal the NumPy windowed-SSD at that offset."""
+
+    def _oracle(self, chans_b, chans_a, specs, oy, ox):
+        p = halo_for(specs)
+        h, w = chans_b[0].shape
+        d = np.zeros((h, w), np.float64)
+        for cb, ca, sp in zip(chans_b, chans_a, specs):
+            r = len(sp.wy) // 2
+            bp = np.pad(cb.astype(np.float32), p, mode="edge")
+            apad = np.pad(ca.astype(np.float32), p, mode="edge")
+            for ty, wy in enumerate(sp.wy):
+                for tx, wx in enumerate(sp.wx):
+                    dy = (ty - r) * sp.dilation
+                    dx = (tx - r) * sp.dilation
+                    bwin = bp[p + dy : p + dy + h, p + dx : p + dx + w]
+                    awin = apad[
+                        p + oy + dy : p + oy + dy + h,
+                        p + ox + dx : p + ox + dx + w,
+                    ]
+                    d += wy * wx * (bwin - awin) ** 2
+        return d
+
+    # Offsets kept inside every tile's unclamped range: the rightmost
+    # tile origin is 124, and wa - tile_w = 132, so ox <= 8.
+    @pytest.mark.parametrize("offset", [(0, 0), (2, 3), (17, 7)])
+    def test_matches_numpy_oracle_fine(self, rng, offset):
+        oy, ox = offset
+        cfg = SynthConfig()
+        specs = _specs(cfg)
+        h, w = 128, 128
+        ha, wa = 224, 256
+        geom = tile_geometry(h, w, specs)
+        src_b = rng.standard_normal((h, w)).astype(np.float32)
+        flt_b = rng.standard_normal((h, w)).astype(np.float32)
+        src_a = rng.standard_normal((ha, wa)).astype(np.float32)
+        flt_a = rng.standard_normal((ha, wa)).astype(np.float32)
+
+        a_planes = prepare_a_planes(
+            jnp.asarray(src_a), jnp.asarray(flt_a), None, None, specs
+        )
+        b_blocked = jnp.stack(
+            [to_blocked(jnp.asarray(c), geom) for c in (src_b, flt_b)]
+        )
+        n_ty, n_tx = geom.n_ty, geom.n_tx
+        cand_y = jnp.full((n_ty, n_tx, K_TOTAL), oy, jnp.int32)
+        cand_x = jnp.full((n_ty, n_tx, K_TOTAL), ox, jnp.int32)
+        thp = geom.thp
+        z = jnp.zeros((n_ty * thp, n_tx * LANE), jnp.int32)
+        d0 = jnp.full((n_ty * thp, n_tx * LANE), np.inf, jnp.float32)
+
+        oy_b, ox_b, d_b = tile_sweep(
+            a_planes, b_blocked, cand_y, cand_x, z, z, d0,
+            specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=1.0,
+            interpret=True,
+        )
+        got = np.asarray(from_blocked(d_b, geom, h, w))
+        want = self._oracle([src_b, flt_b], [src_a, flt_a], specs, oy, ox)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        # Recorded offsets are the shared candidate everywhere.
+        np.testing.assert_array_equal(
+            np.asarray(from_blocked(oy_b, geom, h, w)), oy
+        )
+        np.testing.assert_array_equal(
+            np.asarray(from_blocked(ox_b, geom, h, w)), ox
+        )
+
+    def test_matches_numpy_oracle_coarse(self, rng):
+        """With coarse channels: dilated window on upsampled planes."""
+        cfg = SynthConfig()
+        specs = _specs(cfg, has_coarse=True)
+        h = w = 128
+        ha = wa = 256  # large enough that (oy, ox) clamps in no tile
+        geom = tile_geometry(h, w, specs)
+        mk = lambda *s: rng.standard_normal(s).astype(np.float32)  # noqa: E731
+        src_b, flt_b = mk(h, w), mk(h, w)
+        src_bc, flt_bc = mk(h // 2, w // 2), mk(h // 2, w // 2)
+        src_a, flt_a = mk(ha, wa), mk(ha, wa)
+        src_ac, flt_ac = mk(ha // 2, wa // 2), mk(ha // 2, wa // 2)
+
+        a_planes = prepare_a_planes(
+            jnp.asarray(src_a), jnp.asarray(flt_a),
+            jnp.asarray(src_ac), jnp.asarray(flt_ac), specs,
+        )
+        chans_b = channel_images(
+            jnp.asarray(src_b), jnp.asarray(flt_b),
+            jnp.asarray(src_bc), jnp.asarray(flt_bc),
+        )
+        b_blocked = jnp.stack(
+            [to_blocked(c.astype(jnp.float32), geom) for c in chans_b]
+        )
+        oy, ox = 5, 2
+        n_ty, n_tx = geom.n_ty, geom.n_tx
+        cand_y = jnp.full((n_ty, n_tx, K_TOTAL), oy, jnp.int32)
+        cand_x = jnp.full((n_ty, n_tx, K_TOTAL), ox, jnp.int32)
+        thp = geom.thp
+        z = jnp.zeros((n_ty * thp, n_tx * LANE), jnp.int32)
+        d0 = jnp.full((n_ty * thp, n_tx * LANE), np.inf, jnp.float32)
+        _, _, d_b = tile_sweep(
+            a_planes, b_blocked, cand_y, cand_x, z, z, d0,
+            specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=1.0,
+            interpret=True,
+        )
+        got = np.asarray(from_blocked(d_b, geom, h, w))
+        chans_a = channel_images(
+            jnp.asarray(src_a), jnp.asarray(flt_a),
+            jnp.asarray(src_ac), jnp.asarray(flt_ac),
+        )
+        want = self._oracle(
+            [np.asarray(c, np.float32) for c in chans_b],
+            [np.asarray(c, np.float32) for c in chans_a],
+            specs, oy, ox,
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestCandidateSampling:
+    def test_shapes_and_split(self, rng):
+        specs = _specs()
+        geom = tile_geometry(256, 256, specs)
+        off = jnp.zeros((256, 256), jnp.int32)
+        cy, cx = sample_candidates(
+            off, off, jax.random.PRNGKey(0), geom, 256, 256
+        )
+        assert cy.shape == (geom.n_ty, geom.n_tx, K_TOTAL)
+        assert cx.shape == cy.shape
+
+    def test_own_samples_come_from_state(self, rng):
+        """With a constant offset field, all own/prop candidates equal it."""
+        from image_analogies_tpu.kernels.patchmatch_tile import K_COHERENT
+
+        specs = _specs()
+        geom = tile_geometry(128, 128, specs)
+        off_y = jnp.full((128, 128), 7, jnp.int32)
+        off_x = jnp.full((128, 128), -3, jnp.int32)
+        cy, cx = sample_candidates(
+            off_y, off_x, jax.random.PRNGKey(1), geom, 256, 256
+        )
+        assert (np.asarray(cy)[..., :K_COHERENT] == 7).all()
+        assert (np.asarray(cx)[..., :K_COHERENT] == -3).all()
+
+
+class TestEligibility:
+    def test_small_levels_fall_back(self):
+        specs = _specs()
+        assert not tile_eligible(64, 64, 64, 64, specs)
+        assert tile_eligible(128, 128, 128, 128, specs)
+
+    def test_channel_plan_adapts_to_vmem(self):
+        from image_analogies_tpu.kernels.patchmatch_tile import plan_channels
+
+        cfg = SynthConfig()
+        # 512^2: all four channels fit.
+        plan = plan_channels(1, 1, cfg, True, 512, 512, 512, 512)
+        assert plan is not None and plan[1] is True
+        assert vmem_estimate(plan[0], 512, 512) < 11e6
+        # 1024^2: coarse channels dropped, fine-only still fits.
+        plan = plan_channels(1, 1, cfg, True, 1024, 1024, 1024, 1024)
+        assert plan is not None and plan[1] is False
+        assert vmem_estimate(plan[0], 1024, 1024) < 11e6
+        # Steerable at 1024^2 (5 src channels): nothing fits -> None.
+        cfg_s = SynthConfig(steerable=True)
+        assert plan_channels(5, 1, cfg_s, True, 1024, 1024, 1024, 1024) is None
+
+
+class TestKernelMatcherPath:
+    """Full matcher dispatch with raw planes (interpret mode)."""
+
+    def _setup(self, rng, h=128, w=128, ha=128, wa=128):
+        cfg = SynthConfig(
+            matcher="patchmatch", pallas_mode="interpret", levels=1,
+            pm_iters=2,
+        )
+        src_b = jnp.asarray(rng.random((h, w)).astype(np.float32))
+        flt_b = jnp.asarray(rng.random((h, w)).astype(np.float32))
+        src_a = jnp.asarray(rng.random((ha, wa)).astype(np.float32))
+        flt_a = jnp.asarray(rng.random((ha, wa)).astype(np.float32))
+        f_b = assemble_features(src_b, flt_b, cfg, None, None)
+        f_a = assemble_features(src_a, flt_a, cfg, None, None)
+        specs = _specs(cfg)
+        a_planes = prepare_a_planes(src_a, flt_a, None, None, specs)
+        raw = RawPlanes(src_b, flt_b, None, None, a_planes)
+        return cfg, f_b, f_a, raw
+
+    def test_beats_random_and_near_oracle(self, rng):
+        cfg, f_b, f_a, raw = self._setup(rng)
+        m = get_matcher("patchmatch")
+        key = jax.random.PRNGKey(0)
+        nnf0 = jnp.zeros((128, 128, 2), jnp.int32)
+        nnf, dist = m.match(
+            f_b, f_a, nnf0, key=key, level=0, cfg=cfg, raw=raw
+        )
+        d = f_a.shape[-1]
+        _, d_exact = exact_nn(
+            f_b.reshape(-1, d), f_a.reshape(-1, d), chunk=4096
+        )
+        # Within 2x of the exact optimum after only 2 kernel sweeps +
+        # 1 polish sweep (smoke threshold; TPU runs use more sweeps).
+        assert float(dist.mean()) <= 2.0 * float(d_exact.mean())
+
+    def test_deterministic(self, rng):
+        cfg, f_b, f_a, raw = self._setup(rng)
+        m = get_matcher("patchmatch")
+        key = jax.random.PRNGKey(3)
+        nnf0 = jnp.zeros((128, 128, 2), jnp.int32)
+        out1 = m.match(f_b, f_a, nnf0, key=key, level=0, cfg=cfg, raw=raw)
+        out2 = m.match(f_b, f_a, nnf0, key=key, level=0, cfg=cfg, raw=raw)
+        np.testing.assert_array_equal(np.asarray(out1[0]), np.asarray(out2[0]))
+
+    def test_dist_consistent_with_nnf(self, rng):
+        from image_analogies_tpu.models.matcher import nnf_dist
+
+        cfg, f_b, f_a, raw = self._setup(rng)
+        m = get_matcher("patchmatch")
+        nnf, dist = m.match(
+            f_b, f_a, jnp.zeros((128, 128, 2), jnp.int32),
+            key=jax.random.PRNGKey(1), level=0, cfg=cfg, raw=raw,
+        )
+        recomputed = nnf_dist(
+            f_b, f_a.reshape(-1, f_a.shape[-1]), nnf, f_a.shape[1]
+        )
+        np.testing.assert_allclose(
+            np.asarray(dist), np.asarray(recomputed), rtol=1e-4, atol=1e-5
+        )
+
+
+class TestEndToEnd:
+    def test_create_image_analogy_kernel_path(self):
+        """128^2 super-resolution synthesis through the kernel path tracks
+        the brute-force oracle (mirrors test_synthesis config 3, which
+        asserts the same for the pure-XLA PatchMatch path)."""
+        from image_analogies_tpu import create_image_analogy, psnr
+        from image_analogies_tpu.utils.examples import super_resolution
+
+        a, ap, b = super_resolution(128)
+        kw = dict(levels=2, em_iters=2)
+        bp_kernel = np.asarray(
+            create_image_analogy(
+                a, ap, b,
+                SynthConfig(
+                    matcher="patchmatch", pallas_mode="interpret",
+                    pm_iters=3, **kw,
+                ),
+            )
+        )
+        bp_oracle = np.asarray(
+            create_image_analogy(a, ap, b, SynthConfig(matcher="brute", **kw))
+        )
+        assert psnr(bp_kernel, bp_oracle) >= 30.0
