@@ -23,7 +23,12 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--coarse-patch-size", type=int, default=3)
     p.add_argument("--kappa", type=float, default=0.0)
     p.add_argument(
-        "--matcher", default="patchmatch", help="brute | patchmatch"
+        "--matcher", default="patchmatch",
+        help="brute | patchmatch | ann (native C++ kd-tree, CPU backend)",
+    )
+    p.add_argument(
+        "--ann-eps", type=float, default=0.5,
+        help="ann matcher approximation factor; 0 = exact tree search",
     )
     p.add_argument(
         "--color-mode", default="luminance", choices=["luminance", "rgb"]
@@ -65,6 +70,7 @@ def _config_from(args) -> "SynthConfig":
         em_iters=args.em_iters,
         pm_iters=args.pm_iters,
         pca_dims=args.pca_dims,
+        ann_eps=args.ann_eps,
         seed=args.seed,
         pallas_mode=args.pallas_mode,
         save_level_artifacts=args.save_level_artifacts,

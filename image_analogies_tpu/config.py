@@ -68,6 +68,11 @@ class SynthConfig:
     # per step; bounds peak HBM for the (chunk, N_A) distance tile).
     brute_chunk: int = 4096
 
+    # Approximation factor for the native kd-tree 'ann' matcher (C8):
+    # returned neighbors are within (1+eps) of the true nearest distance;
+    # 0 = exact search.  Pair with pca_dims (Hertzmann §3.1).
+    ann_eps: float = 0.5
+
     # Minimum image side at the coarsest pyramid level; levels are clamped
     # so the coarsest level is at least this big.
     min_size: int = 16
@@ -89,6 +94,8 @@ class SynthConfig:
             raise ValueError(f"unknown pallas_mode {self.pallas_mode!r}")
         if self.pca_dims is not None and self.pca_dims < 1:
             raise ValueError("pca_dims must be >= 1 (or None to disable)")
+        if self.ann_eps < 0.0:
+            raise ValueError("ann_eps must be >= 0")
 
     def clamp_levels(self, *shapes: Tuple[int, int]) -> int:
         """Number of usable pyramid levels for the given image shapes."""

@@ -264,7 +264,7 @@ def from_blocked(
     thp = geom.thp
     x = blocked.reshape(geom.n_ty, thp, geom.n_tx, LANE)
     x = x[:, p : p + th, :, p : p + tw]
-    x = x.transpose(0, 1, 2, 3).reshape(geom.n_ty * th, geom.n_tx * tw)
+    x = x.reshape(geom.n_ty * th, geom.n_tx * tw)
     return x[:h, :w]
 
 

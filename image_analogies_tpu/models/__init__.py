@@ -9,6 +9,7 @@ from .matcher import (
 from .brute import BruteForceMatcher, exact_nn
 from .patchmatch import PatchMatchMatcher, patchmatch_sweeps, random_init
 from .coherence import CoherenceWrapper, coherence_sweeps
+from .ann import AnnMatcher
 from .analogy import create_image_analogy, upsample_nnf
 
 __all__ = [
@@ -18,6 +19,7 @@ __all__ = [
     "register_matcher",
     "BruteForceMatcher",
     "exact_nn",
+    "AnnMatcher",
     "PatchMatchMatcher",
     "patchmatch_sweeps",
     "random_init",

@@ -45,6 +45,7 @@ from .patchmatch import random_init
 from . import brute as _brute  # noqa: F401
 from . import coherence as _coherence  # noqa: F401
 from . import patchmatch as _patchmatch  # noqa: F401
+from . import ann as _ann  # noqa: F401
 
 
 def _with_steerable(y: jnp.ndarray, cfg: SynthConfig) -> jnp.ndarray:

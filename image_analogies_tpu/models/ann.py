@@ -1,0 +1,136 @@
+"""Native kd-tree ANN matcher (SURVEY.md §2 C8).
+
+The reference backs its approximate search with a host-side C++ ANN
+library (FLANN/cKDTree family) [SURVEY.md C8].  The TPU-native mapping of
+that component is the Pallas PatchMatch kernel (C9) — trees don't map to
+the MXU — but the CPU backend keeps a faithful native equivalent: the
+C++ kd-tree in native/ann.cpp (built via g++ + ctypes, utils/native.py),
+reached from inside the jitted EM step through `jax.pure_callback` (the
+JAX-idiomatic host-code embedding; on TPU this is a host round trip and
+is anti-idiomatic — use it with `--device cpu`, as the reference would).
+
+Hertzmann §3.1 pairs ANN search with PCA-projected features; combine
+`matcher="ann"` with `pca_dims` for the same effect.  At `ann_eps=0` the
+tree search is exact and the matcher is interchangeable with `brute`
+(same metric, near-identical fields modulo argmin ties); larger eps
+trades quality for speed with the classic (1+eps) distance guarantee.
+Kappa coherence composes on top through the same CoherenceWrapper the
+brute matcher uses.  If g++ or OpenMP is unavailable the matcher falls
+back to the exact XLA path with a logged warning, keeping configs
+portable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..config import SynthConfig
+from .matcher import Matcher, flat_to_nnf, register_matcher
+from .brute import exact_nn
+from .coherence import CoherenceWrapper
+
+log = logging.getLogger(__name__)
+
+
+# Host-side tree cache: f_a is constant for a whole pyramid level but the
+# jitted EM step calls the matcher em_iters times, so without a cache the
+# O(N log N) build (and nothing else) would re-run per iteration.  Keyed
+# on a full-content hash — hashing is ~10x cheaper than building and a
+# false hit would silently corrupt matches, so no fingerprint shortcuts.
+_TREE_CACHE: "dict" = {}
+_TREE_CACHE_CAP = 4
+_tree_lock = __import__("threading").Lock()
+
+
+def _tree_for(f_a: np.ndarray):
+    from ..utils.native import load_ann
+
+    lib = load_ann()
+    key = (f_a.shape, hash(f_a.tobytes()))
+    with _tree_lock:
+        if key in _TREE_CACHE:
+            return _TREE_CACHE[key][1]
+        while len(_TREE_CACHE) >= _TREE_CACHE_CAP:
+            _, (keep, old) = _TREE_CACHE.popitem()
+            lib.ann_free(old)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        tree = lib.ann_build(
+            f_a.ctypes.data_as(f32p), f_a.shape[0], f_a.shape[1]
+        )
+        # The C++ Tree owns a copy of the data; f_a is retained only so
+        # the hash key can be re-derived for debugging.
+        _TREE_CACHE[key] = (f_a, tree)
+        return tree
+
+
+def _host_ann_query(f_b_flat: np.ndarray, f_a_flat: np.ndarray, eps: float):
+    """Query the (cached) tree on the host (numpy in/out)."""
+    from ..utils.native import load_ann
+
+    lib = load_ann()
+    f_a = np.ascontiguousarray(f_a_flat, np.float32)
+    f_b = np.ascontiguousarray(f_b_flat, np.float32)
+    n_q = f_b.shape[0]
+    idx = np.empty(n_q, np.int32)
+    dist = np.empty(n_q, np.float32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    tree = _tree_for(f_a)
+    lib.ann_query(
+        tree,
+        f_b.ctypes.data_as(f32p),
+        n_q,
+        ctypes.c_float(eps),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        dist.ctypes.data_as(f32p),
+    )
+    return idx, dist
+
+
+class AnnMatcher(Matcher):
+    """C++ kd-tree NN via pure_callback; exact-XLA fallback if unbuilt."""
+
+    name = "ann"
+
+    def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig,
+              raw=None):
+        from ..utils.native import ann_available
+
+        h, w, d = f_b.shape
+        ha, wa = f_a.shape[:2]
+        f_b_flat = f_b.reshape(-1, d).astype(jnp.float32)
+        f_a_flat = f_a.reshape(-1, d).astype(jnp.float32)
+        if not ann_available():
+            log.warning(
+                "native ANN library unavailable; ann matcher falling back "
+                "to exact XLA search"
+            )
+            idx, dist = exact_nn(
+                f_b_flat, f_a_flat, chunk=min(cfg.brute_chunk, h * w)
+            )
+        else:
+            eps = float(cfg.ann_eps)
+
+            def host(fb, fa):
+                return _host_ann_query(fb, fa, eps)
+
+            idx, dist = jax.pure_callback(
+                host,
+                (
+                    jax.ShapeDtypeStruct((h * w,), jnp.int32),
+                    jax.ShapeDtypeStruct((h * w,), jnp.float32),
+                ),
+                f_b_flat,
+                f_a_flat,
+                vmap_method="sequential",
+            )
+        return flat_to_nnf(idx, wa, (h, w)), dist.reshape(h, w)
+
+
+# Like 'brute': kappa coherence composes on top (reference matcher x
+# kappa flag matrix).
+register_matcher("ann", CoherenceWrapper(AnnMatcher()))

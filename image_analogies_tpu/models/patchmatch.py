@@ -55,7 +55,7 @@ class RawPlanes(NamedTuple):
     flt_b: jnp.ndarray
     src_b_coarse: Optional[jnp.ndarray]
     flt_b_coarse: Optional[jnp.ndarray]
-    a_planes: jnp.ndarray  # (C, Ha+2P, Wq, 128) bf16, prepare_a_planes
+    a_planes: jnp.ndarray  # (C, Ha+2P+pad, Wq, 128) f32, prepare_a_planes
 
 # Propagation neighborhood: left, right, up, down.
 _DELTAS = ((0, -1), (0, 1), (-1, 0), (1, 0))

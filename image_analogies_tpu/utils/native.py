@@ -1,0 +1,96 @@
+"""ctypes loader/builder for the native C++ helpers (SURVEY.md §2 C8).
+
+pybind11 is not available in this environment, so native code is plain
+C ABI compiled with g++ and loaded via ctypes.  The shared library is
+built on first use into native/build/ (next to the sources) and cached;
+build failure degrades gracefully — callers treat `load_ann() is None`
+as "native backend unavailable" and fall back to the XLA path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO_ROOT, "native", "ann.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LIB = os.path.join(_BUILD_DIR, "libia_ann.so")
+
+_lock = threading.Lock()
+_cached: Optional[ctypes.CDLL] = None
+_failed = False
+
+
+def _compile() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    # Compile to a process-private path and rename into place so a
+    # concurrent process never dlopens a half-written .so.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-fopenmp",
+        "-o", tmp, _SRC,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, text=True, timeout=120
+        )
+        os.replace(tmp, _LIB)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        log.warning("native ANN build failed: %s", detail.strip()[:500])
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        return False
+
+
+def load_ann() -> Optional[ctypes.CDLL]:
+    """The ANN library with argtypes configured, or None if unbuildable.
+
+    Builds (once per process) when the cached .so is missing or older
+    than the source.
+    """
+    global _cached, _failed
+    with _lock:
+        if _cached is not None:
+            return _cached
+        if _failed:
+            return None
+        stale = not os.path.exists(_LIB) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        )
+        if stale and not _compile():
+            _failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            log.warning("native ANN load failed: %s", e)
+            _failed = True
+            return None
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.ann_build.argtypes = [f32p, ctypes.c_int, ctypes.c_int]
+        lib.ann_build.restype = ctypes.c_void_p
+        lib.ann_query.argtypes = [
+            ctypes.c_void_p, f32p, ctypes.c_int, ctypes.c_float, i32p, f32p,
+        ]
+        lib.ann_query.restype = None
+        lib.ann_free.argtypes = [ctypes.c_void_p]
+        lib.ann_free.restype = None
+        _cached = lib
+        return lib
+
+
+def ann_available() -> bool:
+    return load_ann() is not None

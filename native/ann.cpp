@@ -1,0 +1,171 @@
+// Native ANN (kd-tree) nearest-neighbor library (SURVEY.md §2 C8).
+//
+// The reference accelerates its best-match search with a host-side C++
+// ANN library (FLANN / `ann` / cKDTree family) [SURVEY.md C8,
+// RECONSTRUCTED].  On TPU the idiomatic ANN is the Pallas PatchMatch
+// kernel (C9) — pointer-chasing trees don't map to the MXU/VPU — but the
+// CPU backend keeps a native equivalent for capability parity: this
+// kd-tree with FLANN-style epsilon-approximate pruning, OpenMP-parallel
+// over queries, exposed through a minimal C ABI consumed via ctypes
+// (no pybind11 in this environment).
+//
+// Semantics:
+//   - exact nearest neighbor at eps = 0 (hyperplane-bound pruning is
+//     conservative), matching models/brute.exact_nn up to argmin ties;
+//   - at eps > 0, the returned neighbor's squared distance is at most
+//     (1+eps)^2 times the true minimum (the classic ANN guarantee);
+//   - returned distances are exact squared L2 for the returned index, so
+//     downstream kappa accept tests see the same metric as candidate_dist.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <numeric>
+#include <vector>
+
+namespace {
+
+constexpr int kLeafSize = 16;
+
+struct Node {
+  // Internal: dim >= 0, children via left/right.  Leaf: dim == -1,
+  // [start, end) indexes into `order`.
+  int dim;
+  float val;
+  int left;
+  int right;
+  int start;
+  int end;
+};
+
+struct Tree {
+  int n;
+  int d;
+  std::vector<float> data;   // row-major (n, d), reordered copy not kept:
+  std::vector<int> order;    // leaf ranges index this permutation
+  std::vector<Node> nodes;
+};
+
+float sq(float x) { return x * x; }
+
+int build_rec(Tree& t, int start, int end, std::vector<float>& mins,
+              std::vector<float>& maxs) {
+  Node node;
+  node.start = start;
+  node.end = end;
+  if (end - start <= kLeafSize) {
+    node.dim = -1;
+    node.val = 0.f;
+    node.left = node.right = -1;
+    t.nodes.push_back(node);
+    return static_cast<int>(t.nodes.size()) - 1;
+  }
+  // Split the widest dimension at the median point.
+  const int d = t.d;
+  std::fill(mins.begin(), mins.end(), std::numeric_limits<float>::max());
+  std::fill(maxs.begin(), maxs.end(), std::numeric_limits<float>::lowest());
+  for (int i = start; i < end; ++i) {
+    const float* row = &t.data[static_cast<size_t>(t.order[i]) * d];
+    for (int k = 0; k < d; ++k) {
+      mins[k] = std::min(mins[k], row[k]);
+      maxs[k] = std::max(maxs[k], row[k]);
+    }
+  }
+  int dim = 0;
+  float spread = -1.f;
+  for (int k = 0; k < d; ++k) {
+    if (maxs[k] - mins[k] > spread) {
+      spread = maxs[k] - mins[k];
+      dim = k;
+    }
+  }
+  if (spread <= 0.f) {  // all points identical: make a leaf
+    node.dim = -1;
+    node.val = 0.f;
+    node.left = node.right = -1;
+    t.nodes.push_back(node);
+    return static_cast<int>(t.nodes.size()) - 1;
+  }
+  int mid = (start + end) / 2;
+  std::nth_element(
+      t.order.begin() + start, t.order.begin() + mid, t.order.begin() + end,
+      [&](int a, int b) {
+        return t.data[static_cast<size_t>(a) * d + dim] <
+               t.data[static_cast<size_t>(b) * d + dim];
+      });
+  node.dim = dim;
+  node.val = t.data[static_cast<size_t>(t.order[mid]) * d + dim];
+  int self = static_cast<int>(t.nodes.size());
+  t.nodes.push_back(node);
+  int left = build_rec(t, start, mid, mins, maxs);
+  int right = build_rec(t, mid, end, mins, maxs);
+  t.nodes[self].left = left;
+  t.nodes[self].right = right;
+  return self;
+}
+
+void search(const Tree& t, int ni, const float* q, float prune_mult,
+            float& best_d, int& best_i) {
+  const Node& n = t.nodes[ni];
+  if (n.dim < 0) {
+    const int d = t.d;
+    for (int i = n.start; i < n.end; ++i) {
+      const int idx = t.order[i];
+      const float* row = &t.data[static_cast<size_t>(idx) * d];
+      float dist = 0.f;
+      for (int k = 0; k < d; ++k) dist += sq(q[k] - row[k]);
+      // Lowest-index tie break, matching jnp.argmin in the XLA oracle.
+      if (dist < best_d || (dist == best_d && idx < best_i)) {
+        best_d = dist;
+        best_i = idx;
+      }
+    }
+    return;
+  }
+  const float diff = q[n.dim] - n.val;
+  const int near = diff <= 0.f ? n.left : n.right;
+  const int far = diff <= 0.f ? n.right : n.left;
+  search(t, near, q, prune_mult, best_d, best_i);
+  // Approximate pruning: visit the far side only if the splitting
+  // hyperplane is closer than best/(1+eps)^2.
+  if (sq(diff) * prune_mult < best_d) {
+    search(t, far, q, prune_mult, best_d, best_i);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ann_build(const float* data, int n, int d) {
+  Tree* t = new Tree;
+  t->n = n;
+  t->d = d;
+  t->data.assign(data, data + static_cast<size_t>(n) * d);
+  t->order.resize(n);
+  std::iota(t->order.begin(), t->order.end(), 0);
+  t->nodes.reserve(2 * n / kLeafSize + 4);
+  std::vector<float> mins(d), maxs(d);
+  build_rec(*t, 0, n, mins, maxs);
+  return t;
+}
+
+void ann_query(const void* tree, const float* queries, int nq, float eps,
+               int32_t* out_idx, float* out_dist) {
+  const Tree& t = *static_cast<const Tree*>(tree);
+  const float prune_mult = sq(1.f + eps);
+#pragma omp parallel for schedule(static)
+  for (int i = 0; i < nq; ++i) {
+    const float* q = queries + static_cast<size_t>(i) * t.d;
+    float best_d = std::numeric_limits<float>::max();
+    int best_i = 0;
+    search(t, 0, q, prune_mult, best_d, best_i);
+    out_idx[i] = best_i;
+    out_dist[i] = best_d;
+  }
+}
+
+void ann_free(void* tree) { delete static_cast<Tree*>(tree); }
+
+}  // extern "C"
