@@ -52,7 +52,16 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
         "elsewhere) | off (pure XLA) | interpret (debug)",
     )
     p.add_argument("--save-level-artifacts", default=None)
+    p.add_argument(
+        "--resume-from", default=None, metavar="DIR",
+        help="resume mid-pyramid from a --save-level-artifacts directory",
+    )
     p.add_argument("--progress", default=None, help="JSONL progress path")
+    p.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="write a jax.profiler (Perfetto/XProf) trace of the "
+        "synthesis to DIR (SURVEY.md §5 tracing)",
+    )
 
 
 def _config_from(args) -> "SynthConfig":
@@ -99,18 +108,30 @@ def cmd_synth(args) -> int:
     ap = load_image(args.ap)
     b = load_image(args.b)
     cfg = _config_from(args)
+    if args.spatial and args.resume_from:
+        raise SystemExit(
+            "--resume-from is not supported with --spatial (the spatial "
+            "runner keeps no per-level resume contract yet); re-run "
+            "without --spatial or without --resume-from"
+        )
     progress.emit("start", shape=list(b.shape), matcher=cfg.matcher)
     t0 = time.perf_counter()
-    if args.spatial:
-        from .parallel.mesh import make_mesh
-        from .parallel.spatial import synthesize_spatial
+    from .utils.profiling import device_trace
 
-        bp = synthesize_spatial(
-            a, ap, b, cfg, make_mesh(args.n_devices), progress=progress
-        )
-    else:
-        bp = create_image_analogy(a, ap, b, cfg)
-    bp.block_until_ready()
+    with device_trace(args.profile):
+        if args.spatial:
+            from .parallel.mesh import make_mesh
+            from .parallel.spatial import synthesize_spatial
+
+            bp = synthesize_spatial(
+                a, ap, b, cfg, make_mesh(args.n_devices), progress=progress
+            )
+        else:
+            bp = create_image_analogy(
+                a, ap, b, cfg, progress=progress,
+                resume_from=args.resume_from,
+            )
+        bp.block_until_ready()
     progress.emit("done", wall_s=round(time.perf_counter() - t0, 3))
     save_image(args.out, bp)
     print(f"wrote {args.out} ({time.perf_counter() - t0:.2f}s)")
@@ -126,6 +147,11 @@ def cmd_batch(args) -> int:
     from .utils.io import load_image, save_image
     from .utils.progress import ProgressWriter
 
+    if args.resume_from:
+        raise SystemExit(
+            "--resume-from is not supported by the batch runner; use "
+            "--save-level-artifacts + per-frame synth runs to resume"
+        )
     progress = ProgressWriter(args.progress)
     a = load_image(args.a)
     ap = load_image(args.ap)
@@ -137,7 +163,12 @@ def cmd_batch(args) -> int:
     cfg = _config_from(args)
     mesh = make_mesh(args.n_devices)
     t0 = time.perf_counter()
-    bps = np.asarray(synthesize_batch(a, ap, frames, cfg, mesh, progress=progress))
+    from .utils.profiling import device_trace
+
+    with device_trace(args.profile):
+        bps = np.asarray(
+            synthesize_batch(a, ap, frames, cfg, mesh, progress=progress)
+        )
     os.makedirs(args.out, exist_ok=True)
     for name, bp in zip(names, bps):
         save_image(os.path.join(args.out, name), bp)

@@ -54,8 +54,11 @@ class SynthConfig:
     # D/pca_dims at the cost of approximate distances.
     pca_dims: Optional[int] = None
 
-    # Matching precision on device ('float32' is the oracle-faithful default;
-    # 'bfloat16' halves HBM traffic for the distance evaluations).
+    # Matching precision on device.  'float32' is the oracle-faithful
+    # default; 'bfloat16' halves the distance-matmul HBM traffic and
+    # returns identical argmins on the acceptance configs (verified on
+    # v5e-1), but measured slower end-to-end there — the exact-f32
+    # winner-distance recompute dominates — so it stays opt-in.
     match_dtype: str = "float32"
 
     # Pallas kernel selection: 'auto' compiles the kernels when an

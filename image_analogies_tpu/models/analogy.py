@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -186,12 +187,22 @@ def create_image_analogy(
     b,
     cfg: Optional[SynthConfig] = None,
     return_aux: bool = False,
+    progress=None,
+    resume_from: Optional[str] = None,
 ):
     """Synthesize B' such that A : A' :: B : B'.
 
     `a`, `ap`, `b`: float arrays in [0,1], (H,W,3) RGB or (H,W) gray; `a`
     and `ap` must share a shape.  Returns B' shaped like `b` (or a dict of
-    auxiliary per-level artifacts when `return_aux`).
+    auxiliary per-level artifacts when `return_aux`).  `progress` is an
+    optional utils.progress.ProgressWriter: one timed `level_done` event
+    per pyramid level (SURVEY.md §5 metrics/observability).
+
+    `resume_from`: directory of per-level artifacts written by a prior
+    run with `cfg.save_level_artifacts` (SURVEY.md §5 checkpoint/resume).
+    Synthesis restarts from the finest completed level's (nnf, B') state;
+    with the same cfg/seed the result is identical to an uninterrupted
+    run (per-level keys derive from the level index, not the path here).
     """
     cfg = cfg or SynthConfig()
     a = jnp.asarray(a, jnp.float32)
@@ -218,7 +229,26 @@ def create_image_analogy(
     flt_bp_coarse = None
     nnf = None
 
-    for level in range(levels - 1, -1, -1):
+    start_level = levels - 1
+    if resume_from:
+        loaded = _load_resume_state(resume_from, levels)
+        if loaded is not None:
+            resumed_level, nnf, dist, bp, aux_fill = loaded
+            flt_bp = bp
+            for lvl, (n, d) in aux_fill.items():
+                aux["nnf"][lvl] = n
+                aux["dist"][lvl] = d
+            if progress is not None:
+                progress.emit("resume", from_level=resumed_level)
+            if resumed_level == 0:
+                out = _finalize(bp, yiq_b, b, cfg)
+                if return_aux:
+                    return {"bp": out, "nnf": aux["nnf"], "dist": aux["dist"]}
+                return out
+            start_level = resumed_level - 1
+
+    for level in range(start_level, -1, -1):
+        level_t0 = time.perf_counter()
         f_a_src = pyr_src_a[level]
         h, w = pyr_src_b[level].shape[:2]
         ha, wa = f_a_src.shape[:2]
@@ -269,6 +299,18 @@ def create_image_analogy(
 
         aux["nnf"][level] = nnf
         aux["dist"][level] = dist
+        if progress is not None:
+            # One device sync per level — the only host sync in the loop
+            # (north-star: minimize host round trips), and it gives the
+            # wall clock + NN-field energy honest values.
+            jax.block_until_ready(dist)
+            progress.emit(
+                "level_done",
+                level=level,
+                shape=[int(h), int(w)],
+                wall_ms=round((time.perf_counter() - level_t0) * 1000, 3),
+                nnf_energy=float(dist.mean()),
+            )
         if cfg.save_level_artifacts:
             _save_level(cfg.save_level_artifacts, level, nnf, dist, bp)
 
@@ -289,11 +331,52 @@ def _finalize(bp, yiq_b, b, cfg: SynthConfig):
 
 
 def _save_level(path: str, level: int, nnf, dist, bp) -> None:
-    """Per-level checkpoint artifacts (SURVEY.md §5 checkpoint/resume)."""
+    """Per-level checkpoint artifacts (SURVEY.md §5 checkpoint/resume).
+
+    Written to a temp file and renamed so a kill mid-write never leaves a
+    truncated .npz where resume would trip over it."""
     os.makedirs(path, exist_ok=True)
-    np.savez(
-        os.path.join(path, f"level_{level}.npz"),
-        nnf=np.asarray(nnf),
-        dist=np.asarray(dist),
-        bp=np.asarray(bp),
-    )
+    final = os.path.join(path, f"level_{level}.npz")
+    tmp = f"{final}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(
+            f,
+            nnf=np.asarray(nnf),
+            dist=np.asarray(dist),
+            bp=np.asarray(bp),
+        )
+    os.replace(tmp, final)
+
+
+def _load_resume_state(path: str, levels: int):
+    """Resume state from a checkpoint dir: (finest_loadable_level, nnf,
+    dist, bp, {level: (nnf, dist)} for every loadable level), or None
+    when nothing usable exists.  Corrupt/truncated artifacts (crash
+    mid-write by a pre-atomic-rename writer, partial copies) are skipped
+    with a fallback to the next-coarser intact level — resume must
+    survive exactly the crashes it exists for."""
+    import re
+    import zipfile
+
+    loadable = {}
+    if os.path.isdir(path):
+        for name in os.listdir(path):
+            m = re.fullmatch(r"level_(\d+)\.npz", name)
+            if not m or int(m.group(1)) >= levels:
+                continue
+            lvl = int(m.group(1))
+            try:
+                data = np.load(os.path.join(path, name))
+                loadable[lvl] = (
+                    jnp.asarray(data["nnf"]),
+                    jnp.asarray(data["dist"]),
+                    jnp.asarray(data["bp"]),
+                )
+            except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+                continue
+    if not loadable:
+        return None
+    best = min(loadable)
+    nnf, dist, bp = loadable[best]
+    aux_fill = {lvl: (n, d) for lvl, (n, d, _) in loadable.items()}
+    return best, nnf, dist, bp, aux_fill

@@ -1,0 +1,27 @@
+"""Tracing/profiling harness (SURVEY.md §5 tracing/profiling).
+
+`device_trace(dir)` wraps a region in `jax.profiler.trace`, producing
+Perfetto/XProf traces (TensorBoard-loadable) of every XLA executable and
+Pallas kernel launch in the region — the TPU-native replacement for the
+host profilers a CPU reference would use.  Wall-clock per-level timings
+come from the drivers themselves (models/analogy.py emits `level_done`
+events with a single block_until_ready sync per level), not from this
+module.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """jax.profiler.trace when a directory is given; no-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

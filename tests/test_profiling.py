@@ -1,0 +1,46 @@
+"""Profiling/observability harness tests (SURVEY.md §5)."""
+
+import json
+import os
+
+import numpy as np
+
+from image_analogies_tpu import SynthConfig, create_image_analogy
+from image_analogies_tpu.utils.profiling import device_trace
+from image_analogies_tpu.utils.progress import ProgressWriter
+
+
+def test_per_level_progress_events(tmp_path, rng):
+    path = str(tmp_path / "prog.jsonl")
+    a = rng.random((32, 32)).astype(np.float32)
+    ap = rng.random((32, 32)).astype(np.float32)
+    b = rng.random((32, 32)).astype(np.float32)
+    cfg = SynthConfig(levels=2, matcher="brute", em_iters=1)
+    create_image_analogy(a, ap, b, cfg, progress=ProgressWriter(path))
+    events = [json.loads(line) for line in open(path)]
+    level_events = [e for e in events if e["event"] == "level_done"]
+    assert [e["level"] for e in level_events] == [1, 0]
+    for e in level_events:
+        assert e["wall_ms"] > 0.0
+        assert e["nnf_energy"] >= 0.0
+    # Coarse-to-fine: finer level's shape doubles the coarser's.
+    assert level_events[1]["shape"] == [32, 32]
+    assert level_events[0]["shape"] == [16, 16]
+
+
+def test_device_trace_writes_trace_dir(tmp_path):
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "trace")
+    with device_trace(d):
+        (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+    # jax.profiler.trace lays out plugins/profile/<run>/... under d.
+    found = []
+    for root, _, files in os.walk(d):
+        found += files
+    assert found, "no trace files written"
+
+
+def test_device_trace_noop_without_dir():
+    with device_trace(None):
+        pass

@@ -1,0 +1,84 @@
+"""Checkpoint/resume tests (SURVEY.md §5): a run resumed from per-level
+artifacts must reproduce the uninterrupted run exactly (per-level PRNG
+keys derive from the level index, so the continuation is path-independent).
+"""
+
+import os
+
+import numpy as np
+
+from image_analogies_tpu import SynthConfig, create_image_analogy
+
+
+def _inputs(rng, n=32):
+    a = rng.random((n, n)).astype(np.float32)
+    ap = np.clip(a * 0.5 + 0.2, 0, 1).astype(np.float32)
+    b = rng.random((n, n)).astype(np.float32)
+    return a, ap, b
+
+
+def test_resume_reproduces_full_run(tmp_path, rng):
+    a, ap, b = _inputs(rng)
+    ckpt = str(tmp_path / "ckpt")
+    cfg = SynthConfig(
+        levels=3, matcher="patchmatch", em_iters=2, pm_iters=3,
+        save_level_artifacts=ckpt,
+    )
+    bp_full = np.asarray(create_image_analogy(a, ap, b, cfg))
+
+    # Simulate a crash after level 1: drop the finest level's artifact.
+    os.unlink(os.path.join(ckpt, "level_0.npz"))
+    cfg2 = SynthConfig(levels=3, matcher="patchmatch", em_iters=2, pm_iters=3)
+    bp_resumed = np.asarray(
+        create_image_analogy(a, ap, b, cfg2, resume_from=ckpt)
+    )
+    np.testing.assert_array_equal(bp_resumed, bp_full)
+
+
+def test_resume_with_all_levels_done_returns_final(tmp_path, rng):
+    a, ap, b = _inputs(rng)
+    ckpt = str(tmp_path / "ckpt")
+    cfg = SynthConfig(
+        levels=2, matcher="brute", em_iters=1, save_level_artifacts=ckpt,
+    )
+    bp_full = np.asarray(create_image_analogy(a, ap, b, cfg))
+    bp_resumed = np.asarray(
+        create_image_analogy(
+            a, ap, b, SynthConfig(levels=2, matcher="brute", em_iters=1),
+            resume_from=ckpt,
+        )
+    )
+    np.testing.assert_array_equal(bp_resumed, bp_full)
+
+
+def test_resume_skips_corrupt_artifact(tmp_path, rng):
+    """A truncated finest-level artifact (crash mid-write by a
+    non-atomic writer) must fall back to the next intact level, not
+    abort — resume exists for exactly this crash."""
+    a, ap, b = _inputs(rng)
+    ckpt = str(tmp_path / "ckpt")
+    cfg = SynthConfig(
+        levels=3, matcher="brute", em_iters=1, save_level_artifacts=ckpt,
+    )
+    bp_full = np.asarray(create_image_analogy(a, ap, b, cfg))
+    # Corrupt level_0 (truncate), keep level_1/level_2 intact.
+    with open(os.path.join(ckpt, "level_0.npz"), "wb") as f:
+        f.write(b"PK\x03\x04 truncated")
+    bp_resumed = np.asarray(
+        create_image_analogy(
+            a, ap, b, SynthConfig(levels=3, matcher="brute", em_iters=1),
+            resume_from=ckpt,
+        )
+    )
+    np.testing.assert_array_equal(bp_resumed, bp_full)
+
+
+def test_resume_from_empty_dir_is_fresh_run(tmp_path, rng):
+    a, ap, b = _inputs(rng)
+    cfg = SynthConfig(levels=2, matcher="brute", em_iters=1)
+    bp_fresh = np.asarray(create_image_analogy(a, ap, b, cfg))
+    empty = str(tmp_path / "nothing")
+    bp_resumed = np.asarray(
+        create_image_analogy(a, ap, b, cfg, resume_from=empty)
+    )
+    np.testing.assert_array_equal(bp_resumed, bp_fresh)
