@@ -118,17 +118,21 @@ def cmd_synth(args) -> int:
     t0 = time.perf_counter()
     from .utils.profiling import device_trace
 
+    # Per-level progress costs one host sync per level; only pay it when
+    # the user asked for a progress file (north-star: minimal host syncs).
+    level_progress = progress if args.progress else None
     with device_trace(args.profile):
         if args.spatial:
             from .parallel.mesh import make_mesh
             from .parallel.spatial import synthesize_spatial
 
             bp = synthesize_spatial(
-                a, ap, b, cfg, make_mesh(args.n_devices), progress=progress
+                a, ap, b, cfg, make_mesh(args.n_devices),
+                progress=level_progress,
             )
         else:
             bp = create_image_analogy(
-                a, ap, b, cfg, progress=progress,
+                a, ap, b, cfg, progress=level_progress,
                 resume_from=args.resume_from,
             )
         bp.block_until_ready()
@@ -167,7 +171,10 @@ def cmd_batch(args) -> int:
 
     with device_trace(args.profile):
         bps = np.asarray(
-            synthesize_batch(a, ap, frames, cfg, mesh, progress=progress)
+            synthesize_batch(
+                a, ap, frames, cfg, mesh,
+                progress=progress if args.progress else None,
+            )
         )
     os.makedirs(args.out, exist_ok=True)
     for name, bp in zip(names, bps):
@@ -190,6 +197,7 @@ def cmd_examples(args) -> int:
         "texture_by_numbers": ex.texture_by_numbers(args.size),
         "artistic_filter": ex.artistic_filter(args.size),
         "super_resolution": ex.super_resolution(args.size),
+        "texture_transfer": ex.texture_transfer(args.size),
     }
     for name, (a, ap, b) in sets.items():
         for tag, img in [("A", a), ("Ap", ap), ("B", b)]:

@@ -193,12 +193,13 @@ def channel_images(
     return chans
 
 
+@functools.partial(jax.jit, static_argnames=("specs",))
 def prepare_a_planes(
     src: jnp.ndarray,
     flt: jnp.ndarray,
     src_coarse: Optional[jnp.ndarray],
     flt_coarse: Optional[jnp.ndarray],
-    specs: Sequence[ChannelSpec],
+    specs: Tuple[ChannelSpec, ...],
 ) -> jnp.ndarray:
     """A-side planes packed for the kernel: (C, Ha+2P+pad, Wq, 128) f32.
 

@@ -231,7 +231,9 @@ def create_image_analogy(
 
     start_level = levels - 1
     if resume_from:
-        loaded = _load_resume_state(resume_from, levels)
+        loaded = _load_resume_state(
+            resume_from, levels, _ckpt_fingerprint(cfg, b.shape)
+        )
         if loaded is not None:
             resumed_level, nnf, dist, bp, aux_fill = loaded
             flt_bp = bp
@@ -312,7 +314,9 @@ def create_image_analogy(
                 nnf_energy=float(dist.mean()),
             )
         if cfg.save_level_artifacts:
-            _save_level(cfg.save_level_artifacts, level, nnf, dist, bp)
+            _save_level(
+                cfg.save_level_artifacts, level, nnf, dist, bp, cfg, b.shape
+            )
 
     out = _finalize(bp, yiq_b, b, cfg)
     if return_aux:
@@ -330,11 +334,22 @@ def _finalize(bp, yiq_b, b, cfg: SynthConfig):
     return jnp.clip(out, 0.0, 1.0)
 
 
-def _save_level(path: str, level: int, nnf, dist, bp) -> None:
+def _ckpt_fingerprint(cfg: SynthConfig, b_shape) -> str:
+    """Identity of a checkpointed run: all result-shaping knobs plus the
+    target shape.  `save_level_artifacts` is excluded — the save-run sets
+    it, the resume-run usually doesn't, and it cannot change results."""
+    import dataclasses
+
+    cfg_id = dataclasses.replace(cfg, save_level_artifacts=None)
+    return f"{tuple(b_shape)}|{cfg_id!r}"
+
+
+def _save_level(path: str, level: int, nnf, dist, bp, cfg, b_shape) -> None:
     """Per-level checkpoint artifacts (SURVEY.md §5 checkpoint/resume).
 
     Written to a temp file and renamed so a kill mid-write never leaves a
-    truncated .npz where resume would trip over it."""
+    truncated .npz where resume would trip over it; stamped with the run
+    fingerprint so resume can reject stale/mismatched checkpoints."""
     os.makedirs(path, exist_ok=True)
     final = os.path.join(path, f"level_{level}.npz")
     tmp = f"{final}.{os.getpid()}.tmp"
@@ -344,20 +359,27 @@ def _save_level(path: str, level: int, nnf, dist, bp) -> None:
             nnf=np.asarray(nnf),
             dist=np.asarray(dist),
             bp=np.asarray(bp),
+            fingerprint=np.asarray(_ckpt_fingerprint(cfg, b_shape)),
         )
     os.replace(tmp, final)
 
 
-def _load_resume_state(path: str, levels: int):
+def _load_resume_state(path: str, levels: int, fingerprint: str):
     """Resume state from a checkpoint dir: (finest_loadable_level, nnf,
     dist, bp, {level: (nnf, dist)} for every loadable level), or None
-    when nothing usable exists.  Corrupt/truncated artifacts (crash
-    mid-write by a pre-atomic-rename writer, partial copies) are skipped
-    with a fallback to the next-coarser intact level — resume must
-    survive exactly the crashes it exists for."""
+    when nothing usable exists.
+
+    Artifacts are skipped (with a logged warning, falling back to the
+    next-coarser intact level) when they are corrupt/truncated — resume
+    must survive exactly the crashes it exists for — or when their
+    fingerprint does not match the current run (different input shape,
+    seed, matcher, or any other result-shaping knob): silently resuming
+    a stale checkpoint would produce a wrong image with exit code 0."""
+    import logging
     import re
     import zipfile
 
+    log = logging.getLogger("image_analogies_tpu")
     loadable = {}
     if os.path.isdir(path):
         for name in os.listdir(path):
@@ -367,12 +389,20 @@ def _load_resume_state(path: str, levels: int):
             lvl = int(m.group(1))
             try:
                 data = np.load(os.path.join(path, name))
+                saved_fp = str(data["fingerprint"])
+                if saved_fp != fingerprint:
+                    log.warning(
+                        "resume: skipping %s (checkpoint from a different "
+                        "run: %s != %s)", name, saved_fp, fingerprint,
+                    )
+                    continue
                 loadable[lvl] = (
                     jnp.asarray(data["nnf"]),
                     jnp.asarray(data["dist"]),
                     jnp.asarray(data["bp"]),
                 )
             except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+                log.warning("resume: skipping unreadable artifact %s", name)
                 continue
     if not loadable:
         return None

@@ -238,7 +238,9 @@ def synthesize_spatial(
                 nnf_energy=float(dist.mean()), spatial_slabs=n_slabs,
             )
         if cfg.save_level_artifacts:
-            _save_level(cfg.save_level_artifacts, level, nnf, dist, bp)
+            _save_level(
+                cfg.save_level_artifacts, level, nnf, dist, bp, cfg, b.shape
+            )
 
     out = _finalize(bp, yiq_b, b, cfg)
     return out[:h0]

@@ -149,6 +149,19 @@ def super_resolution(
     return _box_blur(ap, 2), ap, _box_blur(sharp_b, 2)
 
 
+def texture_transfer(
+    size: int = 256, seed: int = 4
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(A, A', B): texture transfer (Hertzmann §4.4) — A and A' are both
+    the *texture* (identity filter), B is an arbitrary target image;
+    synthesized B' re-renders B out of the texture's material.  Run with
+    kappa > 0 so coherent texture patches survive the luminance match."""
+    rng = _rng(seed)
+    tex = _texture_for_label(rng, 1, size, size)
+    b = _photo_like(rng, size, size)
+    return tex, tex.copy(), b
+
+
 def npr_frames(
     n_frames: int = 8, size: int = 1024, seed: int = 3
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

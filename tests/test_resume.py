@@ -73,6 +73,30 @@ def test_resume_skips_corrupt_artifact(tmp_path, rng):
     np.testing.assert_array_equal(bp_resumed, bp_full)
 
 
+def test_resume_rejects_mismatched_checkpoint(tmp_path, rng):
+    """A checkpoint from a different run (other shape or config) must be
+    ignored — silently resuming it would produce a wrong image."""
+    a, ap, b = _inputs(rng)
+    ckpt = str(tmp_path / "ckpt")
+    create_image_analogy(
+        a, ap, b,
+        SynthConfig(levels=2, matcher="brute", em_iters=1,
+                    save_level_artifacts=ckpt),
+    )
+    # Different seed => different run identity => fresh synthesis.
+    cfg2 = SynthConfig(levels=2, matcher="patchmatch", em_iters=1, seed=9)
+    bp_fresh = np.asarray(create_image_analogy(a, ap, b, cfg2))
+    bp_resumed = np.asarray(
+        create_image_analogy(a, ap, b, cfg2, resume_from=ckpt)
+    )
+    np.testing.assert_array_equal(bp_resumed, bp_fresh)
+    # Different B shape: also ignored (no crash, no wrong-shape output).
+    a2, ap2, b2 = _inputs(rng, n=16)
+    cfg3 = SynthConfig(levels=2, matcher="brute", em_iters=1)
+    bp2 = np.asarray(create_image_analogy(a2, ap2, b2, cfg3, resume_from=ckpt))
+    assert bp2.shape == b2.shape
+
+
 def test_resume_from_empty_dir_is_fresh_run(tmp_path, rng):
     a, ap, b = _inputs(rng)
     cfg = SynthConfig(levels=2, matcher="brute", em_iters=1)

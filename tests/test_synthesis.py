@@ -62,6 +62,40 @@ class TestEndToEnd:
         assert bp.shape == b.shape
         assert np.isfinite(bp).all()
 
+    def test_texture_transfer(self):
+        """Hertzmann §4.4 texture transfer: A == A' (identity filter),
+        B arbitrary — B' must be built out of the texture's pixels (its
+        value distribution), not B's, while kappa keeps patches coherent."""
+        from image_analogies_tpu.utils.examples import texture_transfer
+
+        a, ap, b = texture_transfer(64)
+        # luminance_remap off: remapping would rescale the texture to B's
+        # stats, which is the right default for stylization but hides the
+        # "pixels come from the texture" property this test asserts.
+        bp = _run(
+            a, ap, b, levels=3, matcher="patchmatch", kappa=5.0,
+            em_iters=2, pm_iters=8, luminance_remap=False,
+        )
+        assert bp.shape == b.shape
+        # Gather semantics: every B' *luminance* value is literally a
+        # texture pixel (Y(B') = A'[s(q)]; chroma recombines from B per
+        # Hertzmann §3.4), while B' still tracks B's structure.
+        from image_analogies_tpu.ops.color import rgb_to_yiq
+
+        y_bp = np.asarray(rgb_to_yiq(bp)[..., 0]).ravel()
+        y_ap = ap if ap.ndim == 2 else np.asarray(rgb_to_yiq(ap)[..., 0])
+        tex_vals = np.sort(np.unique(y_ap.ravel()))
+        pos = np.searchsorted(tex_vals, y_bp).clip(1, len(tex_vals) - 1)
+        nearest = np.minimum(
+            np.abs(y_bp - tex_vals[pos - 1]), np.abs(y_bp - tex_vals[pos])
+        )
+        # A small fraction of pixels gamut-clip in the YIQ->RGB round
+        # trip (texture Y + B chroma can leave [0,1]); the rest must be
+        # exact texture values.
+        assert (nearest > 1e-4).mean() < 0.02
+        assert nearest.max() < 0.01
+        assert not np.allclose(bp, b, atol=1e-3)
+
     def test_luminance_mode_preserves_chroma(self):
         """Hertzmann §3.4: I/Q channels of B' come from B."""
         from image_analogies_tpu.ops.color import rgb_to_yiq
@@ -118,7 +152,7 @@ class TestEndToEnd:
         files = sorted(os.listdir(out))
         assert files == ["level_0.npz", "level_1.npz"]
         data = np.load(os.path.join(out, "level_0.npz"))
-        assert set(data.files) == {"nnf", "dist", "bp"}
+        assert set(data.files) == {"nnf", "dist", "bp", "fingerprint"}
 
     def test_aux_outputs(self):
         a, ap, b = artistic_filter(32)
