@@ -335,12 +335,22 @@ def _finalize(bp, yiq_b, b, cfg: SynthConfig):
 
 
 def _ckpt_fingerprint(cfg: SynthConfig, b_shape) -> str:
-    """Identity of a checkpointed run: all result-shaping knobs plus the
-    target shape.  `save_level_artifacts` is excluded — the save-run sets
-    it, the resume-run usually doesn't, and it cannot change results."""
+    """Identity of a checkpointed run: the result-shaping knobs plus the
+    target shape.  Excluded as non-result-shaping: `save_level_artifacts`
+    (the save-run sets it, the resume-run usually doesn't),
+    `pallas_mode`/`brute_chunk`/`match_dtype` (dispatch/precision/perf
+    knobs — the saved per-level (nnf, dist, bp) state is valid input for
+    any of them, so flipping one between save and resume must not force
+    a from-scratch recompute)."""
     import dataclasses
 
-    cfg_id = dataclasses.replace(cfg, save_level_artifacts=None)
+    cfg_id = dataclasses.replace(
+        cfg,
+        save_level_artifacts=None,
+        pallas_mode="auto",
+        brute_chunk=0,
+        match_dtype="float32",
+    )
     return f"{tuple(b_shape)}|{cfg_id!r}"
 
 
@@ -389,6 +399,13 @@ def _load_resume_state(path: str, levels: int, fingerprint: str):
             lvl = int(m.group(1))
             try:
                 data = np.load(os.path.join(path, name))
+                if "fingerprint" not in data.files:
+                    log.warning(
+                        "resume: skipping %s (no run fingerprint — written "
+                        "by an older version; re-save to make it resumable)",
+                        name,
+                    )
+                    continue
                 saved_fp = str(data["fingerprint"])
                 if saved_fp != fingerprint:
                     log.warning(

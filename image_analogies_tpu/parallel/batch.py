@@ -39,7 +39,9 @@ def _batch_step_fn(cfg: SynthConfig, level: int, has_coarse: bool, mesh_key):
     mesh = _MESHES[mesh_key]
     step = make_em_step(cfg, level, has_coarse)
     # Frame-carried args are vmapped; the A-side (f_a, copy_a), the PCA
-    # basis, and the (unused here) kernel planes are shared across frames.
+    # basis, and the kernel's A planes are shared across frames.  The
+    # Pallas tile kernel batches under vmap (the frame axis becomes a
+    # leading grid dimension), so the kernel path works per shard.
     in_axes = (0, 0, 0, 0, None, None, 0, 0, None, None)
     shard = batch_sharding(mesh)
     repl = replicated(mesh)
@@ -129,6 +131,12 @@ def synthesize_batch(
 
         f_a, proj = fit_and_project(f_a, cfg.pca_dims)
 
+        from ..models.analogy import _maybe_a_planes
+
+        a_planes = _maybe_a_planes(
+            cfg, pyr_src_a, pyr_flt_a, level, has_coarse, (h, w)
+        )
+
         level_key = jax.random.fold_in(key, level)
         if has_coarse:
             nnf = jax.vmap(lambda n: upsample_nnf(n, (h, w), ha, wa))(nnf)
@@ -156,7 +164,7 @@ def synthesize_batch(
                 nnf,
                 em_keys,
                 proj,
-                None,  # a_planes: the tile kernel is single-image for now
+                a_planes,
             )
             nnf, dist, bp = step(*args)
             flt_bp = bp
