@@ -248,8 +248,8 @@ def tile_patchmatch(
         f_a,
         nnf_m,
         jax.random.fold_in(key, cfg.pm_iters),
-        iters=1,
-        n_random=2,
+        iters=cfg.pm_polish_iters,
+        n_random=cfg.pm_polish_random,
         coh_factor=coh,
     )
 

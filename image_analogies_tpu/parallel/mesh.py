@@ -43,6 +43,77 @@ def make_mesh(
     return Mesh(arr, tuple(axis_names))
 
 
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join a multi-host JAX cluster (SURVEY.md §2 comms-backend row).
+
+    Thin wrapper over `jax.distributed.initialize`: after it,
+    `jax.devices()` spans every host's chips, so `make_mesh()` /
+    `make_hybrid_mesh()` and the existing pjit shardings scale to
+    multi-host unchanged — XLA routes collectives over ICI within a
+    slice and DCN across slices; there is no hand-written comms layer to
+    swap.  Arguments default to the standard cluster-environment
+    autodetection; when neither explicit arguments nor a recognizable
+    cluster environment is present (a single dev box), the autodetection
+    failure is treated as "not a cluster" and the call returns False
+    without clustering.  Returns True when initialization happened.
+    """
+    if num_processes is not None and num_processes <= 1:
+        return False
+    import jax.distributed
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except (RuntimeError, ValueError) as e:
+        if coordinator_address or num_processes or process_id:
+            raise  # explicit cluster spec that failed: a real error
+        import logging
+
+        logging.getLogger("image_analogies_tpu").info(
+            "no cluster environment detected (%s); running single-process",
+            str(e).splitlines()[0][:120],
+        )
+        return False
+
+
+def make_hybrid_mesh(
+    dcn_axis: str = BATCH_AXIS,
+    ici_axis: str = SPACE_AXIS,
+) -> Mesh:
+    """Mesh with the slower (cross-slice, DCN) axis outermost.
+
+    The standard layout recipe: put the embarrassingly-parallel axis
+    (frames) across slices where bandwidth is scarce, and the
+    communication-heavy axis (spatial halos) inside a slice where
+    collectives ride ICI.  Granularity is *slices*, not processes — a
+    multi-host single-slice pod (e.g. v5e-16 with 4 hosts) is all-ICI
+    and gets a flat mesh; only genuinely multi-slice topologies use the
+    hybrid DCNxICI builder.
+    """
+    devices = jax.devices()
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if n_slices > 1:
+        from jax.experimental import mesh_utils
+
+        per_slice = len(devices) // n_slices
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1, per_slice),
+            dcn_mesh_shape=(n_slices, 1),
+        )
+        return Mesh(arr, (dcn_axis, ici_axis))
+    return make_mesh(
+        axis_names=(dcn_axis, ici_axis), shape=(1, len(devices))
+    )
+
+
 def batch_sharding(mesh: Mesh, axis: str = BATCH_AXIS) -> NamedSharding:
     """Leading-axis sharding for per-frame arrays."""
     return NamedSharding(mesh, P(axis))

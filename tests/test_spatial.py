@@ -69,3 +69,29 @@ def test_spatial_single_device_mesh(rng):
     cfg = SynthConfig(levels=1, matcher="brute", em_iters=1)
     out = synthesize_spatial(a, ap, b, cfg, make_mesh(1))
     assert out.shape == b.shape
+
+
+def test_hybrid_mesh_single_process():
+    """make_hybrid_mesh degrades to a flat (1, n) two-axis mesh when only
+    one process is present; the axis layout (dcn outer, ici inner) is the
+    multi-host contract."""
+    from image_analogies_tpu.parallel.mesh import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh()
+    assert mesh.axis_names == ("batch", "space")
+    assert mesh.devices.shape == (1, 8)
+
+
+def test_initialize_multihost_noop_single_process():
+    from image_analogies_tpu.parallel.mesh import initialize_multihost
+
+    # num_processes <= 1: must not attempt cluster initialization.
+    initialize_multihost(num_processes=1)
+
+
+def test_initialize_multihost_default_args_no_cluster():
+    """With all-default args on a non-cluster box, autodetection failure
+    must be treated as 'not a cluster' (returns False), not an error."""
+    from image_analogies_tpu.parallel.mesh import initialize_multihost
+
+    assert initialize_multihost() is False
