@@ -25,10 +25,11 @@ divergence"):
     table (200 MB at 1024^2).  Planes are f32, not bf16: Mosaic on this
     toolchain cannot dynamically slice bf16 arrays on sublane dims at all
     (vector.load internal error even 8-aligned — verified).  To stay
-    inside VMEM the channel set adapts per level (`plan_channels`): all
-    channels when they fit (every level <= 512^2 of the north-star
-    config), fine channels only at the finest 1024^2 level — where the
-    exact-metric merge + polish still applies the full feature metric.
+    inside VMEM, `plan_channels` picks the largest channel set and the
+    smallest A row-band count that fit the budget; an A side larger than
+    VMEM streams band by band (one sweep call per band, candidates
+    clamped into the band, per-pixel best carried across bands), so the
+    kernel covers every level of every acceptance config.
   - **Lane alignment via dynamic rotate.**  Mosaic cannot dynamically
     slice the lane (minor) dimension at unaligned offsets.  A-planes are
     stored as (C, Hp, Wq, 128); a candidate column range [sx, sx+128) is
@@ -193,38 +194,57 @@ def channel_images(
     return chans
 
 
-@functools.partial(jax.jit, static_argnames=("specs",))
+def band_rows(ha: int, n_bands: int) -> int:
+    """Rows of A per band (last band may be shorter; uniform arrays)."""
+    return -(-ha // n_bands)
+
+
+@functools.partial(jax.jit, static_argnames=("specs", "n_bands"))
 def prepare_a_planes(
     src: jnp.ndarray,
     flt: jnp.ndarray,
     src_coarse: Optional[jnp.ndarray],
     flt_coarse: Optional[jnp.ndarray],
     specs: Tuple[ChannelSpec, ...],
-) -> jnp.ndarray:
-    """A-side planes packed for the kernel: (C, Ha+2P+pad, Wq, 128) f32.
+    n_bands: int = 1,
+) -> Tuple[jnp.ndarray, ...]:
+    """A-side planes packed for the kernel: a tuple of `n_bands` arrays,
+    each (C, band_rows+2P+pad, Wq, 128) f32 covering A rows
+    [i*band_rows, (i+1)*band_rows) with window halos.
 
     Edge padding mirrors ops.features.extract_patches (windows at A's
     border replicate edge pixels).  One guard lane-block on the right
     keeps the two-block candidate load in bounds for any clamped sx.
-    Pass `src_coarse=None` to build the fine-only channel subset
-    (plan_channels decides per level).
+    Pass `src_coarse=None` to build the fine-only channel subset; bands
+    > 1 stream an A side that exceeds VMEM (plan_channels decides both).
     """
     p = halo_for(specs)
     chans = channel_images(src, flt, src_coarse, flt_coarse)
     ha, wa = chans[0].shape
     wq = -(-(wa + 2 * p) // LANE) + 1
-    # Bottom rows beyond ha+2p feed only the blocked-tile pad rows (see
-    # TileGeometry.thp) — content there is never read into interior
-    # output, edge values just keep the slice in bounds.
+    # Bottom rows beyond the valid range feed only the blocked-tile pad
+    # rows (see TileGeometry.thp) — content there is never read into
+    # interior output, edge values just keep the slice in bounds.
     geom = tile_geometry(ha, wa, specs)
     extra = geom.thp - (geom.tile_h + 2 * p)
-    out = []
+    rows_b = band_rows(ha, n_bands)
+    full = []
+    pad_bottom = p + extra + (n_bands * rows_b - ha)
     for c in chans:
         c = jnp.pad(
-            c, ((p, p + extra), (p, wq * LANE - wa - p)), mode="edge"
+            c, ((p, pad_bottom), (p, wq * LANE - wa - p)), mode="edge"
         )
-        out.append(c.reshape(ha + 2 * p + extra, wq, LANE))
-    return jnp.stack(out).astype(jnp.float32)
+        full.append(c.reshape(c.shape[0], wq, LANE))
+    stacked = jnp.stack(full).astype(jnp.float32)
+    bands = []
+    for i in range(n_bands):
+        bands.append(
+            jax.lax.slice_in_dim(
+                stacked, i * rows_b, i * rows_b + rows_b + 2 * p + extra,
+                axis=1,
+            )
+        )
+    return tuple(bands)
 
 
 def to_blocked(plane: jnp.ndarray, geom: TileGeometry) -> jnp.ndarray:
@@ -364,18 +384,26 @@ def _make_kernel(
     wa: int,
     coh_factor: float,
 ):
+    """The SMEM `band_ref` (row0, rows_valid) selects the A row *band*
+    this call can match into (global rows [row0, row0+rows_valid));
+    with one band it is (0, ha).  Banding streams an A side larger than
+    VMEM: each band gets its own sweep call, candidates clamp into the
+    band, and the carried per-pixel best makes the union over bands a
+    global search.  The bounds are scalar operands, not static args, so
+    one compiled kernel serves every band of a level."""
     p, th, tw = geom.halo, geom.tile_h, geom.tile_w
     thp = geom.thp
     n_chan = len(specs)
-    sy_max = ha - th
     sx_max = wa - tw
 
-    def kernel(cy_ref, cx_ref, a_ref, b_ref, oyi_ref, oxi_ref, di_ref,
-               oyo_ref, oxo_ref, do_ref):
+    def kernel(band_ref, cy_ref, cx_ref, a_ref, b_ref, oyi_ref, oxi_ref,
+               di_ref, oyo_ref, oxo_ref, do_ref):
         i = pl.program_id(0)
         j = pl.program_id(1)
         ty0 = i * th
         tx0 = j * tw
+        row0 = band_ref[0]
+        sy_max = row0 + band_ref[1] - th
 
         b_blk = b_ref[:].astype(jnp.float32)  # (C, THP, LANE)
         lane = jax.lax.broadcasted_iota(jnp.int32, (thp, LANE), 1)
@@ -384,9 +412,9 @@ def _make_kernel(
             best_d, best_y, best_x = carry
             oy = cy_ref[i, j, k]
             ox = cx_ref[i, j, k]
-            # Clamp the tile's match origin into A; the *actual* offset
-            # after clamping is what gets recorded on acceptance.
-            sy = jnp.clip(ty0 + oy, 0, sy_max)
+            # Clamp the tile's match origin into this band of A; the
+            # *actual* offset after clamping is recorded on acceptance.
+            sy = jnp.clip(ty0 + oy, row0, sy_max) - row0  # band-local
             sx = jnp.clip(tx0 + ox, 0, sx_max)
             xq = sx // LANE
             xr = sx % LANE
@@ -417,7 +445,7 @@ def _make_kernel(
             factor = jnp.where(k < K_COHERENT, 1.0, coh_factor)
             accept = d * factor < best_d
             best_d = jnp.where(accept, d, best_d)
-            best_y = jnp.where(accept, sy - ty0, best_y)
+            best_y = jnp.where(accept, sy + row0 - ty0, best_y)
             best_x = jnp.where(accept, sx - tx0, best_x)
             return best_d, best_y, best_x
 
@@ -446,6 +474,7 @@ def tile_sweep(
     off_y: jnp.ndarray,
     off_x: jnp.ndarray,
     dist: jnp.ndarray,
+    band: Optional[jnp.ndarray] = None,
     *,
     specs: Tuple[ChannelSpec, ...],
     geom: TileGeometry,
@@ -454,7 +483,8 @@ def tile_sweep(
     coh_factor: float,
     interpret: bool = False,
 ):
-    """One propagate+random-search sweep over every tile.
+    """One propagate+random-search sweep over every tile, against the A
+    band described by `band` = (row0, rows_valid) int32 (None: all of A).
 
     `off_y/off_x/dist` are halo-blocked state planes; `dist` is carried in
     the kernel's metric across sweeps (monotone non-increasing per pixel).
@@ -462,6 +492,8 @@ def tile_sweep(
     thp = geom.thp
     n_ty, n_tx = geom.n_ty, geom.n_tx
     n_chan = a_planes.shape[0]
+    if band is None:
+        band = jnp.asarray([0, ha], jnp.int32)
 
     kernel = _make_kernel(specs, geom, ha, wa, coh_factor)
     state_blk = lambda i, j: (i, j)  # noqa: E731
@@ -469,6 +501,9 @@ def tile_sweep(
         kernel,
         grid=(n_ty, n_tx),
         in_specs=[
+            # Band bounds (row0, rows_valid) as SMEM scalars: dynamic
+            # operands, so one compiled kernel serves every band.
+            pl.BlockSpec((2,), lambda i, j: (0,), memory_space=pltpu.SMEM),
             # Whole candidate tables in SMEM (a few tens of KB): compiled
             # Pallas requires full-array or (8,128)-divisible blocks, so
             # the kernel indexes them by program_id instead of blocking.
@@ -503,7 +538,7 @@ def tile_sweep(
             jax.ShapeDtypeStruct((n_ty * thp, n_tx * LANE), jnp.float32),
         ],
         interpret=interpret,
-    )(cand_y, cand_x, a_planes, b_blocked, off_y, off_x, dist)
+    )(band, cand_y, cand_x, a_planes, b_blocked, off_y, off_x, dist)
     return out  # (off_y, off_x, dist) blocked
 
 
@@ -511,44 +546,67 @@ def tile_sweep(
 # VMEM budgeting / eligibility
 
 
-def vmem_estimate(specs, ha: int, wa: int) -> int:
-    """Bytes of VMEM the resident A side needs (f32 planes)."""
+def vmem_estimate(specs, ha: int, wa: int, n_bands: int = 1) -> int:
+    """Bytes of VMEM one resident A band needs (f32 planes)."""
     p = halo_for(specs)
     wq = -(-(wa + 2 * p) // LANE) + 1
     geom = tile_geometry(ha, wa, specs)
     extra = geom.thp - (geom.tile_h + 2 * p)
-    return len(specs) * (ha + 2 * p + extra) * wq * LANE * 4
+    rows = band_rows(ha, n_bands) + 2 * p + extra
+    return len(specs) * rows * wq * LANE * 4
 
 
 # Leave headroom below the ~16 MB/core VMEM for tiles/state/temporaries.
 VMEM_BUDGET = 11 * 1024 * 1024
+# Sweep cost scales with the band count; past this, the XLA gather path
+# is the better tool.
+MAX_BANDS = 8
 
 
-def tile_eligible(h: int, w: int, ha: int, wa: int, specs) -> bool:
-    geom_ok = (
-        min(h, w) >= LANE
-        and ha >= TILE_H + 2 * halo_for(specs)
-        and wa >= LANE
-    )
-    return geom_ok and vmem_estimate(specs, ha, wa) <= VMEM_BUDGET
+def _bands_needed(specs, ha: int, wa: int, budget: int) -> Optional[int]:
+    """Smallest band count whose resident band fits `budget`, or None.
+
+    Every band — including the last, which gets the remainder rows —
+    must keep >= TILE_H valid rows, or the kernel's clamp bounds invert
+    (sy_min > sy_max) and recorded offsets stop matching the evaluated
+    window."""
+    for n in range(1, MAX_BANDS + 1):
+        rows = band_rows(ha, n)
+        last_valid = ha - (n - 1) * rows
+        if rows < TILE_H or last_valid < TILE_H:
+            break
+        if vmem_estimate(specs, ha, wa, n) <= budget:
+            return n
+    return None
 
 
 def plan_channels(
     n_src: int, n_flt: int, cfg: SynthConfig, has_coarse: bool,
     h: int, w: int, ha: int, wa: int,
+    budget: int = VMEM_BUDGET,
 ):
-    """Pick the largest channel set that fits the VMEM budget.
+    """Pick the largest channel set (and smallest A band count) that fits
+    the VMEM budget.
 
-    Returns (specs, use_coarse) or None when the level is ineligible for
-    the kernel.  Both the driver (A-plane prep) and the matcher (B-side
-    prep) derive the same plan from the same static shapes, so the two
-    sides always agree on the channel layout.
+    Returns (specs, use_coarse, n_bands) or None when the level is
+    ineligible for the kernel.  Both the driver (A-plane prep) and the
+    matcher (B-side prep) derive the same plan from the same static
+    shapes, so the two sides always agree on the layout.
     """
+    geom_ok = (
+        min(h, w) >= LANE
+        and ha >= TILE_H + 2 * halo_for(channel_specs(n_src, n_flt, cfg, False))
+        and wa >= LANE
+    )
+    if not geom_ok:
+        return None
     if has_coarse:
         specs = channel_specs(n_src, n_flt, cfg, True)
-        if tile_eligible(h, w, ha, wa, specs):
-            return specs, True
+        n = _bands_needed(specs, ha, wa, budget)
+        if n is not None:
+            return specs, True, n
     specs = channel_specs(n_src, n_flt, cfg, False)
-    if tile_eligible(h, w, ha, wa, specs):
-        return specs, False
+    n = _bands_needed(specs, ha, wa, budget)
+    if n is not None:
+        return specs, False, n
     return None

@@ -155,13 +155,14 @@ def _maybe_a_planes(cfg, pyr_src_a, pyr_flt_a, level, has_coarse, b_shape):
     plan = plan_channels(n_src, n_flt, cfg, has_coarse, h, w, ha, wa)
     if plan is None:
         return None
-    specs, use_coarse = plan
+    specs, use_coarse, n_bands = plan
     return prepare_a_planes(
         src,
         flt,
         pyr_src_a[level + 1] if use_coarse else None,
         pyr_flt_a[level + 1] if use_coarse else None,
         specs,
+        n_bands=n_bands,
     )
 
 

@@ -2,8 +2,14 @@
 
 The reference selects its search strategy (brute-force NN vs ANN) through a
 `Matcher` plugin interface [BASELINE.json north star]; this module is that
-interface for the TPU build.  A matcher maps feature fields to a
-nearest-neighbor field:
+interface for the TPU build.  Where the reference splits the contract into
+`index(A_features)` + `query(q)` [SURVEY.md §3.2], the TPU formulation fuses
+indexing into `match`: brute needs no index (the MXU streams the whole
+table), PatchMatch's "index" is the NN-field state threaded through the
+call, and the native ANN matcher caches its kd-tree per feature table
+host-side — each strategy keeps the reference's per-level index economics
+without a stateful two-phase API that jit would fight.  A matcher maps
+feature fields to a nearest-neighbor field:
 
     match(f_b (H,W,D), f_a (Ha,Wa,D), nnf (H,W,2), key, level) -> (nnf, dist)
 

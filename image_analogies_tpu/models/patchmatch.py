@@ -55,7 +55,10 @@ class RawPlanes(NamedTuple):
     flt_b: jnp.ndarray
     src_b_coarse: Optional[jnp.ndarray]
     flt_b_coarse: Optional[jnp.ndarray]
-    a_planes: jnp.ndarray  # (C, Ha+2P+pad, Wq, 128) f32, prepare_a_planes
+    # Tuple of A row-band arrays, each (C, rows+2P+pad, Wq, 128) f32
+    # (kernels.patchmatch_tile.prepare_a_planes); one entry when A fits
+    # VMEM, several to stream a larger A side band by band.
+    a_planes: tuple
 
 # Propagation neighborhood: left, right, up, down.
 _DELTAS = ((0, -1), (0, 1), (-1, 0), (1, 0))
@@ -174,10 +177,12 @@ def tile_patchmatch(
     per-pixel XLA sweep, which restores the pure-XLA twin's output
     contract: exact f32 distances and canonical tie-breaking.
 
-    `plan` is the (specs, use_coarse) channel plan the dispatcher already
-    resolved — passed through so dispatch and kernel cannot disagree.
+    `plan` is the (specs, use_coarse, n_bands) channel/banding plan the
+    dispatcher already resolved (kernels.patchmatch_tile.plan_channels)
+    — passed through so dispatch and kernel cannot disagree.
     """
     from ..kernels.patchmatch_tile import (
+        band_rows,
         channel_images,
         sample_candidates,
         tile_geometry,
@@ -189,7 +194,8 @@ def tile_patchmatch(
     h, w, _ = f_b.shape
     ha, wa = f_a.shape[:2]
     f_a_flat = f_a.reshape(-1, f_a.shape[-1])
-    specs, use_coarse = plan
+    specs, use_coarse, n_bands = plan
+    rows_b = band_rows(ha, n_bands)
     geom = tile_geometry(h, w, specs)
     coh = kappa_factor(cfg.kappa, level)
 
@@ -227,11 +233,18 @@ def tile_patchmatch(
         cand_y, cand_x = sample_candidates(
             off_y, off_x, jax.random.fold_in(key, t), geom, ha, wa
         )
-        oy_b, ox_b, d_b = tile_sweep(
-            raw.a_planes, b_blocked, cand_y, cand_x, oy_b, ox_b, d_b,
-            specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=coh,
-            interpret=interpret,
-        )
+        # One call per A band; the carried per-pixel best makes the union
+        # over bands a global search (single call when A fits VMEM).
+        for bi, band_planes in enumerate(raw.a_planes):
+            band = jnp.asarray(
+                [bi * rows_b, min(rows_b, ha - bi * rows_b)], jnp.int32
+            )
+            oy_b, ox_b, d_b = tile_sweep(
+                band_planes, b_blocked, cand_y, cand_x, oy_b, ox_b, d_b,
+                band,
+                specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=coh,
+                interpret=interpret,
+            )
         off_y = from_blocked(oy_b, geom, h, w)
         off_x = from_blocked(ox_b, geom, h, w)
 

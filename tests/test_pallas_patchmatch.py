@@ -20,7 +20,6 @@ from image_analogies_tpu.kernels.patchmatch_tile import (
     halo_for,
     prepare_a_planes,
     sample_candidates,
-    tile_eligible,
     tile_geometry,
     tile_sweep,
     to_blocked,
@@ -105,7 +104,7 @@ class TestKernelMetric:
         src_a = rng.standard_normal((ha, wa)).astype(np.float32)
         flt_a = rng.standard_normal((ha, wa)).astype(np.float32)
 
-        a_planes = prepare_a_planes(
+        (a_planes,) = prepare_a_planes(
             jnp.asarray(src_a), jnp.asarray(flt_a), None, None, specs
         )
         b_blocked = jnp.stack(
@@ -147,7 +146,7 @@ class TestKernelMetric:
         src_a, flt_a = mk(ha, wa), mk(ha, wa)
         src_ac, flt_ac = mk(ha // 2, wa // 2), mk(ha // 2, wa // 2)
 
-        a_planes = prepare_a_planes(
+        (a_planes,) = prepare_a_planes(
             jnp.asarray(src_a), jnp.asarray(flt_a),
             jnp.asarray(src_ac), jnp.asarray(flt_ac), specs,
         )
@@ -211,25 +210,30 @@ class TestCandidateSampling:
 
 class TestEligibility:
     def test_small_levels_fall_back(self):
-        specs = _specs()
-        assert not tile_eligible(64, 64, 64, 64, specs)
-        assert tile_eligible(128, 128, 128, 128, specs)
+        from image_analogies_tpu.kernels.patchmatch_tile import plan_channels
+
+        cfg = SynthConfig()
+        assert plan_channels(1, 1, cfg, False, 64, 64, 64, 64) is None
+        assert plan_channels(1, 1, cfg, False, 128, 128, 128, 128) is not None
 
     def test_channel_plan_adapts_to_vmem(self):
         from image_analogies_tpu.kernels.patchmatch_tile import plan_channels
 
         cfg = SynthConfig()
-        # 512^2: all four channels fit.
+        # 512^2: all four channels fit in one band.
         plan = plan_channels(1, 1, cfg, True, 512, 512, 512, 512)
-        assert plan is not None and plan[1] is True
+        assert plan is not None and plan[1] is True and plan[2] == 1
         assert vmem_estimate(plan[0], 512, 512) < 11e6
-        # 1024^2: coarse channels dropped, fine-only still fits.
+        # 1024^2: coarse channels kept by splitting A into row bands.
         plan = plan_channels(1, 1, cfg, True, 1024, 1024, 1024, 1024)
-        assert plan is not None and plan[1] is False
-        assert vmem_estimate(plan[0], 1024, 1024) < 11e6
-        # Steerable at 1024^2 (5 src channels): nothing fits -> None.
+        assert plan is not None and plan[1] is True and plan[2] > 1
+        assert vmem_estimate(plan[0], 1024, 1024, plan[2]) < 11e6
+        # Steerable at 1024^2 (5 src channels): eligible via banding.
         cfg_s = SynthConfig(steerable=True)
-        assert plan_channels(5, 1, cfg_s, True, 1024, 1024, 1024, 1024) is None
+        plan = plan_channels(5, 1, cfg_s, True, 1024, 1024, 1024, 1024)
+        assert plan is not None and plan[2] > 1
+        # A too small for even one banded tile row: ineligible.
+        assert plan_channels(1, 1, cfg, False, 128, 128, 32, 128) is None
 
 
 class TestKernelMatcherPath:
@@ -248,7 +252,7 @@ class TestKernelMatcherPath:
         f_a = assemble_features(src_a, flt_a, cfg, None, None)
         specs = _specs(cfg)
         a_planes = prepare_a_planes(src_a, flt_a, None, None, specs)
-        raw = RawPlanes(src_b, flt_b, None, None, a_planes)
+        raw = RawPlanes(src_b, flt_b, None, None, a_planes)  # 1-band tuple
         return cfg, f_b, f_a, raw
 
     def test_beats_random_and_near_oracle(self, rng):
@@ -291,6 +295,63 @@ class TestKernelMatcherPath:
         np.testing.assert_allclose(
             np.asarray(dist), np.asarray(recomputed), rtol=1e-4, atol=1e-5
         )
+
+
+class TestBandedStreaming:
+    def test_banded_matcher_path_tracks_unbanded(self, rng):
+        """Forcing a tiny VMEM budget splits A into row bands; the banded
+        search must stay near the unbanded result (same metric, same
+        output contract)."""
+        from unittest import mock
+
+        from image_analogies_tpu.kernels import patchmatch_tile as pt
+        from image_analogies_tpu.models.matcher import nnf_dist
+
+        cfg = SynthConfig(
+            matcher="patchmatch", pallas_mode="interpret", levels=1,
+            pm_iters=2,
+        )
+        h = w = ha = wa = 128
+        src_b = jnp.asarray(rng.random((h, w)).astype(np.float32))
+        flt_b = jnp.asarray(rng.random((h, w)).astype(np.float32))
+        src_a = jnp.asarray(rng.random((ha, wa)).astype(np.float32))
+        flt_a = jnp.asarray(rng.random((ha, wa)).astype(np.float32))
+        f_b = assemble_features(src_b, flt_b, cfg, None, None)
+        f_a = assemble_features(src_a, flt_a, cfg, None, None)
+        specs = _specs(cfg)
+
+        budget = 300 * 1024  # forces 2 bands at these shapes
+        plan = pt.plan_channels(1, 1, cfg, False, h, w, ha, wa, budget)
+        assert plan is not None and plan[2] == 2
+
+        m = get_matcher("patchmatch")
+        key = jax.random.PRNGKey(0)
+        nnf0 = jnp.zeros((h, w, 2), jnp.int32)
+
+        def run(n_bands_budget):
+            orig = pt.plan_channels
+            forced = lambda *a, **k: orig(  # noqa: E731
+                *a[:8], budget=n_bands_budget
+            )
+            a_planes = pt.prepare_a_planes(
+                src_a, flt_a, None, None, specs,
+                n_bands=forced(1, 1, cfg, False, h, w, ha, wa)[2],
+            )
+            raw = RawPlanes(src_b, flt_b, None, None, a_planes)
+            with mock.patch.object(pt, "plan_channels", forced):
+                return m.match(
+                    f_b, f_a, nnf0, key=key, level=0, cfg=cfg, raw=raw
+                )
+
+        nnf_1, d_1 = run(pt.VMEM_BUDGET)
+        nnf_2, d_2 = run(budget)
+        # Same output contract: dist consistent with nnf, exact metric.
+        rec = nnf_dist(f_b, f_a.reshape(-1, f_a.shape[-1]), nnf_2, wa)
+        np.testing.assert_allclose(
+            np.asarray(d_2), np.asarray(rec), rtol=1e-4, atol=1e-5
+        )
+        # Banded search quality tracks unbanded (both near the optimum).
+        assert float(d_2.mean()) <= 1.25 * float(d_1.mean())
 
 
 class TestBatchedKernelPath:
@@ -343,6 +404,21 @@ class TestBatchedKernelPath:
 
 
 class TestEndToEnd:
+    def test_rgb_mode_kernel_path(self, rng):
+        """color_mode='rgb': six fine channels through the kernel."""
+        from image_analogies_tpu import SynthConfig, create_image_analogy
+
+        a = rng.random((128, 128, 3)).astype(np.float32)
+        ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
+        b = rng.random((128, 128, 3)).astype(np.float32)
+        cfg = SynthConfig(
+            levels=1, matcher="patchmatch", pallas_mode="interpret",
+            color_mode="rgb", luminance_remap=False, em_iters=1, pm_iters=2,
+        )
+        bp = np.asarray(create_image_analogy(a, ap, b, cfg))
+        assert bp.shape == b.shape
+        assert np.isfinite(bp).all()
+
     def test_create_image_analogy_kernel_path(self):
         """128^2 super-resolution synthesis through the kernel path tracks
         the brute-force oracle (mirrors test_synthesis config 3, which
