@@ -108,12 +108,6 @@ def cmd_synth(args) -> int:
     ap = load_image(args.ap)
     b = load_image(args.b)
     cfg = _config_from(args)
-    if args.spatial and args.resume_from:
-        raise SystemExit(
-            "--resume-from is not supported with --spatial (the spatial "
-            "runner keeps no per-level resume contract yet); re-run "
-            "without --spatial or without --resume-from"
-        )
     progress.emit("start", shape=list(b.shape), matcher=cfg.matcher)
     t0 = time.perf_counter()
     from .utils.profiling import device_trace
@@ -129,6 +123,7 @@ def cmd_synth(args) -> int:
             bp = synthesize_spatial(
                 a, ap, b, cfg, make_mesh(args.n_devices),
                 progress=level_progress,
+                resume_from=args.resume_from,
             )
         else:
             bp = create_image_analogy(

@@ -231,24 +231,18 @@ def create_image_analogy(
     nnf = None
 
     start_level = levels - 1
-    if resume_from:
-        loaded = _load_resume_state(
-            resume_from, levels, _ckpt_fingerprint(cfg, b.shape)
-        )
-        if loaded is not None:
-            resumed_level, nnf, dist, bp, aux_fill = loaded
-            flt_bp = bp
-            for lvl, (n, d) in aux_fill.items():
-                aux["nnf"][lvl] = n
-                aux["dist"][lvl] = d
-            if progress is not None:
-                progress.emit("resume", from_level=resumed_level)
-            if resumed_level == 0:
-                out = _finalize(bp, yiq_b, b, cfg)
-                if return_aux:
-                    return {"bp": out, "nnf": aux["nnf"], "dist": aux["dist"]}
-                return out
-            start_level = resumed_level - 1
+    resumed = resume_prologue(resume_from, levels, cfg, b.shape, progress)
+    if resumed is not None:
+        start_level, nnf, bp, aux_fill = resumed
+        flt_bp = bp
+        for lvl, (n, d) in aux_fill.items():
+            aux["nnf"][lvl] = n
+            aux["dist"][lvl] = d
+        if start_level < 0:
+            out = _finalize(bp, yiq_b, b, cfg)
+            if return_aux:
+                return {"bp": out, "nnf": aux["nnf"], "dist": aux["dist"]}
+            return out
 
     for level in range(start_level, -1, -1):
         level_t0 = time.perf_counter()
@@ -373,6 +367,26 @@ def _save_level(path: str, level: int, nnf, dist, bp, cfg, b_shape) -> None:
             fingerprint=np.asarray(_ckpt_fingerprint(cfg, b_shape)),
         )
     os.replace(tmp, final)
+
+
+def resume_prologue(resume_from, levels: int, cfg, b_shape, progress):
+    """Shared resume entry for every synthesis runner.
+
+    Returns None (no usable checkpoint — start fresh) or
+    (start_level, nnf, bp, {level: (nnf, dist)}): start from
+    `start_level` (-1 = every level was checkpointed; finalize `bp`
+    directly) with the loaded state as the incoming coarse state."""
+    if not resume_from:
+        return None
+    loaded = _load_resume_state(
+        resume_from, levels, _ckpt_fingerprint(cfg, b_shape)
+    )
+    if loaded is None:
+        return None
+    resumed_level, nnf, _dist, bp, aux_fill = loaded
+    if progress is not None:
+        progress.emit("resume", from_level=resumed_level)
+    return resumed_level - 1, nnf, bp, aux_fill
 
 
 def _load_resume_state(path: str, levels: int, fingerprint: str):

@@ -107,12 +107,18 @@ def synthesize_spatial(
     cfg: Optional[SynthConfig] = None,
     mesh=None,
     progress=None,
+    resume_from: Optional[str] = None,
 ):
     """B' for one (large) `b`, rows sharded over the mesh's batch axis.
 
     `b`'s height is padded (edge rows) to n_devices * 2^(levels-1)
     granularity so every level splits into equal, parity-aligned slabs;
     the pad is cropped from the result.
+
+    `resume_from`: per-level checkpoint dir (cfg.save_level_artifacts of
+    a prior run) — restarts from the finest completed level like
+    create_image_analogy.  The fingerprint covers the *padded* B shape,
+    so checkpoints only resume onto a mesh with the same padding grain.
     """
     cfg = cfg or SynthConfig()
     mesh = mesh or make_mesh()
@@ -144,7 +150,17 @@ def synthesize_spatial(
     key = jax.random.PRNGKey(cfg.seed)
     bp = flt_bp = nnf = None  # global (H_l, W[, C]) state per level
 
-    for level in range(levels - 1, -1, -1):
+    start_level = levels - 1
+    from ..models.analogy import resume_prologue
+
+    resumed = resume_prologue(resume_from, levels, cfg, b.shape, progress)
+    if resumed is not None:
+        start_level, nnf, bp, _aux = resumed
+        flt_bp = bp
+        if start_level < 0:
+            return _finalize(bp, yiq_b, b, cfg)[:h0]
+
+    for level in range(start_level, -1, -1):
         f_a_src = pyr_src_a[level]
         h, w = pyr_src_b[level].shape[:2]
         ha, wa = f_a_src.shape[:2]

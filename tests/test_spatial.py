@@ -95,3 +95,28 @@ def test_initialize_multihost_default_args_no_cluster():
     from image_analogies_tpu.parallel.mesh import initialize_multihost
 
     assert initialize_multihost() is False
+
+
+def test_spatial_resume_reproduces_full_run(tmp_path):
+    """Spatial run resumed from its own checkpoints must reproduce the
+    uninterrupted spatial run exactly (same keys per level)."""
+    import os
+
+    rng = np.random.default_rng(11)
+    a = rng.random((32, 32)).astype(np.float32)
+    ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
+    b = rng.random((60, 32)).astype(np.float32)  # pads to 64: exercises
+    # the padded-shape fingerprint path
+    ckpt = str(tmp_path / "ckpt")
+    cfg = SynthConfig(
+        levels=2, matcher="brute", em_iters=1, save_level_artifacts=ckpt,
+    )
+    full = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(4)))
+    os.unlink(os.path.join(ckpt, "level_0.npz"))
+    cfg2 = SynthConfig(levels=2, matcher="brute", em_iters=1)
+    resumed = np.asarray(
+        synthesize_spatial(
+            a, ap, b, cfg2, make_mesh(4), resume_from=ckpt
+        )
+    )
+    np.testing.assert_array_equal(resumed, full)
