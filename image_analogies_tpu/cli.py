@@ -169,6 +169,7 @@ def cmd_batch(args) -> int:
             synthesize_batch(
                 a, ap, frames, cfg, mesh,
                 progress=progress if args.progress else None,
+                frames_per_step=args.frames_per_step,
             )
         )
     os.makedirs(args.out, exist_ok=True)
@@ -233,6 +234,12 @@ def main(argv=None) -> int:
     p.add_argument("--frames", required=True)
     p.add_argument("--out", required=True)
     p.add_argument("--n-devices", type=int, default=None)
+    p.add_argument(
+        "--frames-per-step", type=int, default=None,
+        help="process frames in sequential microbatches of this size "
+        "(bounds HBM on small meshes; full-scale 8x1024 budgets one "
+        "frame per chip)",
+    )
     _add_synth_flags(p)
     p.set_defaults(fn=cmd_batch)
 

@@ -29,14 +29,18 @@ def remap_luminance(
     y_ap: jnp.ndarray,
     y_b: jnp.ndarray,
     eps: float = 1e-6,
+    b_stats: Tuple[jnp.ndarray, jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Remap (Y_A, Y_A') to B's luminance statistics using A's statistics.
 
     Returns the remapped (Y_A, Y_A').  `eps` guards flat images
     (sigma_A ~ 0), where the scale collapses to 0 instead of exploding.
+    `b_stats` overrides B's (mu, sigma) — the batched runner passes the
+    whole frame stack's statistics so microbatched chunks share one
+    style normalization.
     """
     mu_a, sigma_a = luminance_stats(y_a)
-    mu_b, sigma_b = luminance_stats(y_b)
+    mu_b, sigma_b = b_stats if b_stats is not None else luminance_stats(y_b)
     scale = sigma_b / jnp.maximum(sigma_a, eps)
     return (
         scale * (y_a - mu_a) + mu_b,

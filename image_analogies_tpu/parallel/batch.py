@@ -15,6 +15,7 @@ mesh (SURVEY.md §4).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -74,6 +75,8 @@ def synthesize_batch(
     cfg: Optional[SynthConfig] = None,
     mesh=None,
     progress=None,
+    frames_per_step: Optional[int] = None,
+    _b_stats=None,
 ):
     """B' for every frame in `frames` ((F,H,W,3) or (F,H,W)) against the
     shared style pair (a, ap).  Returns stacked B' shaped like `frames`.
@@ -81,9 +84,63 @@ def synthesize_batch(
     Frame counts that don't divide the mesh are padded (last frame
     repeated) and trimmed after synthesis, so every device stays busy.
     `progress` is an optional `utils.progress.ProgressWriter`.
+
+    `frames_per_step` bounds how many frames are resident at once: the
+    full-scale NPR config (8x1024^2) budgets one frame per chip on a
+    v5e-8; on fewer chips the same run exceeds HBM unless frames are
+    processed in sequential microbatches.  Style luminance-remap
+    statistics are computed over the WHOLE stack regardless of chunking
+    (temporal coherence); per-frame PRNG keys are chunk-local, so
+    outputs depend (deterministically) on the chosen chunking.
+    `_b_stats` is the internal whole-stack stats pass-through.
     """
     cfg = cfg or SynthConfig()
     mesh = mesh or make_mesh()
+    if frames_per_step is not None and frames_per_step < 1:
+        raise ValueError("frames_per_step must be >= 1")
+    if frames_per_step and frames_per_step < frames.shape[0]:
+        import dataclasses
+
+        from ..ops.color import rgb_to_yiq
+        from ..ops.remap import luminance_stats
+
+        # One style normalization for the WHOLE stack (temporal
+        # coherence must not depend on the chunking), computed here and
+        # passed into every chunk.
+        b_stats = None
+        if cfg.color_mode == "luminance" and cfg.luminance_remap:
+            fr = jnp.asarray(frames, jnp.float32)
+            y_all = rgb_to_yiq(fr)[..., 0] if fr.ndim == 4 else fr
+            b_stats = luminance_stats(y_all)
+        outs = []
+        n = frames.shape[0]
+        for ci, i in enumerate(range(0, n, frames_per_step)):
+            chunk = frames[i : i + frames_per_step]
+            # Pad ragged final chunks (repeat last frame) so every chunk
+            # compiles to the same shapes; trimmed below.
+            n_chunk = chunk.shape[0]
+            if n_chunk < frames_per_step:
+                reps = [chunk[-1:]] * (frames_per_step - n_chunk)
+                chunk = jnp.concatenate([jnp.asarray(chunk)] + reps, axis=0)
+            chunk_cfg = cfg
+            if cfg.save_level_artifacts:
+                # Per-chunk artifact subdirectories: one shared path
+                # would leave only the last chunk's checkpoint.
+                chunk_cfg = dataclasses.replace(
+                    cfg,
+                    save_level_artifacts=os.path.join(
+                        cfg.save_level_artifacts, f"frames_{i:05d}"
+                    ),
+                )
+            outs.append(
+                jnp.asarray(
+                    synthesize_batch(
+                        a, ap, chunk, chunk_cfg, mesh, progress,
+                        _b_stats=b_stats,
+                    )
+                )[:n_chunk]
+            )
+        return jnp.concatenate(outs, axis=0)
     token = _mesh_token(mesh)
     n_frames = frames.shape[0]
     n_pad = (-n_frames) % mesh.devices.size
@@ -97,7 +154,9 @@ def synthesize_batch(
         )
     frames = jax.device_put(frames, batch_sharding(mesh))
 
-    src_a, flt_a, src_b, copy_a, yiq_b = _batched_channels(a, ap, frames, cfg)
+    src_a, flt_a, src_b, copy_a, yiq_b = _batched_channels(
+        a, ap, frames, cfg, b_stats=_b_stats
+    )
 
     levels = cfg.clamp_levels(a.shape[:2], frames.shape[1:3])
     pyr_src_a = [_with_steerable(x, cfg) for x in build_pyramid(src_a, levels)]
@@ -203,8 +262,12 @@ def _save_batch_level(path: str, level: int, nnf, dist, bp) -> None:
     )
 
 
-def _batched_channels(a, ap, frames, cfg: SynthConfig):
-    """Channel split with a leading frame axis on the B side."""
+def _batched_channels(a, ap, frames, cfg: SynthConfig, b_stats=None):
+    """Channel split with a leading frame axis on the B side.
+
+    `b_stats` overrides the remap target statistics — the microbatching
+    wrapper passes the WHOLE stack's stats so the shared style stays
+    fixed across chunks (temporal coherence)."""
     if cfg.color_mode == "luminance":
         color = frames.ndim == 4
         yiq_b = jax.vmap(rgb_to_yiq)(frames) if color else None
@@ -216,6 +279,6 @@ def _batched_channels(a, ap, frames, cfg: SynthConfig):
 
             # Remap A to the statistics of the whole frame stack (shared
             # style must stay fixed across frames for temporal coherence).
-            y_a, y_ap = remap_luminance(y_a, y_ap, y_b)
+            y_a, y_ap = remap_luminance(y_a, y_ap, y_b, b_stats=b_stats)
         return y_a, y_ap, y_b, y_ap, yiq_b
     return a, ap, frames, ap, None

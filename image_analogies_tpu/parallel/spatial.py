@@ -40,6 +40,7 @@ from ..models.analogy import (
     _resolve_channels,
     _save_level,
     _with_steerable,
+    resume_prologue,
     upsample_nnf,
 )
 from ..models.patchmatch import random_init
@@ -151,8 +152,6 @@ def synthesize_spatial(
     bp = flt_bp = nnf = None  # global (H_l, W[, C]) state per level
 
     start_level = levels - 1
-    from ..models.analogy import resume_prologue
-
     resumed = resume_prologue(resume_from, levels, cfg, b.shape, progress)
     if resumed is not None:
         start_level, nnf, bp, _aux = resumed
