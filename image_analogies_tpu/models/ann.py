@@ -22,8 +22,10 @@ portable.
 
 from __future__ import annotations
 
+import collections
 import ctypes
 import logging
+import threading
 
 import numpy as np
 import jax
@@ -37,35 +39,77 @@ from .coherence import CoherenceWrapper
 log = logging.getLogger(__name__)
 
 
+class _TreeEntry:
+    """A cached kd-tree plus the bookkeeping that makes eviction safe:
+    `refs` counts in-flight queries (JAX may run pure_callbacks on
+    several threads at once), and an entry evicted while referenced is
+    freed by the *last* releaser instead of the evictor — ann_query runs
+    outside the cache lock, so freeing eagerly would be a use-after-free
+    on the querying thread."""
+
+    __slots__ = ("tree", "refs", "evicted")
+
+    def __init__(self, tree):
+        self.tree = tree
+        self.refs = 0
+        self.evicted = False
+
+
 # Host-side tree cache: f_a is constant for a whole pyramid level but the
 # jitted EM step calls the matcher em_iters times, so without a cache the
 # O(N log N) build (and nothing else) would re-run per iteration.  Keyed
 # on a full-content hash — hashing is ~10x cheaper than building and a
 # false hit would silently corrupt matches, so no fingerprint shortcuts.
-_TREE_CACHE: "dict" = {}
+# Only the key and the native handle are stored (the C++ Tree owns its
+# own copy of the data); LRU order, oldest evicted first.
+_TREE_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 _TREE_CACHE_CAP = 4
-_tree_lock = __import__("threading").Lock()
+_tree_lock = threading.Lock()
 
 
-def _tree_for(f_a: np.ndarray):
+def _free_tree(lib, tree) -> None:
+    """Single funnel for native frees (tests monkeypatch this)."""
+    lib.ann_free(tree)
+
+
+def _acquire_tree(f_a: np.ndarray) -> _TreeEntry:
+    """Look up (or build) the tree for `f_a` and take a query reference.
+
+    Callers must pair with `_release_tree`.  The build runs under the
+    lock — simpler than racing builders, and builds are rare (once per
+    pyramid level)."""
     from ..utils.native import load_ann
 
     lib = load_ann()
     key = (f_a.shape, hash(f_a.tobytes()))
     with _tree_lock:
-        if key in _TREE_CACHE:
-            return _TREE_CACHE[key][1]
-        while len(_TREE_CACHE) >= _TREE_CACHE_CAP:
-            _, (keep, old) = _TREE_CACHE.popitem()
-            lib.ann_free(old)
-        f32p = ctypes.POINTER(ctypes.c_float)
-        tree = lib.ann_build(
-            f_a.ctypes.data_as(f32p), f_a.shape[0], f_a.shape[1]
-        )
-        # The C++ Tree owns a copy of the data; f_a is retained only so
-        # the hash key can be re-derived for debugging.
-        _TREE_CACHE[key] = (f_a, tree)
-        return tree
+        entry = _TREE_CACHE.get(key)
+        if entry is None:
+            f32p = ctypes.POINTER(ctypes.c_float)
+            tree = lib.ann_build(
+                f_a.ctypes.data_as(f32p), f_a.shape[0], f_a.shape[1]
+            )
+            entry = _TreeEntry(tree)
+            _TREE_CACHE[key] = entry
+            while len(_TREE_CACHE) > _TREE_CACHE_CAP:
+                _, old = _TREE_CACHE.popitem(last=False)  # LRU: oldest out
+                if old.refs == 0:
+                    _free_tree(lib, old.tree)
+                else:
+                    old.evicted = True
+        else:
+            _TREE_CACHE.move_to_end(key)
+        entry.refs += 1
+        return entry
+
+
+def _release_tree(entry: _TreeEntry) -> None:
+    from ..utils.native import load_ann
+
+    with _tree_lock:
+        entry.refs -= 1
+        if entry.evicted and entry.refs == 0:
+            _free_tree(load_ann(), entry.tree)
 
 
 def _host_ann_query(f_b_flat: np.ndarray, f_a_flat: np.ndarray, eps: float):
@@ -79,15 +123,18 @@ def _host_ann_query(f_b_flat: np.ndarray, f_a_flat: np.ndarray, eps: float):
     idx = np.empty(n_q, np.int32)
     dist = np.empty(n_q, np.float32)
     f32p = ctypes.POINTER(ctypes.c_float)
-    tree = _tree_for(f_a)
-    lib.ann_query(
-        tree,
-        f_b.ctypes.data_as(f32p),
-        n_q,
-        ctypes.c_float(eps),
-        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        dist.ctypes.data_as(f32p),
-    )
+    entry = _acquire_tree(f_a)
+    try:
+        lib.ann_query(
+            entry.tree,
+            f_b.ctypes.data_as(f32p),
+            n_q,
+            ctypes.c_float(eps),
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dist.ctypes.data_as(f32p),
+        )
+    finally:
+        _release_tree(entry)
     return idx, dist
 
 

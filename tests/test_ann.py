@@ -54,6 +54,95 @@ class TestKdTree:
         assert (idx < 50).all()
 
 
+@needs_native
+class TestTreeCache:
+    """LRU semantics + deferred frees of the host-side tree cache."""
+
+    def _fresh_cache(self, monkeypatch):
+        import image_analogies_tpu.models.ann as ann_mod
+
+        monkeypatch.setattr(
+            ann_mod, "_TREE_CACHE", type(ann_mod._TREE_CACHE)()
+        )
+        freed = []
+        monkeypatch.setattr(
+            ann_mod, "_free_tree", lambda lib, tree: freed.append(tree)
+        )
+        return ann_mod, freed
+
+    @staticmethod
+    def _tables(n):
+        rng = np.random.default_rng(0)
+        return [
+            np.ascontiguousarray(
+                rng.standard_normal((40 + i, 6)), np.float32
+            )
+            for i in range(n)
+        ]
+
+    def test_evicts_oldest_first(self, monkeypatch):
+        ann_mod, freed = self._fresh_cache(monkeypatch)
+        cap = ann_mod._TREE_CACHE_CAP
+        tables = self._tables(cap + 1)
+        entries = []
+        for t in tables:
+            e = ann_mod._acquire_tree(t)
+            ann_mod._release_tree(e)
+            entries.append(e)
+        # Inserting cap+1 entries evicts exactly the first-inserted tree.
+        assert freed == [entries[0].tree]
+        assert len(ann_mod._TREE_CACHE) == cap
+        # The survivors are still cached: re-acquiring is a hit (no new
+        # build, so no further eviction/free).
+        e = ann_mod._acquire_tree(tables[-1])
+        ann_mod._release_tree(e)
+        assert e.tree == entries[-1].tree
+        assert freed == [entries[0].tree]
+        assert len(ann_mod._TREE_CACHE) == cap
+
+    def test_lru_refresh_on_hit(self, monkeypatch):
+        ann_mod, freed = self._fresh_cache(monkeypatch)
+        cap = ann_mod._TREE_CACHE_CAP
+        tables = self._tables(cap + 1)
+        first = ann_mod._acquire_tree(tables[0])
+        ann_mod._release_tree(first)
+        for t in tables[1:cap]:
+            ann_mod._release_tree(ann_mod._acquire_tree(t))
+        # Touch the oldest entry, then overflow: the *second*-oldest must
+        # be the one evicted.
+        ann_mod._release_tree(ann_mod._acquire_tree(tables[0]))
+        second = ann_mod._TREE_CACHE[
+            list(ann_mod._TREE_CACHE.keys())[0]
+        ]
+        ann_mod._release_tree(ann_mod._acquire_tree(tables[cap]))
+        assert freed == [second.tree]
+        assert not first.evicted
+
+    def test_free_deferred_while_referenced(self, monkeypatch):
+        ann_mod, freed = self._fresh_cache(monkeypatch)
+        cap = ann_mod._TREE_CACHE_CAP
+        tables = self._tables(cap + 1)
+        held = ann_mod._acquire_tree(tables[0])  # in-flight query
+        for t in tables[1:]:
+            ann_mod._release_tree(ann_mod._acquire_tree(t))
+        # Evicted but referenced: not freed yet.
+        assert held.evicted and held.tree not in freed
+        ann_mod._release_tree(held)  # last releaser frees
+        assert freed == [held.tree]
+
+    def test_no_feature_table_retained(self, monkeypatch):
+        """The cache must hold no reference to the feature array (the
+        native tree owns its own copy) — measured by refcount, which a
+        retained copy anywhere reachable from the cache would bump."""
+        import sys
+
+        ann_mod, _ = self._fresh_cache(monkeypatch)
+        t = self._tables(1)[0]
+        before = sys.getrefcount(t)
+        ann_mod._release_tree(ann_mod._acquire_tree(t))
+        assert sys.getrefcount(t) == before
+
+
 class TestAnnMatcher:
     def test_matches_brute_dists_at_eps_zero(self, rng):
         cfg = SynthConfig(matcher="ann", ann_eps=0.0, kappa=0.0)
