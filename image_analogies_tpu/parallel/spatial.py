@@ -7,7 +7,7 @@ and runs the per-level EM step vmapped over the slab axis, which pjit
 shards over the mesh like the batch runner shards frames (parallel/
 batch.py).  The analogy-specific twist is *halos*: feature windows
 (5x5 at l, 3x3 at l+1, Hertzmann §3.1) read a few rows past each slab
-boundary, so every slab carries `_HALO` extra rows on each side, and
+boundary, so every slab carries `slab_halo(cfg)` extra rows per side, and
 after every EM iteration the slab cores are re-stitched into the global
 B' estimate and re-split with fresh halos.  Under `jit` + shardings that
 stitch/split pair lowers to exactly the boundary-row exchanges between
@@ -49,11 +49,22 @@ from ..ops.pyramid import build_pyramid, upsample
 from .batch import _batch_step_fn as _spatial_step_fn, _mesh_token
 from .mesh import batch_sharding, make_mesh
 
-# Rows of context on each side of a slab.  Feature reach per EM step:
-# fine window r=2, plus the l+1 coarse window (r=1 coarse row = 2 fine
-# rows, parity-aligned because slab cores are even-sized).  4 covers
-# both; kept even so coarse slabs split at exactly half resolution.
-_HALO = 4
+
+def slab_halo(cfg: SynthConfig) -> int:
+    """Rows of context on each side of a slab, derived from the config's
+    window geometry (a fixed constant silently under-covers larger
+    patches: at patch_size=11 the fine reach is 5 and a 4-row halo lets
+    boundary features go wrong with exit code 0).
+
+    The fine and coarse windows read independently, so the reach is the
+    MAX of the fine window's patch_size//2 rows and the l+1 coarse
+    window's coarse_patch_size//2 coarse rows (= 2*(coarse//2) fine
+    rows, parity-aligned because slab cores are even-sized) — not their
+    sum, which would double the boundary-row exchange for nothing.
+    Rounded up to even so coarse slabs split at exactly half resolution
+    (the coarse-side halo is halo//2)."""
+    reach = max(cfg.patch_size // 2, 2 * (cfg.coarse_patch_size // 2))
+    return reach + (reach % 2)
 
 
 def _split_slabs(x: jnp.ndarray, n_slabs: int, halo: int) -> jnp.ndarray:
@@ -125,6 +136,7 @@ def synthesize_spatial(
     mesh = mesh or make_mesh()
     token = _mesh_token(mesh)
     n_slabs = int(mesh.devices.size)
+    halo = slab_halo(cfg)
 
     a = jnp.asarray(a, jnp.float32)
     ap = jnp.asarray(ap, jnp.float32)
@@ -176,6 +188,21 @@ def synthesize_spatial(
 
         f_a, proj = fit_and_project(f_a, cfg.pca_dims)
 
+        from ..models.analogy import _maybe_a_planes
+
+        # Kernel eligibility is planned against the SLAB the vmapped step
+        # will see (core + halos), not the global B'.  The kernel's
+        # coordinates stay consistent on slabs because offsets are
+        # relative (off = A_row - local_row, recomputed per EM call from
+        # the global-coordinate NNF and the slab-local iota), so the
+        # replicated A planes serve every slab unchanged; candidate
+        # generation's global restarts subtract the local tile origin,
+        # which lands them in the same relative frame.
+        slab_shape = (h // n_slabs + 2 * halo, w)
+        a_planes = _maybe_a_planes(
+            cfg, pyr_src_a, pyr_flt_a, level, has_coarse, slab_shape
+        )
+
         level_key = jax.random.fold_in(key, level)
         if has_coarse:
             nnf = upsample_nnf(nnf, (h, w), ha, wa)
@@ -191,19 +218,19 @@ def synthesize_spatial(
         # split is hoisted with them), placed on the mesh once per level.
         shard = batch_sharding(mesh)
         slab_src_b = jax.device_put(
-            _split_slabs(pyr_src_b[level], n_slabs, _HALO), shard
+            _split_slabs(pyr_src_b[level], n_slabs, halo), shard
         )
         slab_src_b_c = jax.device_put(
             _split_slabs(
                 pyr_src_b[level + 1] if has_coarse else pyr_src_b[level],
                 n_slabs,
-                _HALO // 2 if has_coarse else _HALO,
+                halo // 2 if has_coarse else halo,
             ),
             shard,
         )
         slab_flt_c = (
             jax.device_put(
-                _split_slabs(flt_bp_coarse_g, n_slabs, _HALO // 2), shard
+                _split_slabs(flt_bp_coarse_g, n_slabs, halo // 2), shard
             )
             if has_coarse
             else None
@@ -214,10 +241,10 @@ def synthesize_spatial(
         # the state stays in (sharded) slab form and is re-haloed by the
         # jitted _reslab, so per-iteration traffic is boundary rows only.
         slab_nnf = jax.device_put(
-            _split_slabs(nnf, n_slabs, _HALO), shard
+            _split_slabs(nnf, n_slabs, halo), shard
         )
         slab_flt = jax.device_put(
-            _split_slabs(flt_bp, n_slabs, _HALO), shard
+            _split_slabs(flt_bp, n_slabs, halo), shard
         )
         nnf_s = dist_s = bp_s = None
         for em in range(cfg.em_iters):
@@ -232,19 +259,17 @@ def synthesize_spatial(
                 pyr_copy_a[level],
                 slab_nnf,
                 slab_keys,
-                # proj replicated; a_planes None (slab-local tile origins
-                # would skew the kernel's tile->A coordinates).
                 proj,
-                None,
+                a_planes,
             )
             nnf_s, dist_s, bp_s = step(*args)
             if em < cfg.em_iters - 1:
-                slab_nnf, slab_flt = _reslab_fn(_HALO, n_slabs, token)(
+                slab_nnf, slab_flt = _reslab_fn(halo, n_slabs, token)(
                     nnf_s, bp_s
                 )
-        nnf = _merge_cores(nnf_s, _HALO)
-        dist = _merge_cores(dist_s, _HALO)
-        bp = _merge_cores(bp_s, _HALO)
+        nnf = _merge_cores(nnf_s, halo)
+        dist = _merge_cores(dist_s, halo)
+        bp = _merge_cores(bp_s, halo)
         flt_bp = bp
 
         if progress is not None:

@@ -43,6 +43,32 @@ def test_spatial_brute_bit_identical_to_single_device(rng):
     np.testing.assert_array_equal(sharded, single)
 
 
+def test_spatial_brute_bit_identical_patch11(rng):
+    """Larger windows than the old fixed 4-row halo covered: the halo
+    must be derived from the config (patch 11 => fine reach 5, the
+    smallest odd patch where a 4-row halo demonstrably breaks
+    slab-boundary features)."""
+    a, ap, b = texture_by_numbers(64)
+    cfg = SynthConfig(
+        levels=2, matcher="brute", em_iters=2, pallas_mode="off",
+        patch_size=11, coarse_patch_size=5,
+    )
+    single = np.asarray(create_image_analogy(a, ap, b, cfg))
+    sharded = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(4)))
+    np.testing.assert_array_equal(sharded, single)
+
+
+def test_slab_halo_covers_window_reach():
+    from image_analogies_tpu.parallel.spatial import slab_halo
+
+    for patch, coarse in [(3, 3), (5, 3), (7, 3), (7, 5), (9, 5), (11, 5)]:
+        cfg = SynthConfig(patch_size=patch, coarse_patch_size=coarse)
+        halo = slab_halo(cfg)
+        assert halo % 2 == 0
+        assert halo >= patch // 2           # fine window reach
+        assert halo // 2 >= coarse // 2     # coarse-slab window reach
+
+
 def test_spatial_patchmatch_quality(rng):
     a, ap, b = texture_by_numbers(64)
     cfg = SynthConfig(levels=2, matcher="patchmatch", em_iters=2, pm_iters=4)
@@ -54,6 +80,64 @@ def test_spatial_patchmatch_quality(rng):
     sharded = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(4)))
     assert sharded.std() > 0.05
     assert psnr(sharded, oracle) > 20.0
+
+
+def test_spatial_engages_pallas_kernel(rng):
+    """The tile kernel must trace and run on the spatial path (slab-local
+    offsets keep its tile->A coordinates valid), and the sharded kernel
+    result must track the brute oracle like the single-device kernel
+    path does."""
+    from unittest import mock
+
+    from image_analogies_tpu.kernels import patchmatch_tile as pt
+
+    # Smooth A (informative windows) and a B made of transformed copies
+    # of A, so exact matches exist: a correct kernel path reaches the
+    # oracle's neighborhood (~30 dB here), while any slab-coordinate
+    # skew drops it to the random-match floor (~12 dB).
+    a = rng.random((128, 128))
+    k = np.ones(13) / 13.0  # separable box passes ~= a Gaussian blur
+    for _ in range(3):
+        a = np.apply_along_axis(
+            lambda r: np.convolve(r, k, mode="same"), 1, a
+        )
+        a = np.apply_along_axis(
+            lambda c: np.convolve(c, k, mode="same"), 0, a
+        )
+    a = ((a - a.min()) / (a.max() - a.min())).astype(np.float32)
+    ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
+    b = np.concatenate(
+        [a, np.flipud(a), a[:, ::-1], a], axis=0
+    ).astype(np.float32)
+    cfg = SynthConfig(
+        levels=1, matcher="patchmatch", pallas_mode="interpret",
+        em_iters=1, pm_iters=2,
+    )
+    calls = []
+    real_sweep = pt.tile_sweep
+
+    def counting_sweep(*args, **kw):
+        calls.append(1)
+        return real_sweep(*args, **kw)
+
+    with mock.patch.object(pt, "tile_sweep", counting_sweep):
+        sharded = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(4)))
+    assert calls, "the Pallas tile kernel was never traced on the spatial path"
+    assert sharded.shape == b.shape
+    assert np.isfinite(sharded).all()
+
+    oracle = np.asarray(
+        create_image_analogy(
+            a, ap, b,
+            SynthConfig(levels=1, matcher="brute", em_iters=1),
+        )
+    )
+    single = np.asarray(create_image_analogy(a, ap, b, cfg))
+    psnr_sharded = psnr(sharded, oracle)
+    psnr_single = psnr(single, oracle)
+    # Sharded kernel quality tracks the single-device kernel path.
+    assert psnr_sharded > 25.0
+    assert psnr_sharded > psnr_single - 2.0
 
 
 def test_spatial_pads_odd_heights(rng):
