@@ -42,7 +42,12 @@ class SynthConfig:
     # SURVEY.md §3.3 and §7 "hard parts").
     pm_iters: int = 6            # propagate+random-search sweeps per EM step
     em_iters: int = 3            # B' re-estimation rounds per level
-    pm_random_candidates: int = 6  # random-search scales per sweep
+    # Random-search scales per sweep — XLA-path sweeps only.  The Pallas
+    # tile kernel's candidate budget is static (K_LOCAL/K_GLOBAL in
+    # kernels/patchmatch_tile.py: SMEM tables and the kernel's fori_loop
+    # bound are compile-time shapes), so on the kernel path this knob is
+    # a no-op; the polish pass there is tuned by pm_polish_random below.
+    pm_random_candidates: int = 6
     # Per-pixel XLA polish after the Pallas tile-kernel sweeps (exact
     # metric, tie canonicalization): sweep count and random scales.
     # (2, 4) measured on v5e-1: +0.2..+1.0 dB PSNR-vs-oracle over (1, 2)

@@ -208,6 +208,144 @@ class TestCandidateSampling:
         assert (np.asarray(cx)[..., :K_COHERENT] == -3).all()
 
 
+class TestKappaSplit:
+    """The kernel's static kappa acceptance split (patchmatch_tile
+    _make_kernel: factor = 1 for k < K_COHERENT, coh_factor after):
+    coherent candidates win at raw distance, random candidates must beat
+    the incumbent by the factor (Hertzmann §3.2 / SURVEY C10)."""
+
+    def _banded_setup(self, v1, v2):
+        """B = 0; A = two constant bands: offset 0 lands every tile's
+        window in the v1 band, offset 164 in the v2 band (164, not 160:
+        the window reach must not straddle the band boundary at row 160), so per-pixel
+        distances are exactly n_chan*v^2 (window weights sum to 1)."""
+        cfg = SynthConfig()
+        specs = _specs(cfg)
+        h = w = 128
+        ha, wa = 320, 256
+        geom = tile_geometry(h, w, specs)
+        a_band = np.full((ha, wa), v1, np.float32)
+        a_band[160:] = v2
+        a = jnp.asarray(a_band)
+        (a_planes,) = prepare_a_planes(a, a, None, None, specs)
+        zeros = jnp.zeros((h, w), jnp.float32)
+        b_blocked = jnp.stack(
+            [to_blocked(zeros, geom) for _ in range(2)]
+        )
+        return cfg, specs, geom, a_planes, b_blocked, ha, wa
+
+    def _sweep(self, coh_factor, v1=0.1, v2=0.09):
+        from image_analogies_tpu.kernels.patchmatch_tile import K_COHERENT
+
+        cfg, specs, geom, a_planes, b_blocked, ha, wa = self._banded_setup(
+            v1, v2
+        )
+        n_ty, n_tx = geom.n_ty, geom.n_tx
+        # Coherent slots propose the v1 band (offset 0), random slots the
+        # strictly better v2 band (offset 164, clear of the boundary).
+        cand_y = jnp.concatenate(
+            [
+                jnp.zeros((n_ty, n_tx, K_COHERENT), jnp.int32),
+                jnp.full((n_ty, n_tx, K_TOTAL - K_COHERENT), 164, jnp.int32),
+            ],
+            axis=-1,
+        )
+        cand_x = jnp.zeros((n_ty, n_tx, K_TOTAL), jnp.int32)
+        thp = geom.thp
+        z = jnp.zeros((n_ty * thp, n_tx * LANE), jnp.int32)
+        d0 = jnp.full((n_ty * thp, n_tx * LANE), np.inf, jnp.float32)
+        oy_b, _, d_b = tile_sweep(
+            a_planes, b_blocked, cand_y, cand_x, z, z, d0,
+            specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=coh_factor,
+            interpret=True,
+        )
+        h = w = 128
+        return (
+            np.asarray(from_blocked(oy_b, geom, h, w)),
+            np.asarray(from_blocked(d_b, geom, h, w)),
+        )
+
+    def test_random_needs_the_factor(self):
+        # d_coh = 2*0.1^2 = 0.02, d_rand = 2*0.09^2 = 0.0162:
+        # d_rand < d_coh but d_rand * 2 > d_coh, so with coh_factor=2 the
+        # coherent candidate must be retained everywhere.
+        oy, d = self._sweep(coh_factor=2.0)
+        np.testing.assert_array_equal(oy, 0)
+        np.testing.assert_allclose(d, 0.02, rtol=1e-5)
+
+    def test_coherent_wins_at_raw_distance(self):
+        # Same geometry with coh_factor=1 (kappa=0): the strictly better
+        # random candidate wins — proving the factor (not ordering or
+        # clamping) decided the previous test.
+        oy, d = self._sweep(coh_factor=1.0)
+        np.testing.assert_array_equal(oy, 164)
+        np.testing.assert_allclose(d, 2 * 0.09**2, rtol=1e-5)
+
+    def test_random_accepted_when_clearly_better(self):
+        # d_rand * coh_factor < d_coh: the random candidate must still
+        # be adopted despite the bias (the factor gates, not forbids).
+        oy, d = self._sweep(coh_factor=2.0, v1=0.1, v2=0.05)
+        np.testing.assert_array_equal(oy, 164)
+        np.testing.assert_allclose(d, 2 * 0.05**2, rtol=1e-5)
+
+    def test_end_to_end_kappa_increases_coherence(self, rng):
+        """kappa=5 through the full kernel path: the synthesized s-map
+        must be measurably more coherent (neighboring offsets agree more
+        often) than kappa=0, and the output must stay in the XLA twin's
+        quality neighborhood."""
+        from image_analogies_tpu import create_image_analogy
+        from image_analogies_tpu.utils.metrics import psnr
+
+        a = rng.random((128, 128))
+        k = np.ones(13) / 13.0
+        for _ in range(3):
+            a = np.apply_along_axis(
+                lambda r: np.convolve(r, k, mode="same"), 1, a
+            )
+            a = np.apply_along_axis(
+                lambda c: np.convolve(c, k, mode="same"), 0, a
+            )
+        a = ((a - a.min()) / (a.max() - a.min())).astype(np.float32)
+        ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
+        b = np.concatenate([a[:, ::-1], np.flipud(a)], axis=1)[:128, :128]
+        b = np.ascontiguousarray(b, np.float32)
+
+        def coherence(nnf):
+            off = np.asarray(nnf) - np.stack(
+                np.meshgrid(
+                    np.arange(nnf.shape[0]), np.arange(nnf.shape[1]),
+                    indexing="ij",
+                ),
+                axis=-1,
+            )
+            same = (off[1:] == off[:-1]).all(-1).mean() + (
+                (off[:, 1:] == off[:, :-1]).all(-1).mean()
+            )
+            return same / 2
+
+        outs = {}
+        for kappa in (0.0, 5.0):
+            cfg = SynthConfig(
+                levels=1, matcher="patchmatch", pallas_mode="interpret",
+                em_iters=1, pm_iters=2, kappa=kappa,
+            )
+            outs[kappa] = create_image_analogy(a, ap, b, cfg, return_aux=True)
+        coh0 = coherence(outs[0.0]["nnf"][0])
+        coh5 = coherence(outs[5.0]["nnf"][0])
+        assert coh5 > coh0, (coh5, coh0)
+
+        xla5 = create_image_analogy(
+            a, ap, b,
+            SynthConfig(
+                levels=1, matcher="patchmatch", pallas_mode="off",
+                em_iters=1, pm_iters=2, kappa=5.0,
+            ),
+        )
+        assert psnr(
+            np.asarray(outs[5.0]["bp"]), np.asarray(xla5)
+        ) > 20.0
+
+
 class TestEligibility:
     def test_small_levels_fall_back(self):
         from image_analogies_tpu.kernels.patchmatch_tile import plan_channels
