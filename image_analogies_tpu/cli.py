@@ -146,11 +146,6 @@ def cmd_batch(args) -> int:
     from .utils.io import load_image, save_image
     from .utils.progress import ProgressWriter
 
-    if args.resume_from:
-        raise SystemExit(
-            "--resume-from is not supported by the batch runner; use "
-            "--save-level-artifacts + per-frame synth runs to resume"
-        )
     progress = ProgressWriter(args.progress)
     a = load_image(args.a)
     ap = load_image(args.ap)
@@ -170,6 +165,7 @@ def cmd_batch(args) -> int:
                 a, ap, frames, cfg, mesh,
                 progress=progress if args.progress else None,
                 frames_per_step=args.frames_per_step,
+                resume_from=args.resume_from,
             )
         )
     os.makedirs(args.out, exist_ok=True)

@@ -24,8 +24,10 @@ import jax.numpy as jnp
 from ..config import SynthConfig
 from ..models.analogy import (
     _finalize,
+    _save_level,
     _with_steerable,
     make_em_step,
+    resume_prologue,
     upsample_nnf,
 )
 from ..models.patchmatch import random_init
@@ -76,7 +78,9 @@ def synthesize_batch(
     mesh=None,
     progress=None,
     frames_per_step: Optional[int] = None,
+    resume_from: Optional[str] = None,
     _b_stats=None,
+    _frame_offset: int = 0,
 ):
     """B' for every frame in `frames` ((F,H,W,3) or (F,H,W)) against the
     shared style pair (a, ap).  Returns stacked B' shaped like `frames`.
@@ -90,9 +94,20 @@ def synthesize_batch(
     v5e-8; on fewer chips the same run exceeds HBM unless frames are
     processed in sequential microbatches.  Style luminance-remap
     statistics are computed over the WHOLE stack regardless of chunking
-    (temporal coherence); per-frame PRNG keys are chunk-local, so
-    outputs depend (deterministically) on the chosen chunking.
-    `_b_stats` is the internal whole-stack stats pass-through.
+    (temporal coherence), and per-frame PRNG keys derive from the GLOBAL
+    frame index, so outputs are invariant to the chosen chunking (a
+    rerun on a different chip count must reproduce the same frames).
+
+    `resume_from`: per-level checkpoint dir of a prior run with
+    `cfg.save_level_artifacts` (SURVEY.md §5 checkpoint/resume) —
+    restarts from the finest completed level's whole-batch (nnf, B')
+    state, exactly the single-image scheme.  The fingerprint covers the
+    *padded* frame-stack shape, so checkpoints resume only onto a mesh /
+    frames_per_step combination with the same padding grain; chunked
+    runs write (and resume) per-chunk subdirectories.
+
+    `_b_stats` / `_frame_offset` are the internal whole-stack-stats and
+    global-frame-index pass-throughs for chunked calls.
     """
     cfg = cfg or SynthConfig()
     mesh = mesh or make_mesh()
@@ -132,11 +147,17 @@ def synthesize_batch(
                         cfg.save_level_artifacts, f"frames_{i:05d}"
                     ),
                 )
+            chunk_resume = (
+                os.path.join(resume_from, f"frames_{i:05d}")
+                if resume_from
+                else None
+            )
             outs.append(
                 jnp.asarray(
                     synthesize_batch(
                         a, ap, chunk, chunk_cfg, mesh, progress,
-                        _b_stats=b_stats,
+                        resume_from=chunk_resume,
+                        _b_stats=b_stats, _frame_offset=i,
                     )
                 )[:n_chunk]
             )
@@ -148,17 +169,50 @@ def synthesize_batch(
     a = jnp.asarray(a, jnp.float32)
     ap = jnp.asarray(ap, jnp.float32)
     frames = jnp.asarray(frames, jnp.float32)
+    if _b_stats is None and cfg.color_mode == "luminance" and cfg.luminance_remap:
+        from ..ops.remap import luminance_stats
+
+        # Stats over the UNPADDED stack, before mesh padding duplicates
+        # the last frame: outputs must not depend on the chip count's
+        # padding grain (the chunked wrapper computes the same stats over
+        # the same unpadded whole stack).
+        y_all = rgb_to_yiq(frames)[..., 0] if frames.ndim == 4 else frames
+        _b_stats = luminance_stats(y_all)
     if n_pad:
         frames = jnp.concatenate(
             [frames, jnp.repeat(frames[-1:], n_pad, axis=0)], axis=0
         )
     frames = jax.device_put(frames, batch_sharding(mesh))
 
+    levels = cfg.clamp_levels(a.shape[:2], frames.shape[1:3])
+    key = jax.random.PRNGKey(cfg.seed)
+    bp = flt_bp = flt_bp_coarse = nnf = None
+    # Global frame indices (offset by the chunk position) make per-frame
+    # keys — and therefore outputs — invariant to frames_per_step.
+    frame_idx = jnp.arange(frames.shape[0]) + _frame_offset
+
+    def frame_keys(base_key):
+        return jax.vmap(lambda i: jax.random.fold_in(base_key, i))(frame_idx)
+
+    start_level = levels - 1
+    resumed = resume_prologue(resume_from, levels, cfg, frames.shape, progress)
+    if resumed is not None:
+        start_level, nnf, bp, _aux = resumed
+        flt_bp = bp
+        if start_level < 0:
+            # Fully-checkpointed run: skip feature/pyramid construction
+            # entirely — only the chroma planes are needed to finalize.
+            yiq_b = (
+                jax.vmap(rgb_to_yiq)(frames)
+                if cfg.color_mode == "luminance" and frames.ndim == 4
+                else None
+            )
+            return _finalize_batch(bp, yiq_b, frames, cfg)[:n_frames]
+
     src_a, flt_a, src_b, copy_a, yiq_b = _batched_channels(
         a, ap, frames, cfg, b_stats=_b_stats
     )
 
-    levels = cfg.clamp_levels(a.shape[:2], frames.shape[1:3])
     pyr_src_a = [_with_steerable(x, cfg) for x in build_pyramid(src_a, levels)]
     pyr_flt_a = build_pyramid(flt_a, levels)
     pyr_copy_a = build_pyramid(copy_a, levels)
@@ -170,10 +224,7 @@ def synthesize_batch(
     ]
     pyr_raw_b = list(vpyr(src_b))
 
-    key = jax.random.PRNGKey(cfg.seed)
-    bp = flt_bp = flt_bp_coarse = nnf = None
-
-    for level in range(levels - 1, -1, -1):
+    for level in range(start_level, -1, -1):
         f_a_src = pyr_src_a[level]
         h, w = pyr_src_b[level].shape[1:3]
         ha, wa = f_a_src.shape[:2]
@@ -202,17 +253,14 @@ def synthesize_batch(
             flt_bp_coarse = flt_bp
             flt_bp = jax.vmap(lambda x: upsample(x, (h, w)))(flt_bp)
         else:
-            frame_keys = jax.random.split(level_key, frames.shape[0])
             nnf = jax.vmap(
                 lambda k: random_init(k, h, w, ha, wa)
-            )(frame_keys)
+            )(frame_keys(jax.random.fold_in(level_key, 0x1217)))
             flt_bp = pyr_raw_b[level]
 
         step = _batch_step_fn(cfg, level, has_coarse, token)
         for em in range(cfg.em_iters):
-            em_keys = jax.random.split(
-                jax.random.fold_in(level_key, em), frames.shape[0]
-            )
+            em_keys = frame_keys(jax.random.fold_in(level_key, em))
             args = (
                 pyr_src_b[level],
                 flt_bp,
@@ -234,31 +282,25 @@ def synthesize_batch(
                 nnf_energy=float(dist.mean()),
             )
         if cfg.save_level_artifacts:
-            _save_batch_level(cfg.save_level_artifacts, level, nnf, dist, bp)
+            # Whole-batch per-level state through the single-image writer:
+            # atomic tmp+rename and a fingerprint covering the padded
+            # frame-stack shape (the arrays just carry a frame axis).
+            _save_level(
+                cfg.save_level_artifacts, level, nnf, dist, bp, cfg,
+                frames.shape,
+            )
 
+    return _finalize_batch(bp, yiq_b, frames, cfg)[:n_frames]
+
+
+def _finalize_batch(bp, yiq_b, frames, cfg: SynthConfig):
+    """Vmapped chroma recombination / clipping over the frame axis."""
     if yiq_b is not None:
-        out = jax.vmap(
+        return jax.vmap(
             lambda bp_f, yiq_f, b_f: _finalize(bp_f, yiq_f, b_f, cfg)
         )(bp, yiq_b, frames)
-    else:
-        out = jax.vmap(lambda bp_f, b_f: _finalize(bp_f, None, b_f, cfg))(
-            bp, frames
-        )
-    return out[:n_frames]
-
-
-def _save_batch_level(path: str, level: int, nnf, dist, bp) -> None:
-    """Per-level checkpoint artifacts for the whole batch (SURVEY.md §5)."""
-    import os
-
-    import numpy as np
-
-    os.makedirs(path, exist_ok=True)
-    np.savez(
-        os.path.join(path, f"batch_level_{level}.npz"),
-        nnf=np.asarray(nnf),
-        dist=np.asarray(dist),
-        bp=np.asarray(bp),
+    return jax.vmap(lambda bp_f, b_f: _finalize(bp_f, None, b_f, cfg))(
+        bp, frames
     )
 
 
