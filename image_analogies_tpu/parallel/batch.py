@@ -14,6 +14,7 @@ mesh (SURVEY.md §4).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
 from typing import Optional
@@ -34,11 +35,22 @@ from ..models.patchmatch import random_init
 from ..ops.color import rgb_to_yiq
 from ..ops.features import assemble_features
 from ..ops.pyramid import build_pyramid, upsample
+from ..ops.remap import luminance_stats
 from .mesh import BATCH_AXIS, batch_sharding, make_mesh, replicated
 
 
-@functools.lru_cache(maxsize=64)
 def _batch_step_fn(cfg: SynthConfig, level: int, has_coarse: bool, mesh_key):
+    # save_level_artifacts is not step-shaping (it only names a host-side
+    # checkpoint dir); stripping it keeps one compiled step per
+    # (cfg, level) even when chunked runs vary the per-chunk subdir.
+    cfg = dataclasses.replace(cfg, save_level_artifacts=None)
+    return _batch_step_fn_cached(cfg, level, has_coarse, mesh_key)
+
+
+@functools.lru_cache(maxsize=64)
+def _batch_step_fn_cached(
+    cfg: SynthConfig, level: int, has_coarse: bool, mesh_key
+):
     mesh = _MESHES[mesh_key]
     step = make_em_step(cfg, level, has_coarse)
     # Frame-carried args are vmapped; the A-side (f_a, copy_a), the PCA
@@ -81,6 +93,7 @@ def synthesize_batch(
     resume_from: Optional[str] = None,
     _b_stats=None,
     _frame_offset: int = 0,
+    _n_stack: Optional[int] = None,
 ):
     """B' for every frame in `frames` ((F,H,W,3) or (F,H,W)) against the
     shared style pair (a, ap).  Returns stacked B' shaped like `frames`.
@@ -102,31 +115,31 @@ def synthesize_batch(
     `cfg.save_level_artifacts` (SURVEY.md §5 checkpoint/resume) —
     restarts from the finest completed level's whole-batch (nnf, B')
     state, exactly the single-image scheme.  The fingerprint covers the
-    *padded* frame-stack shape, so checkpoints resume only onto a mesh /
-    frames_per_step combination with the same padding grain; chunked
-    runs write (and resume) per-chunk subdirectories.
+    *padded* frame-stack shape plus the whole-stack identity (total
+    frame count, chunk offset), so checkpoints resume only onto the same
+    mesh / frames_per_step padding grain AND the same overall stack —
+    appending frames changes the whole-stack remap statistics, so a
+    per-chunk checkpoint from the shorter stack must not be reused.
+    Chunked runs write (and resume) per-chunk subdirectories.
 
-    `_b_stats` / `_frame_offset` are the internal whole-stack-stats and
-    global-frame-index pass-throughs for chunked calls.
+    `_b_stats` / `_frame_offset` / `_n_stack` are the internal
+    whole-stack stats / global-frame-index / total-stack-length
+    pass-throughs for chunked calls.
     """
     cfg = cfg or SynthConfig()
     mesh = mesh or make_mesh()
     if frames_per_step is not None and frames_per_step < 1:
         raise ValueError("frames_per_step must be >= 1")
+    n_stack = _n_stack if _n_stack is not None else frames.shape[0]
+    if _b_stats is None and cfg.color_mode == "luminance" and cfg.luminance_remap:
+        # One style normalization for the WHOLE (unpadded) stack: temporal
+        # coherence must depend on neither the chunking nor the mesh's
+        # padding grain, so chunked and unchunked paths compute the same
+        # stats from the same frames, once, here.
+        fr = jnp.asarray(frames, jnp.float32)
+        y_all = rgb_to_yiq(fr)[..., 0] if fr.ndim == 4 else fr
+        _b_stats = luminance_stats(y_all)
     if frames_per_step and frames_per_step < frames.shape[0]:
-        import dataclasses
-
-        from ..ops.color import rgb_to_yiq
-        from ..ops.remap import luminance_stats
-
-        # One style normalization for the WHOLE stack (temporal
-        # coherence must not depend on the chunking), computed here and
-        # passed into every chunk.
-        b_stats = None
-        if cfg.color_mode == "luminance" and cfg.luminance_remap:
-            fr = jnp.asarray(frames, jnp.float32)
-            y_all = rgb_to_yiq(fr)[..., 0] if fr.ndim == 4 else fr
-            b_stats = luminance_stats(y_all)
         outs = []
         n = frames.shape[0]
         for ci, i in enumerate(range(0, n, frames_per_step)):
@@ -157,7 +170,7 @@ def synthesize_batch(
                     synthesize_batch(
                         a, ap, chunk, chunk_cfg, mesh, progress,
                         resume_from=chunk_resume,
-                        _b_stats=b_stats, _frame_offset=i,
+                        _b_stats=_b_stats, _frame_offset=i, _n_stack=n,
                     )
                 )[:n_chunk]
             )
@@ -169,15 +182,6 @@ def synthesize_batch(
     a = jnp.asarray(a, jnp.float32)
     ap = jnp.asarray(ap, jnp.float32)
     frames = jnp.asarray(frames, jnp.float32)
-    if _b_stats is None and cfg.color_mode == "luminance" and cfg.luminance_remap:
-        from ..ops.remap import luminance_stats
-
-        # Stats over the UNPADDED stack, before mesh padding duplicates
-        # the last frame: outputs must not depend on the chip count's
-        # padding grain (the chunked wrapper computes the same stats over
-        # the same unpadded whole stack).
-        y_all = rgb_to_yiq(frames)[..., 0] if frames.ndim == 4 else frames
-        _b_stats = luminance_stats(y_all)
     if n_pad:
         frames = jnp.concatenate(
             [frames, jnp.repeat(frames[-1:], n_pad, axis=0)], axis=0
@@ -194,8 +198,14 @@ def synthesize_batch(
     def frame_keys(base_key):
         return jax.vmap(lambda i: jax.random.fold_in(base_key, i))(frame_idx)
 
+    # Checkpoint identity: the padded chunk shape plus the whole-stack
+    # length and this chunk's offset — per-chunk state depends on the
+    # whole stack through the shared remap statistics, so a checkpoint
+    # from a different overall stack must not be resumed.
+    fp_shape = tuple(frames.shape) + (n_stack, _frame_offset)
+
     start_level = levels - 1
-    resumed = resume_prologue(resume_from, levels, cfg, frames.shape, progress)
+    resumed = resume_prologue(resume_from, levels, cfg, fp_shape, progress)
     if resumed is not None:
         start_level, nnf, bp, _aux = resumed
         flt_bp = bp
@@ -287,7 +297,7 @@ def synthesize_batch(
             # frame-stack shape (the arrays just carry a frame axis).
             _save_level(
                 cfg.save_level_artifacts, level, nnf, dist, bp, cfg,
-                frames.shape,
+                fp_shape,
             )
 
     return _finalize_batch(bp, yiq_b, frames, cfg)[:n_frames]

@@ -173,9 +173,11 @@ def test_batch_output_invariant_to_chunking(rng):
     from image_analogies_tpu.parallel.batch import synthesize_batch
     from image_analogies_tpu.parallel.mesh import make_mesh
 
-    a = rng.random((32, 32)).astype(np.float32)
+    # RGB frames: covers the color path of the whole-stack remap stats
+    # (grayscale short-circuits the rgb_to_yiq branch).
+    a = rng.random((32, 32, 3)).astype(np.float32)
     ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
-    frames = rng.random((5, 32, 32)).astype(np.float32)
+    frames = rng.random((5, 32, 32, 3)).astype(np.float32)
     cfg = SynthConfig(levels=2, matcher="patchmatch", em_iters=1, pm_iters=3)
     full = np.asarray(synthesize_batch(a, ap, frames, cfg, make_mesh(1)))
     for fps in (2, 3):
@@ -189,3 +191,38 @@ def test_batch_output_invariant_to_chunking(rng):
     # outputs either: remap stats are computed over the unpadded stack.
     padded = np.asarray(synthesize_batch(a, ap, frames, cfg, make_mesh(2)))
     np.testing.assert_array_equal(padded, full)
+
+
+def test_batch_resume_rejects_stale_stack(tmp_path, rng):
+    """Appending frames changes the whole-stack remap statistics, so
+    per-chunk checkpoints from the shorter stack must be ignored (the
+    fingerprint binds the total stack length): resuming must equal a
+    fresh run of the longer stack."""
+    from image_analogies_tpu.parallel.batch import synthesize_batch
+    from image_analogies_tpu.parallel.mesh import make_mesh
+
+    a = rng.random((32, 32)).astype(np.float32)
+    ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
+    frames4 = rng.random((4, 32, 32)).astype(np.float32)
+    frames6 = np.concatenate(
+        [frames4, rng.random((2, 32, 32)).astype(np.float32)]
+    )
+    ckpt = str(tmp_path / "ckpt")
+    cfg = SynthConfig(
+        levels=2, matcher="patchmatch", em_iters=1, pm_iters=3,
+        save_level_artifacts=ckpt,
+    )
+    synthesize_batch(a, ap, frames4, cfg, make_mesh(1), frames_per_step=2)
+    cfg2 = SynthConfig(levels=2, matcher="patchmatch", em_iters=1, pm_iters=3)
+    fresh6 = np.asarray(
+        synthesize_batch(
+            a, ap, frames6, cfg2, make_mesh(1), frames_per_step=2
+        )
+    )
+    resumed6 = np.asarray(
+        synthesize_batch(
+            a, ap, frames6, cfg2, make_mesh(1), frames_per_step=2,
+            resume_from=ckpt,
+        )
+    )
+    np.testing.assert_array_equal(resumed6, fresh6)
