@@ -6,16 +6,31 @@ Prints ONE JSON line:
    ...extra fields...}
 
 `vs_baseline` is the speedup against the binding <10 s target
-[BASELINE.json:2]: > 1.0 means the target is beaten.  The PSNR-vs-CPU-ref
-acceptance is reported at reduced size (the CPU brute-force oracle is
-O(N^2) and infeasible at 1024^2 — which is the reason this framework
-exists; SURVEY.md §6 defines the oracle as this repo's own brute path).
+[BASELINE.json:2]: > 1.0 means the target is beaten.
+
+Schedule note: the headline run uses em_iters=2 (the config-default is 3);
+the same schedule is used for the oracle run, so the PSNR compares
+like-for-like.  Both schedule and PSNR probe size are reported in the
+JSON so the number is reproducible as printed.
+
+PSNR acceptance is measured at FULL scale: the exact-NN oracle runs
+on-TPU through the streaming Pallas kernel (kernels/nn_brute.py), which
+never materializes the N^2 distance matrix, so a 1M-query exact pass is
+a few seconds of MXU time — no reduced-size stand-in.
+
+Kernel utilization: the hot tile-PatchMatch kernel is also timed in
+isolation at the headline level-0 geometry; bytes per sweep are derived
+statically from the channel/banding plan, giving achieved HBM GB/s
+against the v5e-1 roofline (819 GB/s).
 """
 
 import json
 import time
 
 import numpy as np
+
+# TPU v5e single-chip HBM bandwidth (public spec), the kernel's roofline.
+_V5E_HBM_GBPS = 819.0
 
 
 def _tpu_available() -> bool:
@@ -25,6 +40,127 @@ def _tpu_available() -> bool:
         return any(d.platform != "cpu" for d in jax.devices())
     except RuntimeError:
         return False
+
+
+def _sync(x) -> float:
+    """Completion barrier: force x's computation with a 4-byte readback.
+
+    `block_until_ready()` under the tunnelled axon PJRT platform can
+    return before remote execution completes (measured here: a 1024^2
+    run "blocked" in 0.13 s while its result took 20+ s to materialize),
+    silently turning wall-clock benchmarks into dispatch-time
+    benchmarks.  Fetching a scalar reduction of the output is a reliable
+    barrier: the host cannot have the value until the device finished.
+    """
+    import jax.numpy as jnp
+
+    return float(jnp.sum(x))
+
+
+def _level_walls(a, ap, b, cfg):
+    """Per-level wall clock via the driver's own progress events."""
+    import os
+    import tempfile
+
+    from image_analogies_tpu import create_image_analogy
+    from image_analogies_tpu.utils.progress import ProgressWriter
+
+    fd, path = tempfile.mkstemp(suffix=".jsonl")
+    os.close(fd)
+    try:
+        create_image_analogy(
+            a, ap, b, cfg, progress=ProgressWriter(path)
+        ).block_until_ready()
+        walls = {}
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("event") == "level_done":
+                    walls[rec["level"]] = rec["wall_ms"]
+        return [walls[lvl] for lvl in sorted(walls)]
+    finally:
+        os.unlink(path)
+
+
+def _kernel_utilization(cfg, size: int, iters: int = 16):
+    """Steady-state tile_sweep throughput at the headline level-0
+    geometry: (achieved GB/s, roofline fraction, bytes/sweep).
+
+    Traffic model per pm iteration: every A band is fetched once
+    (constant-index blocks are not re-fetched across grid steps) and
+    every tile moves its B channels plus 3 state planes in and 3 out.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from image_analogies_tpu.kernels.patchmatch_tile import (
+        LANE,
+        band_rows,
+        plan_channels,
+        prepare_a_planes,
+        sample_candidates,
+        tile_geometry,
+        tile_sweep,
+        to_blocked,
+    )
+
+    plan = plan_channels(1, 1, cfg, True, size, size, size, size)
+    if plan is None:
+        return None
+    specs, use_coarse, n_bands = plan
+    geom = tile_geometry(size, size, specs)
+    rng = np.random.default_rng(0)
+    mk = lambda *s: jnp.asarray(rng.random(s, np.float32))  # noqa: E731
+    a_planes = prepare_a_planes(
+        mk(size, size), mk(size, size),
+        mk(size // 2, size // 2) if use_coarse else None,
+        mk(size // 2, size // 2) if use_coarse else None,
+        specs, n_bands=n_bands,
+    )
+    n_chan = int(a_planes[0].shape[0])
+    b_blocked = jnp.stack(
+        [to_blocked(mk(size, size), geom) for _ in range(n_chan)]
+    )
+    thp, n_ty, n_tx = geom.thp, geom.n_ty, geom.n_tx
+    oy = jnp.zeros((n_ty * thp, n_tx * LANE), jnp.int32)
+    ox = jnp.zeros((n_ty * thp, n_tx * LANE), jnp.int32)
+    d = jnp.full((n_ty * thp, n_tx * LANE), jnp.inf, jnp.float32)
+    cand_y, cand_x = sample_candidates(
+        jnp.zeros((size, size), jnp.int32), jnp.zeros((size, size), jnp.int32),
+        jax.random.PRNGKey(0), geom, size, size,
+    )
+    rows_b = band_rows(size, n_bands)
+
+    def one_iter(oy, ox, d):
+        for bi, band_planes in enumerate(a_planes):
+            band = jnp.asarray(
+                [bi * rows_b, min(rows_b, size - bi * rows_b)], jnp.int32
+            )
+            oy, ox, d = tile_sweep(
+                band_planes, b_blocked, cand_y, cand_x, oy, ox, d, band,
+                specs=specs, geom=geom, ha=size, wa=size, coh_factor=1.0,
+            )
+        return oy, ox, d
+
+    oy, ox, d = one_iter(oy, ox, d)  # warm/compile
+    _sync(d)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        oy, ox, d = one_iter(oy, ox, d)
+    _sync(d)
+    wall = time.perf_counter() - t0
+
+    a_bytes = sum(int(np.prod(p.shape)) * 4 for p in a_planes)
+    tile_bytes = (n_chan + 6) * thp * LANE * 4  # B chans + 3 state in/out
+    sweep_bytes = a_bytes + n_bands * n_ty * n_tx * tile_bytes
+    gbps = iters * sweep_bytes / wall / 1e9
+    return {
+        "kernel_hbm_gbps": round(gbps, 1),
+        "kernel_roofline_frac": round(gbps / _V5E_HBM_GBPS, 3),
+        "kernel_bytes_per_sweep": sweep_bytes,
+        "kernel_sweep_ms": round(wall / iters * 1000, 3),
+        "kernel_n_bands": n_bands,
+    }
 
 
 def main() -> None:
@@ -40,52 +176,66 @@ def main() -> None:
     on_tpu = _tpu_available()
     size = 1024 if on_tpu else 128  # CPU fallback keeps the bench runnable
     levels = 5 if on_tpu else 4
+    em_iters = 2
 
     a, ap, b = super_resolution(size)
     cfg = SynthConfig(
-        levels=levels, matcher="patchmatch", em_iters=2, pm_iters=6,
+        levels=levels, matcher="patchmatch", em_iters=em_iters, pm_iters=6,
         pm_random_candidates=6,
     )
 
     # Warmup: compile every per-level step (first compile ~20-40 s on TPU;
-    # the metric is synthesis wall-clock, not compile time).
-    create_image_analogy(a, ap, b, cfg).block_until_ready()
-
-    t0 = time.perf_counter()
+    # the metric is synthesis wall-clock, not compile time), then DRAIN
+    # the device queue (_sync) so the timed runs start from idle.
     bp = create_image_analogy(a, ap, b, cfg)
-    bp.block_until_ready()
-    wall = time.perf_counter() - t0
+    _sync(bp)
 
-    # Reduced-size PSNR acceptance vs the CPU-oracle path (brute exact NN).
-    psnr_size = 96
-    a2, ap2, b2 = super_resolution(psnr_size)
-    kw = dict(levels=3, em_iters=3)
-    cpu = jax.devices("cpu")[0]
-    with jax.default_device(cpu):
-        oracle = np.asarray(
-            create_image_analogy(a2, ap2, b2, SynthConfig(matcher="brute", **kw))
-        )
-    approx = np.asarray(
-        create_image_analogy(
-            a2, ap2, b2, SynthConfig(matcher="patchmatch", pm_iters=10, **kw)
-        )
-    )
-    psnr_db = psnr(approx, oracle)
+    # Best-of-3 steady state, each run closed by the scalar-readback
+    # barrier (see _sync: block_until_ready under-measures on axon).
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        bp = create_image_analogy(a, ap, b, cfg)
+        _sync(bp)
+        walls.append(time.perf_counter() - t0)
+    wall = min(walls)
 
-    print(
-        json.dumps(
-            {
-                "metric": f"{size}x{size} B' synth wall-clock "
-                f"({levels}-level pyr, 5x5 patch)",
-                "value": round(wall, 4),
-                "unit": "s",
-                "vs_baseline": round(10.0 / wall, 3),
-                "device": "tpu" if on_tpu else "cpu-fallback",
-                "psnr_vs_cpu_ref_db": round(psnr_db, 2),
-                "psnr_probe_size": psnr_size,
-            }
-        )
+    # FULL-SCALE PSNR acceptance vs the exact-NN oracle (same size, same
+    # schedule): the streaming Pallas brute kernel makes the exact pass
+    # feasible on-TPU at 1024^2 [BASELINE.json:2 ">= 35 dB"].
+    t0 = time.perf_counter()
+    oracle = create_image_analogy(
+        a, ap, b,
+        SynthConfig(levels=levels, matcher="brute", em_iters=em_iters),
     )
+    _sync(oracle)
+    oracle_wall = time.perf_counter() - t0
+    psnr_db = psnr(np.asarray(bp), np.asarray(oracle))
+
+    level_wall_ms = _level_walls(a, ap, b, cfg)
+    util = _kernel_utilization(cfg, size) if on_tpu else None
+
+    rec = {
+        "metric": f"{size}x{size} B' synth wall-clock "
+        f"({levels}-level pyr, 5x5 patch)",
+        "value": round(wall, 4),
+        "unit": "s",
+        "vs_baseline": round(10.0 / wall, 3),
+        "wall_runs_s": [round(w, 3) for w in walls],
+        "device": "tpu" if on_tpu else "cpu-fallback",
+        "em_iters": em_iters,
+        "psnr_vs_cpu_ref_db": round(psnr_db, 2),
+        "psnr_probe_size": size,
+        # Single (unwarmed) oracle pass: includes compile-cache load /
+        # any first-compile cost, labeled as such — the oracle runs once
+        # for the PSNR number, so a warmed timing would double bench
+        # time for a non-headline figure.
+        "oracle_wall_s_inc_compile": round(oracle_wall, 3),
+        "level_wall_ms": level_wall_ms,
+    }
+    if util:
+        rec.update(util)
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

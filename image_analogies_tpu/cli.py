@@ -130,7 +130,12 @@ def cmd_synth(args) -> int:
                 a, ap, b, cfg, progress=level_progress,
                 resume_from=args.resume_from,
             )
-        bp.block_until_ready()
+        # Materialize on the host before stopping the clock: under the
+        # tunnelled axon platform block_until_ready can return before
+        # remote execution finishes, which would report dispatch time.
+        import numpy as np
+
+        bp = np.asarray(bp)
     progress.emit("done", wall_s=round(time.perf_counter() - t0, 3))
     save_image(args.out, bp)
     print(f"wrote {args.out} ({time.perf_counter() - t0:.2f}s)")

@@ -298,15 +298,18 @@ def create_image_analogy(
         aux["dist"][level] = dist
         if progress is not None:
             # One device sync per level — the only host sync in the loop
-            # (north-star: minimize host round trips), and it gives the
-            # wall clock + NN-field energy honest values.
-            jax.block_until_ready(dist)
+            # (north-star: minimize host round trips).  The sync is the
+            # scalar readback itself, evaluated BEFORE the clock is
+            # read: block_until_ready can return before remote execution
+            # completes on the tunnelled axon platform, which would
+            # charge this level's tail to the next level's window.
+            nnf_energy = float(dist.mean())
             progress.emit(
                 "level_done",
                 level=level,
                 shape=[int(h), int(w)],
                 wall_ms=round((time.perf_counter() - level_t0) * 1000, 3),
-                nnf_energy=float(dist.mean()),
+                nnf_energy=nnf_energy,
             )
         if cfg.save_level_artifacts:
             _save_level(
