@@ -95,7 +95,7 @@ def _kernel_utilization(cfg, size: int, iters: int = 16):
 
     from image_analogies_tpu.kernels.patchmatch_tile import (
         LANE,
-        band_rows,
+        band_bounds,
         plan_channels,
         prepare_a_planes,
         sample_candidates,
@@ -129,13 +129,10 @@ def _kernel_utilization(cfg, size: int, iters: int = 16):
         jnp.zeros((size, size), jnp.int32), jnp.zeros((size, size), jnp.int32),
         jax.random.PRNGKey(0), geom, size, size,
     )
-    rows_b = band_rows(size, n_bands)
+    bounds = band_bounds(size, n_bands)
 
     def one_iter(oy, ox, d):
-        for bi, band_planes in enumerate(a_planes):
-            band = jnp.asarray(
-                [bi * rows_b, min(rows_b, size - bi * rows_b)], jnp.int32
-            )
+        for band_planes, band in zip(a_planes, bounds):
             oy, ox, d = tile_sweep(
                 band_planes, b_blocked, cand_y, cand_x, oy, ox, d, band,
                 specs=specs, geom=geom, ha=size, wa=size, coh_factor=1.0,

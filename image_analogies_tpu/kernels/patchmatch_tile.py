@@ -27,9 +27,10 @@ divergence"):
     (vector.load internal error even 8-aligned — verified).  To stay
     inside VMEM, `plan_channels` picks the largest channel set and the
     smallest A row-band count that fit the budget; an A side larger than
-    VMEM streams band by band (one sweep call per band, candidates
-    clamped into the band, per-pixel best carried across bands), so the
-    kernel covers every level of every acceptance config.
+    VMEM streams band by band (one sweep call per band; each candidate
+    is evaluated only in the band containing its clamped origin, so
+    sweep compute does not scale with the band count, and the per-pixel
+    best carried across bands makes the union a global search).
   - **Lane alignment via dynamic rotate.**  Mosaic cannot dynamically
     slice the lane (minor) dimension at unaligned offsets.  A-planes are
     stored as (C, Hp, Wq, 128); a candidate column range [sx, sx+128) is
@@ -197,6 +198,17 @@ def channel_images(
 def band_rows(ha: int, n_bands: int) -> int:
     """Rows of A per band (last band may be shorter; uniform arrays)."""
     return -(-ha // n_bands)
+
+
+def band_bounds(ha: int, n_bands: int) -> list:
+    """The (row0, rows_valid) int32 operand for each band's sweep call —
+    the ONE band-bounds convention, shared by the matcher and the bench
+    so they cannot drift apart."""
+    rows_b = band_rows(ha, n_bands)
+    return [
+        jnp.asarray([i * rows_b, min(rows_b, ha - i * rows_b)], jnp.int32)
+        for i in range(n_bands)
+    ]
 
 
 @functools.partial(jax.jit, static_argnames=("specs", "n_bands"))
@@ -387,10 +399,12 @@ def _make_kernel(
     """The SMEM `band_ref` (row0, rows_valid) selects the A row *band*
     this call can match into (global rows [row0, row0+rows_valid));
     with one band it is (0, ha).  Banding streams an A side larger than
-    VMEM: each band gets its own sweep call, candidates clamp into the
-    band, and the carried per-pixel best makes the union over bands a
-    global search.  The bounds are scalar operands, not static args, so
-    one compiled kernel serves every band of a level."""
+    VMEM: each band gets its own sweep call, a candidate is evaluated
+    only in the one band containing its globally-clamped origin (the
+    in_band cond below — out-of-band candidates skip all vector work),
+    and the carried per-pixel best makes the union over bands a global
+    search.  The bounds are scalar operands, not static args, so one
+    compiled kernel serves every band of a level."""
     p, th, tw = geom.halo, geom.tile_h, geom.tile_w
     thp = geom.thp
     n_chan = len(specs)
@@ -402,6 +416,10 @@ def _make_kernel(
         j = pl.program_id(1)
         ty0 = i * th
         tx0 = j * tw
+        # cy/cx arrive as the 8-row SMEM block containing this tile's
+        # candidate row (flat tile index, padded to 8); SMEM loads must
+        # be scalar, so candidates are read as cy_ref[row, k].
+        row = (i * geom.n_tx + j) % 8
         row0 = band_ref[0]
         sy_max = row0 + band_ref[1] - th
 
@@ -409,45 +427,57 @@ def _make_kernel(
         lane = jax.lax.broadcasted_iota(jnp.int32, (thp, LANE), 1)
 
         def eval_candidate(k, carry):
-            best_d, best_y, best_x = carry
-            oy = cy_ref[i, j, k]
-            ox = cx_ref[i, j, k]
-            # Clamp the tile's match origin into this band of A; the
-            # *actual* offset after clamping is recorded on acceptance.
-            sy = jnp.clip(ty0 + oy, row0, sy_max) - row0  # band-local
-            sx = jnp.clip(tx0 + ox, 0, sx_max)
-            xq = sx // LANE
-            xr = sx % LANE
-            rot_amt = (LANE - xr) % LANE
+            oy = cy_ref[row, k]
+            ox = cx_ref[row, k]
+            # Bands partition [0, ha): evaluate a candidate only in the
+            # ONE band containing its (globally clamped) tile origin, so
+            # banded sweeps cost one evaluation per candidate per pm
+            # iteration rather than n_bands of them — the scalar cond is
+            # tile-uniform, so out-of-band candidates skip all vector
+            # work.  Candidates whose origin falls in a band's last
+            # th-1 rows are clamped up to keep the window resident
+            # (same displacement the all-bands clamp applied before).
+            sy_g = jnp.clip(ty0 + oy, 0, ha - th)
+            in_band = (sy_g >= row0) & (sy_g < row0 + band_ref[1])
 
-            d = jnp.zeros((thp, LANE), jnp.float32)
-            for c in range(n_chan):
-                sp = specs[c]
-                r = len(sp.wy) // 2
-                # Two adjacent lane blocks -> rotate -> select: the
-                # unaligned 128-lane window [sx, sx+128) of plane c.
-                blk = a_ref[c, pl.ds(sy, thp), pl.ds(xq, 2), :]
-                rot = pltpu.roll(blk, rot_amt, 2)
-                al = jnp.where(
-                    lane < LANE - xr, rot[:, 0, :], rot[:, 1, :]
-                ).astype(jnp.float32)
-                dq = b_blk[c] - al
-                dq = dq * dq
-                # Separable window: static lane rolls then sublane rolls.
-                xs = jnp.zeros_like(dq)
-                for t, wgt in enumerate(sp.wx):
-                    dx = (t - r) * sp.dilation
-                    xs = xs + wgt * pltpu.roll(dq, (LANE - dx) % LANE, 1)
-                for t, wgt in enumerate(sp.wy):
-                    dy = (t - r) * sp.dilation
-                    d = d + wgt * pltpu.roll(xs, (thp - dy) % thp, 0)
+            def do_eval(carry):
+                best_d, best_y, best_x = carry
+                sy = jnp.clip(sy_g, row0, sy_max) - row0  # band-local
+                sx = jnp.clip(tx0 + ox, 0, sx_max)
+                xq = sx // LANE
+                xr = sx % LANE
+                rot_amt = (LANE - xr) % LANE
 
-            factor = jnp.where(k < K_COHERENT, 1.0, coh_factor)
-            accept = d * factor < best_d
-            best_d = jnp.where(accept, d, best_d)
-            best_y = jnp.where(accept, sy + row0 - ty0, best_y)
-            best_x = jnp.where(accept, sx - tx0, best_x)
-            return best_d, best_y, best_x
+                d = jnp.zeros((thp, LANE), jnp.float32)
+                for c in range(n_chan):
+                    sp = specs[c]
+                    r = len(sp.wy) // 2
+                    # Two adjacent lane blocks -> rotate -> select: the
+                    # unaligned 128-lane window [sx, sx+128) of plane c.
+                    blk = a_ref[c, pl.ds(sy, thp), pl.ds(xq, 2), :]
+                    rot = pltpu.roll(blk, rot_amt, 2)
+                    al = jnp.where(
+                        lane < LANE - xr, rot[:, 0, :], rot[:, 1, :]
+                    ).astype(jnp.float32)
+                    dq = b_blk[c] - al
+                    dq = dq * dq
+                    # Separable window: static lane then sublane rolls.
+                    xs = jnp.zeros_like(dq)
+                    for t, wgt in enumerate(sp.wx):
+                        dx = (t - r) * sp.dilation
+                        xs = xs + wgt * pltpu.roll(dq, (LANE - dx) % LANE, 1)
+                    for t, wgt in enumerate(sp.wy):
+                        dy = (t - r) * sp.dilation
+                        d = d + wgt * pltpu.roll(xs, (thp - dy) % thp, 0)
+
+                factor = jnp.where(k < K_COHERENT, 1.0, coh_factor)
+                accept = d * factor < best_d
+                best_d = jnp.where(accept, d, best_d)
+                best_y = jnp.where(accept, sy + row0 - ty0, best_y)
+                best_x = jnp.where(accept, sx - tx0, best_x)
+                return best_d, best_y, best_x
+
+            return jax.lax.cond(in_band, do_eval, lambda c: c, carry)
 
         best = jax.lax.fori_loop(
             0,
@@ -495,6 +525,17 @@ def tile_sweep(
     if band is None:
         band = jnp.asarray([0, ha], jnp.int32)
 
+    # Flatten the candidate tables to (n_tiles -> pad 8, K) for the
+    # 8-row SMEM blocking (see in_specs below).
+    n_tiles = n_ty * n_tx
+    pad8 = (-n_tiles) % 8
+    cand_y = jnp.pad(
+        cand_y.reshape(n_tiles, K_TOTAL), ((0, pad8), (0, 0))
+    )
+    cand_x = jnp.pad(
+        cand_x.reshape(n_tiles, K_TOTAL), ((0, pad8), (0, 0))
+    )
+
     kernel = _make_kernel(specs, geom, ha, wa, coh_factor)
     state_blk = lambda i, j: (i, j)  # noqa: E731
     out = pl.pallas_call(
@@ -504,15 +545,20 @@ def tile_sweep(
             # Band bounds (row0, rows_valid) as SMEM scalars: dynamic
             # operands, so one compiled kernel serves every band.
             pl.BlockSpec((2,), lambda i, j: (0,), memory_space=pltpu.SMEM),
-            # Whole candidate tables in SMEM (a few tens of KB): compiled
-            # Pallas requires full-array or (8,128)-divisible blocks, so
-            # the kernel indexes them by program_id instead of blocking.
+            # Candidate tables blocked into SMEM 8 tile-rows at a time:
+            # a whole-array window ((n_tiles, K) i32) overflows the 1 MB
+            # SMEM once the grid passes ~1300 tiles (4096^2 B'), and
+            # Mosaic requires the trailing block dims be 8/equal-
+            # divisible, so each grid step maps to the 8-row group
+            # containing its flat tile index and selects its row.
             pl.BlockSpec(
-                (n_ty, n_tx, K_TOTAL), lambda i, j: (0, 0, 0),
+                (8, K_TOTAL),
+                lambda i, j, _n_tx=n_tx: ((i * _n_tx + j) // 8, 0),
                 memory_space=pltpu.SMEM,
             ),
             pl.BlockSpec(
-                (n_ty, n_tx, K_TOTAL), lambda i, j: (0, 0, 0),
+                (8, K_TOTAL),
+                lambda i, j, _n_tx=n_tx: ((i * _n_tx + j) // 8, 0),
                 memory_space=pltpu.SMEM,
             ),
             pl.BlockSpec(
@@ -562,9 +608,15 @@ def vmem_estimate(specs, ha: int, wa: int, n_bands: int = 1) -> int:
 # ~1 MB; 9 MB keeps the headline config compiling with margin, and the
 # extra band it forces costs microseconds per sweep.
 VMEM_BUDGET = 9 * 1024 * 1024
-# Sweep cost scales with the band count; past this, the XLA gather path
-# is the better tool.
-MAX_BANDS = 8
+# Candidates are evaluated only in the band that contains them (the
+# kernel's in_band cond), so sweep COMPUTE does not scale with the band
+# count — only the fixed per-band-call costs do (B-tile/state traffic,
+# grid dispatch; measured ~1-2 ms per extra band call at 1024^2).  A
+# 4096^2 A side with coarse channels needs 33 bands to fit the VMEM
+# budget (vmem_estimate(coarse, 4096, 4096, 33) = 9.26 MB); 40 leaves a
+# little headroom beyond that design point.  Past this the per-call
+# overhead dominates and the XLA gather path is the better tool.
+MAX_BANDS = 40
 
 
 def _bands_needed(specs, ha: int, wa: int, budget: int) -> Optional[int]:

@@ -182,7 +182,7 @@ def tile_patchmatch(
     — passed through so dispatch and kernel cannot disagree.
     """
     from ..kernels.patchmatch_tile import (
-        band_rows,
+        band_bounds,
         channel_images,
         sample_candidates,
         tile_geometry,
@@ -195,7 +195,7 @@ def tile_patchmatch(
     ha, wa = f_a.shape[:2]
     f_a_flat = f_a.reshape(-1, f_a.shape[-1])
     specs, use_coarse, n_bands = plan
-    rows_b = band_rows(ha, n_bands)
+    bounds = band_bounds(ha, n_bands)
     geom = tile_geometry(h, w, specs)
     coh = kappa_factor(cfg.kappa, level)
 
@@ -235,10 +235,7 @@ def tile_patchmatch(
         )
         # One call per A band; the carried per-pixel best makes the union
         # over bands a global search (single call when A fits VMEM).
-        for bi, band_planes in enumerate(raw.a_planes):
-            band = jnp.asarray(
-                [bi * rows_b, min(rows_b, ha - bi * rows_b)], jnp.int32
-            )
+        for band_planes, band in zip(raw.a_planes, bounds):
             oy_b, ox_b, d_b = tile_sweep(
                 band_planes, b_blocked, cand_y, cand_x, oy_b, ox_b, d_b,
                 band,

@@ -373,6 +373,32 @@ class TestEligibility:
         # A too small for even one banded tile row: ineligible.
         assert plan_channels(1, 1, cfg, False, 128, 128, 32, 128) is None
 
+    def test_band_fallback_boundary(self):
+        """Pin exactly where the banded kernel hands off to the XLA
+        gather path as A grows (VMEM budget / MAX_BANDS geometry):
+        4096^2 keeps all four channels via 33 A-bands, 6144^2 drops to
+        fine-only, 8192^2 is gather-path territory."""
+        from image_analogies_tpu.kernels.patchmatch_tile import (
+            MAX_BANDS,
+            plan_channels,
+        )
+
+        cfg = SynthConfig()
+        expected = {
+            1024: (True, 3),    # all 4 channels, 3 A-bands
+            2048: (True, 9),
+            4096: (True, 33),   # the MAX_BANDS=40 design point
+            6144: (False, 35),  # coarse would need > MAX_BANDS bands
+        }
+        for size, (use_coarse, n_bands) in expected.items():
+            plan = plan_channels(1, 1, cfg, True, size, size, size, size)
+            assert plan is not None, size
+            assert (plan[1], plan[2]) == (use_coarse, n_bands), (
+                size, plan[1], plan[2],
+            )
+            assert plan[2] <= MAX_BANDS
+        assert plan_channels(1, 1, cfg, True, 8192, 8192, 8192, 8192) is None
+
 
 class TestKernelMatcherPath:
     """Full matcher dispatch with raw planes (interpret mode)."""
