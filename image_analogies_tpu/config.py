@@ -78,6 +78,15 @@ class SynthConfig:
     # (CPU tests; catches OOB indexing — SURVEY.md §5 sanitizers).
     pallas_mode: str = "auto"
 
+    # Estimated f32 feature-table HBM bytes above which a
+    # kernel-eligible level switches to the LEAN path: feature tables
+    # are assembled chunk-wise into bf16 (halving the lane-padded
+    # table cost that OOMs at 4096^2+ — models/analogy.py
+    # `_feature_table_bytes`), distance evaluations are chunked, and
+    # the NN field is carried as (H, W) planes.  Same staging and
+    # metric as the standard kernel path, up to bf16 quantization.
+    feature_bytes_budget: int = 6 * 1024**3
+
     # Brute-force matcher query chunk (rows of the distance matrix computed
     # per step; bounds peak HBM for the (chunk, N_A) distance tile).
     brute_chunk: int = 4096
@@ -112,6 +121,8 @@ class SynthConfig:
             raise ValueError(f"unknown pallas_mode {self.pallas_mode!r}")
         if self.pca_dims is not None and self.pca_dims < 1:
             raise ValueError("pca_dims must be >= 1 (or None to disable)")
+        if self.feature_bytes_budget < 1:
+            raise ValueError("feature_bytes_budget must be >= 1")
         if self.ann_eps < 0.0:
             raise ValueError("ann_eps must be >= 0")
 

@@ -87,15 +87,81 @@ def upsample_nnf(nnf: jnp.ndarray, target_shape, ha: int, wa: int) -> jnp.ndarra
     return clamp_nnf(up, ha, wa)
 
 
-def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool):
+def upsample_nnf_planes(py, px, target_shape, ha: int, wa: int):
+    """`upsample_nnf` for the lean plane-pair field: same doubling +
+    child parity, per (H, W) plane — a stacked (H, W, 2) int32 array
+    pads its trailing dim 2 -> 128 lanes on TPU (8 GB at 4096^2), so
+    lean levels never stack the field (patchmatch_sweeps_lean)."""
+    h, w = target_shape
+    uy = jnp.repeat(jnp.repeat(py, 2, axis=0), 2, axis=1)[:h, :w] * 2
+    ux = jnp.repeat(jnp.repeat(px, 2, axis=0), 2, axis=1)[:h, :w] * 2
+    uy = uy + jax.lax.broadcasted_iota(jnp.int32, (h, w), 0) % 2
+    ux = ux + jax.lax.broadcasted_iota(jnp.int32, (h, w), 1) % 2
+    return jnp.clip(uy, 0, ha - 1), jnp.clip(ux, 0, wa - 1)
+
+
+def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
+                 lean: bool = False):
     """One EM step at one pyramid level: features -> match -> render.
 
     Pure function of its array arguments (vmap-able over a frame axis for
     the batched runner, SURVEY.md C15).  With `cfg.pca_dims`, `f_a` is
     the already-projected database and `proj` the (D, k) basis applied to
     the B-side features in-step (Hertzmann §3.1 PCA).
+
+    `lean=True` (driver-selected for levels whose ROW-MAJOR feature
+    tables would not fit HBM, see `_feature_table_bytes`): feature
+    tables are assembled chunk-wise into bf16 form
+    (`assemble_features_lean` — the f_a slot carries the A-side table)
+    and distance evaluations are chunked, so the output contract
+    matches the standard kernel path up to bf16 quantization.
     """
     matcher = get_matcher(cfg.matcher)
+
+    if lean:
+        from ..kernels import resolve_pallas
+        from ..kernels.patchmatch_tile import plan_channels
+        from .patchmatch import RawPlanes, tile_patchmatch_lean
+
+        def em_step_lean(src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf,
+                         key, proj=None, a_planes=None):
+            # In lean steps the f_a slot carries the (Na, D) bf16
+            # A-side table (assemble_features_lean), and the nnf slot a
+            # (py, px) plane pair; the B-side table is assembled
+            # in-step, chunk-wise, in the same layout.
+            py, px = nnf
+            h, w = src_b.shape[:2]
+            ha, wa = copy_a.shape[:2]
+            n_src = 1 if src_b.ndim == 2 else src_b.shape[-1]
+            n_flt = 1 if flt_b.ndim == 2 else flt_b.shape[-1]
+            plan = plan_channels(n_src, n_flt, cfg, has_coarse, h, w, ha, wa)
+            f_b_tab = assemble_features_lean(
+                src_b,
+                flt_b,
+                cfg,
+                src_b_c if has_coarse else None,
+                flt_b_c if has_coarse else None,
+            )
+            raw = RawPlanes(
+                src_b,
+                flt_b,
+                src_b_c if has_coarse else None,
+                flt_b_c if has_coarse else None,
+                a_planes,
+            )
+            py, px, dist = tile_patchmatch_lean(
+                f_b_tab, f_a, py, px, key, raw=raw, cfg=cfg, level=level,
+                interpret=bool(resolve_pallas(cfg)), plan=plan,
+                ha=ha, wa=wa,
+            )
+            flat = copy_a.reshape(ha * wa, -1)
+            out = jnp.take(
+                flat, (py * wa + px).reshape(-1), axis=0
+            ).reshape(h, w, -1)
+            bp = out[..., 0] if copy_a.ndim == 2 else out
+            return (py, px), dist, bp
+
+        return em_step_lean
 
     def em_step(src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf, key,
                 proj=None, a_planes=None):
@@ -129,9 +195,96 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool):
 
 
 @functools.lru_cache(maxsize=64)
-def _em_step_fn(cfg: SynthConfig, level: int, has_coarse: bool):
+def _em_step_fn(cfg: SynthConfig, level: int, has_coarse: bool,
+                lean: bool = False):
     """Compiled EM step for one pyramid level (cached per config+level)."""
-    return jax.jit(make_em_step(cfg, level, has_coarse))
+    return jax.jit(make_em_step(cfg, level, has_coarse, lean))
+
+
+def _feature_table_bytes(h: int, w: int, ha: int, wa: int) -> int:
+    """HBM cost estimate of the assembled feature tables at a level.
+
+    TPU lays an (N, D) f32 table out as T(8, 128) tiles, so any D <= 128
+    costs N * 128 * 4 bytes regardless of the logical D — at 4096^2 the
+    two tables alone are ~17 GB against 16 GB of HBM (and the im2col
+    temps are larger still), which is what the lean path exists for."""
+    return (h * w + ha * wa) * 128 * 4
+
+
+# Lean-path feature chunking: rows of B (or A) assembled per slab, which
+# bounds the im2col temps; bf16 halves the resident table cost at a
+# quantization the polish's accept tests absorb.
+_LEAN_CHUNK_ROWS = 256
+_LEAN_TABLE_DTYPE = jnp.bfloat16
+
+
+def assemble_features_lean(src, flt, cfg: SynthConfig, src_c, flt_c):
+    """Feature table assembled slab-by-slab into one (N, D) bf16 buffer.
+
+    A whole-image f32 assembly is unaffordable at 4096^2 twice over:
+    the T(8, 128) layout pads D to 128 lanes (8.5 GB per table) and the
+    im2col materializes multi-GB temps.  This variant splits the image
+    into row slabs with window halos (the same geometry the spatial
+    runner proves bit-exact) and a `fori_loop` writes each slab's rows
+    straight into the single bf16 buffer, so peak memory is the 4.3 GB
+    table plus one slab's temps.  bf16 row-major is deliberate: it is
+    the layout XLA's gathers want (forcing a (D, N) layout was measured
+    to re-materialize relayout copies bigger than the saving).
+
+    Matches `assemble_features` exactly up to the bf16 cast (slab cores
+    with halo >= window reach see identical windows)."""
+    from ..parallel.spatial import _split_slabs, slab_halo
+
+    h, w = src.shape[:2]
+    halo = slab_halo(cfg)
+    n_chunks = max(1, -(-h // _LEAN_CHUNK_ROWS))
+    grain = n_chunks * 2
+    pad_h = (-h) % grain
+
+    def padded(x, scale=1):
+        p = [(0, pad_h // scale)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, p, mode="edge") if pad_h else x
+
+    has_coarse = src_c is not None
+    slabs = [
+        _split_slabs(padded(src), n_chunks, halo),
+        _split_slabs(padded(flt), n_chunks, halo),
+    ]
+    if has_coarse:
+        slabs += [
+            _split_slabs(padded(src_c, 2), n_chunks, halo // 2),
+            _split_slabs(padded(flt_c, 2), n_chunks, halo // 2),
+        ]
+
+    def one(slab):
+        s_src, s_flt = slab[0], slab[1]
+        s_src_c = slab[2] if has_coarse else None
+        s_flt_c = slab[3] if has_coarse else None
+        f = assemble_features(s_src, s_flt, cfg, s_src_c, s_flt_c)
+        core = f[halo : f.shape[0] - halo]
+        return core.reshape(-1, core.shape[-1]).astype(_LEAN_TABLE_DTYPE)
+
+    slab_stacks = tuple(slabs)
+    d_feat = jax.eval_shape(
+        one, tuple(jax.ShapeDtypeStruct(s.shape[1:], s.dtype) for s in slab_stacks)
+    ).shape[1]
+    rows_core = slab_stacks[0].shape[1] - 2 * halo
+    rw = rows_core * w
+
+    def body(i, f_tab):
+        slab = tuple(
+            jax.lax.dynamic_index_in_dim(s, i, keepdims=False)
+            for s in slab_stacks
+        )
+        return jax.lax.dynamic_update_slice(f_tab, one(slab), (i * rw, 0))
+
+    f_tab = jax.lax.fori_loop(
+        0,
+        n_chunks,
+        body,
+        jnp.zeros((n_chunks * rw, d_feat), _LEAN_TABLE_DTYPE),
+    )
+    return f_tab[: h * w]
 
 
 def _maybe_a_planes(cfg, pyr_src_a, pyr_flt_a, level, has_coarse, b_shape):
@@ -195,7 +348,9 @@ def create_image_analogy(
 
     `a`, `ap`, `b`: float arrays in [0,1], (H,W,3) RGB or (H,W) gray; `a`
     and `ap` must share a shape.  Returns B' shaped like `b` (or a dict of
-    auxiliary per-level artifacts when `return_aux`).  `progress` is an
+    auxiliary per-level artifacts when `return_aux`; at lean levels —
+    past cfg.feature_bytes_budget — the per-level `nnf` entry is a
+    (py, px) plane pair rather than a stacked (H, W, 2) array).  `progress` is an
     optional utils.progress.ProgressWriter: one timed `level_done` event
     per pyramid level (SURVEY.md §5 metrics/observability).
 
@@ -251,31 +406,75 @@ def create_image_analogy(
         ha, wa = f_a_src.shape[:2]
         has_coarse = level < levels - 1
 
-        f_a = assemble_features(
-            f_a_src,
-            pyr_flt_a[level],
-            cfg,
-            pyr_src_a[level + 1] if has_coarse else None,
-            pyr_flt_a[level + 1] if has_coarse else None,
-        )
-        f_a, proj = pca_fit_and_project(f_a, cfg.pca_dims)
-
         a_planes = _maybe_a_planes(
             cfg, pyr_src_a, pyr_flt_a, level, has_coarse, (h, w)
         )
+        # Lean levels never materialize the (N, D) feature tables — the
+        # decision must precede assembly (assembly is what OOMs).
+        lean = (
+            a_planes is not None
+            and _feature_table_bytes(h, w, ha, wa) > cfg.feature_bytes_budget
+        )
+        if lean:
+            if cfg.pca_dims:
+                import logging
+
+                logging.getLogger("image_analogies_tpu").warning(
+                    "level %d exceeds feature_bytes_budget: lean path "
+                    "matches in full-D bf16 space, pca_dims=%s is not "
+                    "applied at this level", level, cfg.pca_dims,
+                )
+            # The (Na, D) bf16 table rides in the f_a slot (see
+            # em_step_lean); no f32 whole-image table is ever assembled.
+            f_a = assemble_features_lean(
+                f_a_src,
+                pyr_flt_a[level],
+                cfg,
+                pyr_src_a[level + 1] if has_coarse else None,
+                pyr_flt_a[level + 1] if has_coarse else None,
+            )
+            proj = None
+        else:
+            f_a = assemble_features(
+                f_a_src,
+                pyr_flt_a[level],
+                cfg,
+                pyr_src_a[level + 1] if has_coarse else None,
+                pyr_flt_a[level + 1] if has_coarse else None,
+            )
+            f_a, proj = pca_fit_and_project(f_a, cfg.pca_dims)
 
         level_key = jax.random.fold_in(key, level)
         if has_coarse:
-            nnf = upsample_nnf(nnf, (h, w), ha, wa)
+            if lean:
+                # Lean levels carry the field as (py, px) planes; the
+                # parent is either already planes (lean-ness is
+                # monotone in level size) or a small stacked field from
+                # the last normal level / a resume checkpoint.
+                p_py, p_px = (
+                    nnf if isinstance(nnf, tuple)
+                    else (nnf[..., 0], nnf[..., 1])
+                )
+                nnf = upsample_nnf_planes(p_py, p_px, (h, w), ha, wa)
+            elif isinstance(nnf, tuple):
+                # Lean parent feeding a non-lean finer level (kernel
+                # eligibility can lapse as A outgrows MAX_BANDS):
+                # upsample per plane, stack for the standard step.
+                uy, ux = upsample_nnf_planes(nnf[0], nnf[1], (h, w), ha, wa)
+                nnf = jnp.stack([uy, ux], axis=-1)
+            else:
+                nnf = upsample_nnf(nnf, (h, w), ha, wa)
             flt_bp_coarse = flt_bp
             flt_bp = upsample(flt_bp, (h, w))
             bp = upsample(bp, (h, w))
         else:
             nnf = random_init(level_key, h, w, ha, wa)
+            if lean:  # only reachable with a forced-tiny budget (tests)
+                nnf = (nnf[..., 0], nnf[..., 1])
             flt_bp = pyr_raw_b[level]
             bp = pyr_copy_a[level]  # overwritten by first render
 
-        step = _em_step_fn(cfg, level, has_coarse)
+        step = _em_step_fn(cfg, level, has_coarse, lean)
         for em in range(cfg.em_iters):
             args = (
                 pyr_src_b[level],
@@ -312,8 +511,17 @@ def create_image_analogy(
                 nnf_energy=nnf_energy,
             )
         if cfg.save_level_artifacts:
+            nnf_save = nnf
+            if isinstance(nnf, tuple):
+                # Stack the lean plane pair on the HOST: checkpoints
+                # keep the standard (H, W, 2) schema without ever
+                # materializing the lane-padded stack on device.
+                nnf_save = np.stack(
+                    [np.asarray(nnf[0]), np.asarray(nnf[1])], axis=-1
+                )
             _save_level(
-                cfg.save_level_artifacts, level, nnf, dist, bp, cfg, b.shape
+                cfg.save_level_artifacts, level, nnf_save, dist, bp, cfg,
+                b.shape,
             )
 
     out = _finalize(bp, yiq_b, b, cfg)

@@ -82,6 +82,39 @@ def nnf_dist(
     return candidate_dist(f_b.reshape(-1, d), f_a_flat, idx).reshape(h, w)
 
 
+def candidate_dist_lean(
+    f_b_tab: jnp.ndarray,
+    f_a_tab: jnp.ndarray,
+    idx: jnp.ndarray,
+    chunk: int = 1 << 20,
+) -> jnp.ndarray:
+    """`candidate_dist` for the lean path: bf16 tables, evaluated in
+    pixel chunks under `lax.map`.
+
+    At 4096^2 a whole-field evaluation materializes the gathered A rows
+    as an (N, 128-lane-padded) array — 4 GB bf16 — on top of the two
+    resident tables; chunking keeps that temp at `chunk` rows.  Both
+    sides are fetched with per-element-clipped gathers (the padded tail
+    of the last chunk reads row 0 and is discarded), and distances
+    accumulate in f32 regardless of table dtype."""
+    n = idx.shape[0]
+    chunk = min(chunk, n)
+    n_chunks = -(-n // chunk)
+    idx_p = jnp.pad(idx, (0, n_chunks * chunk - n)).reshape(n_chunks, chunk)
+    b_ix = (
+        jnp.arange(n_chunks)[:, None] * chunk + jnp.arange(chunk)[None, :]
+    )
+
+    def one(args):
+        ix, bx = args
+        rows_a = jnp.take(f_a_tab, ix, axis=0).astype(jnp.float32)
+        rows_b = jnp.take(f_b_tab, bx, axis=0).astype(jnp.float32)
+        return jnp.sum((rows_b - rows_a) ** 2, axis=-1)
+
+    d = jax.lax.map(one, (idx_p, b_ix))
+    return d.reshape(-1)[:n]
+
+
 # ---------------------------------------------------------------------------
 # Registry
 

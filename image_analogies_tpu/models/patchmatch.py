@@ -38,6 +38,7 @@ from ..config import SynthConfig
 from .matcher import (
     Matcher,
     candidate_dist,
+    candidate_dist_lean,
     clamp_nnf,
     flat_to_nnf,
     nnf_dist,
@@ -258,6 +259,194 @@ def tile_patchmatch(
         f_a,
         nnf_m,
         jax.random.fold_in(key, cfg.pm_iters),
+        iters=cfg.pm_polish_iters,
+        n_random=cfg.pm_polish_random,
+        coh_factor=coh,
+    )
+
+
+def patchmatch_sweeps_lean(
+    f_b_tab: jnp.ndarray,
+    f_a_tab: jnp.ndarray,
+    py: jnp.ndarray,
+    px: jnp.ndarray,
+    key: jax.Array,
+    *,
+    ha: int,
+    wa: int,
+    iters: int,
+    n_random: int,
+    coh_factor: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """`patchmatch_sweeps` over the lean (N, D) bf16 tables and a
+    PLANE-PAIR field; returns (py, px, dist).
+
+    Same sweep structure, candidates, kappa rule, and canonical
+    tie-breaking as the full-precision twin, with two memory changes
+    that make 4096^2+ affordable: distances go through
+    `candidate_dist_lean` (bf16 tables, chunk-wise evaluation so the
+    gathered-rows temp never reaches field size), and the field is
+    carried as separate (H, W) int32 planes — a stacked (H, W, 2) array
+    tiles as T(8, 128) on its trailing dims, padding 2 -> 128 lanes
+    (64x, 8 GB at 4096^2).
+    """
+    h, w = py.shape
+    py = jnp.clip(py, 0, ha - 1)
+    px = jnp.clip(px, 0, wa - 1)
+    dist = candidate_dist_lean(
+        f_b_tab, f_a_tab, (py * wa + px).reshape(-1)
+    ).reshape(h, w)
+
+    max_radius = max(ha, wa)
+    radii = [max(1, int(max_radius * (0.5**s))) for s in range(n_random)]
+
+    def try_candidates(state, cy, cx, factor):
+        py_c, px_c, dist_cur = state
+        cy = jnp.clip(cy, 0, ha - 1)
+        cx = jnp.clip(cx, 0, wa - 1)
+        idx = cy * wa + cx
+        d_cand = candidate_dist_lean(
+            f_b_tab, f_a_tab, idx.reshape(-1)
+        ).reshape(h, w)
+        idx_cur = py_c * wa + px_c
+        better = d_cand * factor < dist_cur
+        tie_lower = (d_cand == dist_cur) & (idx < idx_cur)
+        accept = better | tie_lower
+        return (
+            jnp.where(accept, cy, py_c),
+            jnp.where(accept, cx, px_c),
+            jnp.where(accept, d_cand, dist_cur),
+        )
+
+    def sweep(state, it_key):
+        for dy, dx in _DELTAS:
+            cy = jnp.roll(state[0], (dy, dx), (0, 1)) + dy
+            cx = jnp.roll(state[1], (dy, dx), (0, 1)) + dx
+            state = try_candidates(state, cy, cx, 1.0)
+        for dy, dx in _DELTAS:
+            cy = jnp.roll(state[0], (dy, dx), (0, 1))
+            cx = jnp.roll(state[1], (dy, dx), (0, 1))
+            state = try_candidates(state, cy, cx, 1.0)
+        keys = jax.random.split(it_key, len(radii))
+        for r, rk in zip(radii, keys):
+            ky, kx = jax.random.split(rk)
+            oy = jax.random.randint(ky, (h, w), -r, r + 1)
+            ox = jax.random.randint(kx, (h, w), -r, r + 1)
+            state = try_candidates(
+                state, state[0] + oy, state[1] + ox, coh_factor
+            )
+        return state, None
+
+    (py, px, dist), _ = jax.lax.scan(
+        sweep, (py, px, dist), jax.random.split(key, iters)
+    )
+    return py, px, dist
+
+
+def tile_patchmatch_lean(
+    f_b_tab: jnp.ndarray,
+    f_a_tab: jnp.ndarray,
+    py: jnp.ndarray,
+    px: jnp.ndarray,
+    key: jax.Array,
+    *,
+    raw: RawPlanes,
+    cfg: SynthConfig,
+    level: int,
+    interpret: bool,
+    plan,
+    ha: int,
+    wa: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """PatchMatch for levels whose ROW-MAJOR feature tables would not
+    fit HBM (models/analogy.py `_feature_table_bytes`); the field is a
+    (py, px) plane pair in and out (returns (py, px, dist)).
+
+    Identical staging to `tile_patchmatch` — kernel bulk search in the
+    raw-plane metric, exact-feature-metric merge, per-pixel polish —
+    with the lean memory rules: feature tables are bf16 and assembled
+    chunk-wise (models/analogy.py `assemble_features_lean`), distance
+    evaluations are chunked (matcher.candidate_dist_lean), and the
+    field stays in (H, W) planes (a stacked (H, W, 2) int32 pads
+    2 -> 128 lanes = 8 GB at 4096^2).
+    Output contract matches the standard kernel path up to bf16
+    quantization of the features.
+    """
+    from ..kernels.patchmatch_tile import (
+        band_bounds,
+        channel_images,
+        sample_candidates,
+        tile_geometry,
+        tile_sweep,
+        to_blocked,
+        from_blocked,
+    )
+
+    h, w = raw.src_b.shape[:2]
+    specs, use_coarse, n_bands = plan
+    bounds = band_bounds(ha, n_bands)
+    geom = tile_geometry(h, w, specs)
+    coh = kappa_factor(cfg.kappa, level)
+
+    chans_b = channel_images(
+        raw.src_b,
+        raw.flt_b,
+        raw.src_b_coarse if use_coarse else None,
+        raw.flt_b_coarse if use_coarse else None,
+    )
+    b_blocked = jnp.stack(
+        [to_blocked(c.astype(jnp.float32), geom) for c in chans_b]
+    )
+
+    py = jnp.clip(py, 0, ha - 1)
+    px = jnp.clip(px, 0, wa - 1)
+    qy = jax.lax.broadcasted_iota(jnp.int32, (h, w), 0)
+    qx = jax.lax.broadcasted_iota(jnp.int32, (h, w), 1)
+    off_y = py - qy
+    off_x = px - qx
+    dist0 = candidate_dist_lean(
+        f_b_tab, f_a_tab, (py * wa + px).reshape(-1)
+    ).reshape(h, w)
+
+    oy_b = to_blocked(off_y, geom)
+    ox_b = to_blocked(off_x, geom)
+    # Kernel-metric incumbents start at +inf, exactly as in
+    # tile_patchmatch: the raw-plane metric and the feature metric must
+    # not be mixed in one accept test.
+    d_b = jnp.full(
+        (geom.n_ty * geom.thp, geom.n_tx * 128), jnp.inf, jnp.float32
+    )
+    for t in range(cfg.pm_iters):
+        cand_y, cand_x = sample_candidates(
+            off_y, off_x, jax.random.fold_in(key, t), geom, ha, wa
+        )
+        for band_planes, band in zip(raw.a_planes, bounds):
+            oy_b, ox_b, d_b = tile_sweep(
+                band_planes, b_blocked, cand_y, cand_x, oy_b, ox_b, d_b,
+                band,
+                specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=coh,
+                interpret=interpret,
+            )
+        off_y = from_blocked(oy_b, geom, h, w)
+        off_x = from_blocked(ox_b, geom, h, w)
+
+    ky = jnp.clip(qy + off_y, 0, ha - 1)
+    kx = jnp.clip(qx + off_x, 0, wa - 1)
+    # Exact-metric merge: adopt the kernel's match only where it wins.
+    d_k = candidate_dist_lean(
+        f_b_tab, f_a_tab, (ky * wa + kx).reshape(-1)
+    ).reshape(h, w)
+    better = d_k < dist0
+    py_m = jnp.where(better, ky, py)
+    px_m = jnp.where(better, kx, px)
+    return patchmatch_sweeps_lean(
+        f_b_tab,
+        f_a_tab,
+        py_m,
+        px_m,
+        jax.random.fold_in(key, cfg.pm_iters),
+        ha=ha,
+        wa=wa,
         iters=cfg.pm_polish_iters,
         n_random=cfg.pm_polish_random,
         coh_factor=coh,

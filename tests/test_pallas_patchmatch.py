@@ -518,6 +518,129 @@ class TestBandedStreaming:
         assert float(d_2.mean()) <= 1.25 * float(d_1.mean())
 
 
+class TestLeanPath:
+    """Kernel-only EM steps for levels past the feature-table budget
+    (cfg.feature_bytes_budget): no (N, D) tables are ever assembled."""
+
+    def _abp(self, rng):
+        a = rng.random((128, 128))
+        k = np.ones(13) / 13.0
+        for _ in range(3):
+            a = np.apply_along_axis(
+                lambda r: np.convolve(r, k, mode="same"), 1, a
+            )
+            a = np.apply_along_axis(
+                lambda c: np.convolve(c, k, mode="same"), 0, a
+            )
+        a = ((a - a.min()) / (a.max() - a.min())).astype(np.float32)
+        ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
+        b = np.ascontiguousarray(a[:, ::-1], np.float32)
+        return a, ap, b
+
+    def test_lean_uses_chunked_tables_and_tracks_oracle(self, rng):
+        from unittest import mock
+
+        from image_analogies_tpu import create_image_analogy, psnr
+        import image_analogies_tpu.models.analogy as an_mod
+
+        a, ap, b = self._abp(rng)
+        kw = dict(
+            levels=1, matcher="patchmatch", pallas_mode="interpret",
+            em_iters=1, pm_iters=3,
+        )
+        oracle = np.asarray(
+            create_image_analogy(
+                a, ap, b, SynthConfig(levels=1, matcher="brute", em_iters=1)
+            )
+        )
+        normal = np.asarray(
+            create_image_analogy(a, ap, b, SynthConfig(**kw))
+        )
+
+        calls = []
+        real = an_mod.assemble_features_lean
+
+        def counting(*args, **kwargs):
+            calls.append(1)
+            return real(*args, **kwargs)
+
+        with mock.patch.object(an_mod, "assemble_features_lean", counting):
+            lean = np.asarray(
+                create_image_analogy(
+                    a, ap, b, SynthConfig(feature_bytes_budget=1, **kw)
+                )
+            )
+        # Both sides (A in the driver, B in-step) go through the
+        # transposed chunked assembly.
+        assert len(calls) >= 2, calls
+        # Same staging as the standard kernel path, bf16 tables: lean
+        # must track the normal path closely against the brute oracle.
+        p_lean, p_norm = psnr(lean, oracle), psnr(normal, oracle)
+        assert p_lean > 25.0, (p_lean, p_norm)
+        assert p_lean > p_norm - 3.0, (p_lean, p_norm)
+
+    def test_lean_assembly_matches_full(self, rng):
+        """assemble_features_lean must equal assemble_features exactly
+        up to the bf16 cast — with and without the coarse block, at
+        sizes that exercise slab padding."""
+        import jax.numpy as jnp
+
+        from image_analogies_tpu.models.analogy import assemble_features_lean
+        from image_analogies_tpu.ops.features import assemble_features
+
+        cfg = SynthConfig()
+        for h, w, coarse in [(40, 24, False), (52, 16, True)]:
+            src = jnp.asarray(rng.random((h, w)).astype(np.float32))
+            flt = jnp.asarray(rng.random((h, w)).astype(np.float32))
+            src_c = flt_c = None
+            if coarse:
+                src_c = jnp.asarray(
+                    rng.random((h // 2, w // 2)).astype(np.float32)
+                )
+                flt_c = jnp.asarray(
+                    rng.random((h // 2, w // 2)).astype(np.float32)
+                )
+            want = np.asarray(
+                assemble_features(src, flt, cfg, src_c, flt_c)
+            ).reshape(h * w, -1).astype(np.float32)
+            # Force multiple slabs even at test sizes.
+            import image_analogies_tpu.models.analogy as an_mod
+            from unittest import mock
+
+            with mock.patch.object(an_mod, "_LEAN_CHUNK_ROWS", 16):
+                got = np.asarray(
+                    assemble_features_lean(src, flt, cfg, src_c, flt_c)
+                ).astype(np.float32)
+            bf16 = want.astype(jnp.bfloat16).astype(np.float32)
+            np.testing.assert_array_equal(got, bf16)
+
+    def test_default_budget_keeps_small_levels_exact(self, rng):
+        """128^2 levels are far below the default budget: the normal
+        (exact-metric) path must still be selected."""
+        from unittest import mock
+
+        from image_analogies_tpu import create_image_analogy
+        import image_analogies_tpu.models.analogy as an_mod
+
+        a, ap, b = self._abp(rng)
+        calls = []
+        real = an_mod.assemble_features
+
+        def counting(*args, **kwargs):
+            calls.append(1)
+            return real(*args, **kwargs)
+
+        with mock.patch.object(an_mod, "assemble_features", counting):
+            create_image_analogy(
+                a, ap, b,
+                SynthConfig(
+                    levels=1, matcher="patchmatch",
+                    pallas_mode="interpret", em_iters=1, pm_iters=2,
+                ),
+            )
+        assert calls, "default budget must keep the exact-metric path"
+
+
 class TestBatchedKernelPath:
     def test_batch_runner_uses_kernel_under_vmap(self, rng):
         """The tile kernel must batch under vmap + mesh sharding (the
