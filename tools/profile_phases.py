@@ -1,0 +1,229 @@
+"""Phase attribution of the headline 1024^2 run (VERDICT r2 task 1/2).
+
+Times each pipeline phase in isolation at the headline level-0 geometry,
+plus the full per-level EM steps, each warmed and synced with the scalar
+readback barrier bench.py uses.  Prints a JSON breakdown.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from image_analogies_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+from image_analogies_tpu import SynthConfig
+from image_analogies_tpu.models.analogy import (
+    _em_step_fn,
+    _gather_image,
+    _maybe_a_planes,
+    _resolve_channels,
+    _with_steerable,
+)
+from image_analogies_tpu.models.matcher import nnf_dist
+from image_analogies_tpu.models.patchmatch import (
+    patchmatch_sweeps,
+    random_init,
+)
+from image_analogies_tpu.ops.features import assemble_features
+from image_analogies_tpu.ops.pyramid import build_pyramid
+from image_analogies_tpu.utils.examples import super_resolution
+from image_analogies_tpu.kernels.patchmatch_tile import (
+    band_bounds,
+    plan_channels,
+    prepare_a_planes,
+    sample_candidates,
+    tile_geometry,
+    tile_sweep,
+    to_blocked,
+    from_blocked,
+)
+
+
+def _sync(x) -> float:
+    return float(jnp.sum(x))
+
+
+def timeit(fn, *args, reps=5, **kw):
+    out = fn(*args, **kw)
+    _sync(jax.tree_util.tree_leaves(out)[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args, **kw)
+    _sync(jax.tree_util.tree_leaves(out)[0])
+    return (time.perf_counter() - t0) / reps * 1000  # ms
+
+
+def main():
+    size, levels = 1024, 5
+    cfg = SynthConfig(
+        levels=levels, matcher="patchmatch", em_iters=2, pm_iters=6,
+        pm_random_candidates=6,
+    )
+    a, ap, b = super_resolution(size)
+    a = jnp.asarray(a, jnp.float32)
+    ap = jnp.asarray(ap, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+
+    res = {}
+
+    # Sync overhead itself (tunnel round-trip floor).
+    tiny = jnp.zeros(())
+    _sync(tiny)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        _sync(tiny)
+    res["sync_roundtrip_ms"] = (time.perf_counter() - t0) / 10 * 1000
+
+    # P1: prologue (channel resolve + 5 pyramids + steerable), eager.
+    def prologue():
+        src_a, flt_a, src_b, copy_a, yiq_b = _resolve_channels(a, ap, b, cfg)
+        pyr_src_a = [_with_steerable(x, cfg) for x in build_pyramid(src_a, levels)]
+        pyr_flt_a = build_pyramid(flt_a, levels)
+        pyr_src_b = [_with_steerable(x, cfg) for x in build_pyramid(src_b, levels)]
+        pyr_copy_a = build_pyramid(copy_a, levels)
+        pyr_raw_b = build_pyramid(src_b, levels)
+        return pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, pyr_raw_b
+
+    out = prologue()
+    _sync(out[0][0])
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = prologue()
+    _sync(out[0][0])
+    res["prologue_eager_ms"] = (time.perf_counter() - t0) / 3 * 1000
+    pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, pyr_raw_b = out
+
+    # Level-0 geometry pieces.
+    level = 0
+    h = w = ha = wa = size
+    src_b0, flt_b0 = pyr_src_b[0], pyr_raw_b[0]
+    src_bc, flt_bc = pyr_src_b[1], pyr_raw_b[1]
+
+    af = jax.jit(lambda s, f, sc, fc: assemble_features(s, f, cfg, sc, fc))
+    res["assemble_features_1024_ms"] = timeit(af, src_b0, flt_b0, src_bc, flt_bc)
+    f_b = af(src_b0, flt_b0, src_bc, flt_bc)
+    f_a = af(pyr_src_a[0], pyr_flt_a[0], pyr_src_a[1], pyr_flt_a[1])
+    f_a_flat = f_a.reshape(-1, f_a.shape[-1])
+    res["feat_D"] = int(f_b.shape[-1])
+
+    plan = plan_channels(1, 1, cfg, True, h, w, ha, wa)
+    specs, use_coarse, n_bands = plan
+    geom = tile_geometry(h, w, specs)
+    res["n_bands"] = n_bands
+
+    res["prepare_a_planes_ms"] = timeit(
+        prepare_a_planes, pyr_src_a[0], pyr_flt_a[0], pyr_src_a[1],
+        pyr_flt_a[1], specs, n_bands=n_bands,
+    )
+    a_planes = prepare_a_planes(
+        pyr_src_a[0], pyr_flt_a[0], pyr_src_a[1], pyr_flt_a[1], specs,
+        n_bands=n_bands,
+    )
+
+    from image_analogies_tpu.kernels.patchmatch_tile import channel_images
+
+    @jax.jit
+    def blocked_prep(src, flt, sc, fc, off_y, off_x):
+        chans = channel_images(src, flt, sc, fc)
+        b_blocked = jnp.stack(
+            [to_blocked(c.astype(jnp.float32), geom) for c in chans]
+        )
+        oy_b = to_blocked(off_y, geom)
+        ox_b = to_blocked(off_x, geom)
+        return b_blocked, oy_b, ox_b
+
+    nnf = random_init(jax.random.PRNGKey(0), h, w, ha, wa)
+    off_y = nnf[..., 0] - jax.lax.broadcasted_iota(jnp.int32, (h, w), 0)
+    off_x = nnf[..., 1] - jax.lax.broadcasted_iota(jnp.int32, (h, w), 1)
+    res["to_blocked_prep_ms"] = timeit(
+        blocked_prep, src_b0, flt_b0, src_bc, flt_bc, off_y, off_x
+    )
+    b_blocked, oy_b, ox_b = blocked_prep(
+        src_b0, flt_b0, src_bc, flt_bc, off_y, off_x
+    )
+
+    sc_j = jax.jit(
+        lambda oy, ox, k: sample_candidates(oy, ox, k, geom, ha, wa)
+    )
+    res["sample_candidates_ms"] = timeit(
+        sc_j, off_y, off_x, jax.random.PRNGKey(1)
+    )
+    cand_y, cand_x, cand_valid = sc_j(off_y, off_x, jax.random.PRNGKey(1))
+
+    bounds = band_bounds(ha, n_bands)
+    d_b = jnp.full((geom.n_ty * geom.thp, geom.n_tx * 128), jnp.inf, jnp.float32)
+
+    def one_sweep(oy, ox, d):
+        for band_planes, band in zip(a_planes, bounds):
+            oy, ox, d = tile_sweep(
+                band_planes, b_blocked, cand_y, cand_x, oy, ox, d, band,
+                cand_valid,
+                specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=1.0,
+            )
+        return oy, ox, d
+
+    res["tile_sweep_all_bands_ms"] = timeit(one_sweep, oy_b, ox_b, d_b)
+
+    fb_j = jax.jit(
+        lambda x: (from_blocked(x, geom, h, w), from_blocked(x, geom, h, w))
+    )
+    res["from_blocked_x2_ms"] = timeit(fb_j, oy_b)
+
+    nd_j = jax.jit(lambda fb, fa, nf: nnf_dist(fb, fa, nf, wa))
+    res["nnf_dist_ms"] = timeit(nd_j, f_b, f_a_flat, nnf)
+
+    pol = jax.jit(
+        lambda fb, fa, nf, k: patchmatch_sweeps(
+            fb, fa, nf, k, iters=cfg.pm_polish_iters,
+            n_random=cfg.pm_polish_random, coh_factor=1.0,
+        )
+    )
+    res["polish_ms"] = timeit(pol, f_b, f_a, nnf, jax.random.PRNGKey(2))
+
+    g_j = jax.jit(_gather_image)
+    res["render_gather_ms"] = timeit(g_j, pyr_copy_a[0], nnf)
+
+    # Full em step per level (the driver's actual unit).
+    key = jax.random.PRNGKey(0)
+    for lvl in range(levels - 1, -1, -1):
+        has_coarse = lvl < levels - 1
+        hh, ww = pyr_src_b[lvl].shape[:2]
+        hha, wwa = pyr_src_a[lvl].shape[:2]
+        ap_l = _maybe_a_planes(
+            cfg, pyr_src_a, pyr_flt_a, lvl, has_coarse, (hh, ww)
+        )
+        f_a_l = af(
+            pyr_src_a[lvl], pyr_flt_a[lvl],
+            pyr_src_a[lvl + 1] if has_coarse else None,
+            pyr_flt_a[lvl + 1] if has_coarse else None,
+        ) if has_coarse else assemble_features(
+            pyr_src_a[lvl], pyr_flt_a[lvl], cfg, None, None
+        )
+        nnf_l = random_init(jax.random.fold_in(key, lvl), hh, ww, hha, wwa)
+        step = _em_step_fn(cfg, lvl, has_coarse, False)
+        args = (
+            pyr_src_b[lvl], pyr_raw_b[lvl],
+            pyr_src_b[lvl + 1] if has_coarse else pyr_src_b[lvl],
+            pyr_raw_b[lvl + 1] if has_coarse else pyr_raw_b[lvl],
+            f_a_l, pyr_copy_a[lvl], nnf_l,
+            jax.random.fold_in(key, 100 + lvl), None, ap_l,
+        )
+        res[f"em_step_level{lvl}_({hh})_ms"] = timeit(step, *args, reps=3)
+
+    for k, v in res.items():
+        if isinstance(v, float):
+            res[k] = round(v, 3)
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()
