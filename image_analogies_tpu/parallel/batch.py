@@ -71,6 +71,35 @@ def _batch_step_fn_cached(
     )
 
 
+def _lean_step_fn(cfg: SynthConfig, level: int, has_coarse: bool, mesh_key):
+    """Vmapped LEAN em step (plane-pair NN field, bf16 chunked tables)
+    for the sharded runners — same sharding layout as `_batch_step_fn`
+    but with the field carried as a (py, px) tuple per slab/frame."""
+    cfg = dataclasses.replace(cfg, save_level_artifacts=None)
+    return _lean_step_fn_cached(cfg, level, has_coarse, mesh_key)
+
+
+@functools.lru_cache(maxsize=64)
+def _lean_step_fn_cached(
+    cfg: SynthConfig, level: int, has_coarse: bool, mesh_key
+):
+    mesh = _MESHES[mesh_key]
+    step = make_em_step(cfg, level, has_coarse, lean=True)
+    in_axes = (0, 0, 0, 0, None, None, (0, 0), 0, None, None)
+    shard = batch_sharding(mesh)
+    repl = replicated(mesh)
+    shardings = (
+        shard, shard, shard, shard, repl, repl, (shard, shard), shard,
+        repl, repl,
+    )
+    vstep = jax.vmap(step, in_axes=in_axes)
+    return jax.jit(
+        vstep,
+        in_shardings=shardings,
+        out_shardings=((shard, shard), shard, shard),
+    )
+
+
 # jit caches need hashable mesh handles; Mesh objects are hashable but we
 # key the lru_cache on a stable token so reruns reuse compilations.
 _MESHES = {}

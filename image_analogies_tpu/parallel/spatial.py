@@ -24,6 +24,19 @@ absorbs [BASELINE.json metric].
 
 A-side features are replicated: matches may land anywhere in A, and A'
 style images are small next to B' at the scales this runner targets.
+
+Scale ceiling: levels whose global feature tables exceed
+`cfg.feature_bytes_budget` run the LEAN step per slab (plane-pair NN
+field, bf16 chunk-assembled per-slab B tables), so per-device residency
+is the slab's share of the B side plus the replicated A side — the
+runner reaches the single-chip lean path's ceiling TIMES the mesh on
+the B' axis (e.g. ~8192^2 B' on 4 chips that each handle lean 4096^2
+slabs).  The remaining hard walls are (a) the replicated A-side lean
+table + kernel A-planes, which do NOT shard (A parallelism would need
+band-sharded search + cross-device argmin reduction — not built), and
+(b) kernel eligibility of the slab geometry itself (plan_channels);
+slabs too large for any band plan fall back to the XLA gather path's
+memory behavior.
 """
 
 from __future__ import annotations
@@ -31,22 +44,31 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
 from ..config import SynthConfig
 from ..models.analogy import (
+    _feature_table_bytes,
     _finalize,
-    _resolve_channels,
+    _kernel_eligible,
+    _prologue_fn,
     _save_level,
-    _with_steerable,
+    assemble_features_lean,
+    random_init_planes,
     resume_prologue,
     upsample_nnf,
+    upsample_nnf_planes,
 )
 from ..models.patchmatch import random_init
 from ..ops.features import assemble_features
-from ..ops.pyramid import build_pyramid, upsample
-from .batch import _batch_step_fn as _spatial_step_fn, _mesh_token
+from ..ops.pyramid import upsample
+from .batch import (
+    _batch_step_fn as _spatial_step_fn,
+    _lean_step_fn as _spatial_lean_step_fn,
+    _mesh_token,
+)
 from .mesh import batch_sharding, make_mesh
 
 
@@ -83,32 +105,31 @@ def _merge_cores(slabs: jnp.ndarray, halo: int) -> jnp.ndarray:
 
 
 @functools.lru_cache(maxsize=32)
-def _reslab_fn(halo: int, n_slabs: int, mesh_key):
-    """Jitted stitch-cores + re-split-with-fresh-halos, slab-sharded in
-    and out.
+def _reslab_fn(halo: int, n_slabs: int, n_arrays: int, mesh_key):
+    """Jitted stitch-cores + re-split-with-fresh-halos over `n_arrays`
+    slab-stacked arrays, slab-sharded in and out.
 
     Between EM iterations only the halo rows actually change hands; with
     input and output pinned to the slab sharding, XLA lowers the
     merge+split pair to the boundary-row exchanges between mesh neighbors
     instead of re-materializing the global arrays on the host every
     iteration (the module docstring's halo-exchange claim is made true
-    here)."""
+    here).  Array count is generic: the standard path re-halos
+    (stacked-nnf, bp), the lean path (py, px, bp)."""
     from .batch import _MESHES
 
     shard = batch_sharding(_MESHES[mesh_key])
 
-    def reslab(nnf_s, bp_s):
-        nnf = _merge_cores(nnf_s, halo)
-        bp = _merge_cores(bp_s, halo)
-        return (
-            _split_slabs(nnf, n_slabs, halo),
-            _split_slabs(bp, n_slabs, halo),
+    def reslab(*slabs):
+        return tuple(
+            _split_slabs(_merge_cores(s, halo), n_slabs, halo)
+            for s in slabs
         )
 
     return jax.jit(
         reslab,
-        in_shardings=(shard, shard),
-        out_shardings=(shard, shard),
+        in_shardings=(shard,) * n_arrays,
+        out_shardings=(shard,) * n_arrays,
     )
 
 
@@ -152,13 +173,14 @@ def synthesize_spatial(
             b, [(0, pad_h)] + [(0, 0)] * (b.ndim - 1), mode="edge"
         )
 
-    src_a, flt_a, src_b, copy_a, yiq_b = _resolve_channels(a, ap, b, cfg)
-
-    pyr_src_a = [_with_steerable(x, cfg) for x in build_pyramid(src_a, levels)]
-    pyr_flt_a = build_pyramid(flt_a, levels)
-    pyr_copy_a = build_pyramid(copy_a, levels)
-    pyr_src_b = [_with_steerable(x, cfg) for x in build_pyramid(src_b, levels)]
-    pyr_raw_b = build_pyramid(src_b, levels)
+    # The SAME compiled prologue the single-image driver uses: channel
+    # resolve + remap + pyramids in one jit call — one dispatch, and
+    # bit-identical leaves to create_image_analogy's (the parity tests
+    # compare the two runners exactly; separate compilations of the
+    # reduction-bearing prologue ops could legally round differently).
+    (
+        pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, pyr_raw_b, yiq_b
+    ) = _prologue_fn(cfg, levels)(a, ap, b)
 
     key = jax.random.PRNGKey(cfg.seed)
     bp = flt_bp = nnf = None  # global (H_l, W[, C]) state per level
@@ -177,17 +199,6 @@ def synthesize_spatial(
         ha, wa = f_a_src.shape[:2]
         has_coarse = level < levels - 1
 
-        f_a = assemble_features(
-            f_a_src,
-            pyr_flt_a[level],
-            cfg,
-            pyr_src_a[level + 1] if has_coarse else None,
-            pyr_flt_a[level + 1] if has_coarse else None,
-        )
-        from ..ops.pca import fit_and_project
-
-        f_a, proj = fit_and_project(f_a, cfg.pca_dims)
-
         from ..models.analogy import _maybe_a_planes
 
         # Kernel eligibility is planned against the SLAB the vmapped step
@@ -199,17 +210,70 @@ def synthesize_spatial(
         # generation's global restarts subtract the local tile origin,
         # which lands them in the same relative frame.
         slab_shape = (h // n_slabs + 2 * halo, w)
+
+        # Lean x spatial composition: levels whose GLOBAL row-major
+        # feature tables would not fit one device's HBM run the lean
+        # step per slab — plane-pair (py, px) field in slab form, bf16
+        # chunk-assembled per-slab B tables, one replicated lean A
+        # table — so the sharded runner reaches the sizes the
+        # single-chip lean path handles, times the mesh (the round-2
+        # runner stacked an (H, W, 2) field: 8 GB of lane pad at
+        # 4096^2, exactly the wall it existed to pass).
+        lean = (
+            _kernel_eligible(
+                cfg, f_a_src, pyr_flt_a[level], has_coarse, *slab_shape
+            )
+            and _feature_table_bytes(h, w, ha, wa) > cfg.feature_bytes_budget
+        )
+
+        if lean:
+            f_a = assemble_features_lean(
+                f_a_src,
+                pyr_flt_a[level],
+                cfg,
+                pyr_src_a[level + 1] if has_coarse else None,
+                pyr_flt_a[level + 1] if has_coarse else None,
+            )
+            proj = None
+        else:
+            f_a = assemble_features(
+                f_a_src,
+                pyr_flt_a[level],
+                cfg,
+                pyr_src_a[level + 1] if has_coarse else None,
+                pyr_flt_a[level + 1] if has_coarse else None,
+            )
+            from ..ops.pca import fit_and_project
+
+            f_a, proj = fit_and_project(f_a, cfg.pca_dims)
+
         a_planes = _maybe_a_planes(
             cfg, pyr_src_a, pyr_flt_a, level, has_coarse, slab_shape
         )
 
         level_key = jax.random.fold_in(key, level)
         if has_coarse:
-            nnf = upsample_nnf(nnf, (h, w), ha, wa)
+            if lean:
+                p_py, p_px = (
+                    nnf if isinstance(nnf, tuple)
+                    else (nnf[..., 0], nnf[..., 1])
+                )
+                nnf = upsample_nnf_planes(p_py, p_px, (h, w), ha, wa)
+            elif isinstance(nnf, tuple):
+                uy, ux = upsample_nnf_planes(
+                    nnf[0], nnf[1], (h, w), ha, wa
+                )
+                nnf = jnp.stack([uy, ux], axis=-1)
+            else:
+                nnf = upsample_nnf(nnf, (h, w), ha, wa)
             flt_bp_coarse_g = flt_bp
             flt_bp = upsample(flt_bp, (h, w))
         else:
-            nnf = random_init(level_key, h, w, ha, wa)
+            nnf = (
+                random_init_planes(level_key, h, w, ha, wa)
+                if lean
+                else random_init(level_key, h, w, ha, wa)
+            )
             flt_bp = pyr_raw_b[level]
             flt_bp_coarse_g = None
 
@@ -236,13 +300,23 @@ def synthesize_spatial(
             else None
         )
 
-        step = _spatial_step_fn(cfg, level, has_coarse, token)
+        step = (
+            _spatial_lean_step_fn(cfg, level, has_coarse, token)
+            if lean
+            else _spatial_step_fn(cfg, level, has_coarse, token)
+        )
         # One host-side slab placement per level; between EM iterations
         # the state stays in (sharded) slab form and is re-haloed by the
         # jitted _reslab, so per-iteration traffic is boundary rows only.
-        slab_nnf = jax.device_put(
-            _split_slabs(nnf, n_slabs, halo), shard
-        )
+        if lean:
+            slab_nnf = (
+                jax.device_put(_split_slabs(nnf[0], n_slabs, halo), shard),
+                jax.device_put(_split_slabs(nnf[1], n_slabs, halo), shard),
+            )
+        else:
+            slab_nnf = jax.device_put(
+                _split_slabs(nnf, n_slabs, halo), shard
+            )
         slab_flt = jax.device_put(
             _split_slabs(flt_bp, n_slabs, halo), shard
         )
@@ -264,10 +338,22 @@ def synthesize_spatial(
             )
             nnf_s, dist_s, bp_s = step(*args)
             if em < cfg.em_iters - 1:
-                slab_nnf, slab_flt = _reslab_fn(halo, n_slabs, token)(
-                    nnf_s, bp_s
-                )
-        nnf = _merge_cores(nnf_s, halo)
+                if lean:
+                    py_s, px_s, slab_flt = _reslab_fn(
+                        halo, n_slabs, 3, token
+                    )(nnf_s[0], nnf_s[1], bp_s)
+                    slab_nnf = (py_s, px_s)
+                else:
+                    slab_nnf, slab_flt = _reslab_fn(
+                        halo, n_slabs, 2, token
+                    )(nnf_s, bp_s)
+        if lean:
+            nnf = (
+                _merge_cores(nnf_s[0], halo),
+                _merge_cores(nnf_s[1], halo),
+            )
+        else:
+            nnf = _merge_cores(nnf_s, halo)
         dist = _merge_cores(dist_s, halo)
         bp = _merge_cores(bp_s, halo)
         flt_bp = bp
@@ -278,8 +364,18 @@ def synthesize_spatial(
                 nnf_energy=float(dist.mean()), spatial_slabs=n_slabs,
             )
         if cfg.save_level_artifacts:
+            nnf_save = nnf
+            if isinstance(nnf, tuple):
+                # Stack the lean plane pair on the HOST: checkpoints keep
+                # the standard (H, W, 2) schema without materializing the
+                # lane-padded stack on device (models/analogy.py does the
+                # same).
+                nnf_save = np.stack(
+                    [np.asarray(nnf[0]), np.asarray(nnf[1])], axis=-1
+                )
             _save_level(
-                cfg.save_level_artifacts, level, nnf, dist, bp, cfg, b.shape
+                cfg.save_level_artifacts, level, nnf_save, dist, bp, cfg,
+                b.shape,
             )
 
     out = _finalize(bp, yiq_b, b, cfg)
