@@ -8,29 +8,46 @@ Prints ONE JSON line:
 `vs_baseline` is the speedup against the binding <10 s target
 [BASELINE.json:2]: > 1.0 means the target is beaten.
 
-Schedule note: the headline run uses em_iters=2 (the config-default is 3);
-the same schedule is used for the oracle run, so the PSNR compares
-like-for-like.  Both schedule and PSNR probe size are reported in the
-JSON so the number is reproducible as printed.
-
-PSNR acceptance is measured at FULL scale: the exact-NN oracle runs
-on-TPU through the streaming Pallas kernel (kernels/nn_brute.py), which
-never materializes the N^2 distance matrix, so a 1M-query exact pass is
-a few seconds of MXU time — no reduced-size stand-in.
-
-Kernel utilization: the hot tile-PatchMatch kernel is also timed in
-isolation at the headline level-0 geometry; bytes per sweep are derived
-statically from the channel/banding plan, giving achieved HBM GB/s
-against the v5e-1 roofline (819 GB/s).
+Measurement notes (round-3 revision):
+  - The headline wall is the MEDIAN of 5 steady-state runs with
+    device-resident inputs; best-of-5 and the full run list are also
+    reported (round-2 VERDICT: best-case-only reporting hides variance).
+  - Input transfer is measured and reported separately
+    (`input_transfer_s`): this environment reaches the chip through a
+    tunnelled PJRT backend whose host->device bandwidth is ~10 MB/s and
+    varies run to run — on co-located TPU hosts the same transfer is
+    milliseconds, so folding it into the synthesis wall would benchmark
+    the tunnel, not the framework.  This is exactly the round-2
+    "unexplained 2x same-day variance": tunnel weather.
+  - `value_default_schedule_s` is the wall at the config-default
+    em_iters=3 (the headline schedule em_iters=2 is reported as such).
+  - PSNR is measured at FULL scale vs the on-TPU streaming exact-NN
+    oracle (kernels/nn_brute.py) over three seeds; min/mean and the
+    per-seed list are reported (round-2 VERDICT: single-seed PSNR with a
+    0.9 dB gate margin is a variance statement away from meaningless).
+  - `prologue_ms`/`level_wall_ms` come from a progress-instrumented run
+    with a device sync before each level's clock (walls sum ~= the
+    progress-run wall; the coarsest level is no longer charged the whole
+    async prologue).
+  - Kernel utilization reports BOTH roofline fractions: achieved HBM
+    bandwidth vs the 819 GB/s spec AND achieved VPU FLOP/s vs the
+    ~3.85 TFLOP/s f32 vector spec — the windowed-SSD kernel is
+    VPU-compute-bound, so the FLOP fraction is the binding one.
+  - `acceptance_configs` carries measured wall (+PSNR where an oracle is
+    distinct) for all five BASELINE.json configs — none extrapolated.
 """
 
 import json
+import statistics
 import time
 
 import numpy as np
 
-# TPU v5e single-chip HBM bandwidth (public spec), the kernel's roofline.
+# TPU v5e single-chip public specs used for roofline fractions.
 _V5E_HBM_GBPS = 819.0
+# VPU peak: 8 sublanes x 128 lanes x 4 ALU slots x ~0.94 GHz, counting
+# one FLOP per slot-cycle (mul OR add; FMA would double this).
+_V5E_VPU_GFLOPS = 8 * 128 * 4 * 0.94e9 / 1e9
 
 
 def _tpu_available() -> bool:
@@ -46,8 +63,8 @@ def _sync(x) -> float:
     """Completion barrier: force x's computation with a 4-byte readback.
 
     `block_until_ready()` under the tunnelled axon PJRT platform can
-    return before remote execution completes (measured here: a 1024^2
-    run "blocked" in 0.13 s while its result took 20+ s to materialize),
+    return before remote execution completes (measured: a 1024^2 run
+    "blocked" in 0.13 s while its result took 20+ s to materialize),
     silently turning wall-clock benchmarks into dispatch-time
     benchmarks.  Fetching a scalar reduction of the output is a reliable
     barrier: the host cannot have the value until the device finished.
@@ -57,8 +74,22 @@ def _sync(x) -> float:
     return float(jnp.sum(x))
 
 
-def _level_walls(a, ap, b, cfg):
-    """Per-level wall clock via the driver's own progress events."""
+def _timed_runs(fn, n: int):
+    """n wall-clock timings of fn(), each closed by the readback barrier
+    (fn must return a device array).  Returns (walls, last_output) so
+    callers can reuse a result (e.g. for PSNR) instead of re-running."""
+    walls, out = [], None
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn()
+        _sync(out)
+        walls.append(round(time.perf_counter() - t0, 4))
+    return walls, out
+
+
+def _phase_breakdown(a, ap, b, cfg):
+    """Prologue + per-level walls from the driver's own progress events
+    (the driver syncs before each level's clock when progress is on)."""
     import os
     import tempfile
 
@@ -68,23 +99,39 @@ def _level_walls(a, ap, b, cfg):
     fd, path = tempfile.mkstemp(suffix=".jsonl")
     os.close(fd)
     try:
-        create_image_analogy(
-            a, ap, b, cfg, progress=ProgressWriter(path)
-        ).block_until_ready()
-        walls = {}
+        _sync(create_image_analogy(a, ap, b, cfg, progress=ProgressWriter(path)))
+        prologue_ms, walls = None, {}
         with open(path) as f:
             for line in f:
                 rec = json.loads(line)
-                if rec.get("event") == "level_done":
+                if rec.get("event") == "prologue":
+                    prologue_ms = rec["wall_ms"]
+                elif rec.get("event") == "level_done":
                     walls[rec["level"]] = rec["wall_ms"]
-        return [walls[lvl] for lvl in sorted(walls)]
+        return prologue_ms, [walls[lvl] for lvl in sorted(walls)]
     finally:
         os.unlink(path)
 
 
+def _kernel_flops_per_sweep(specs, geom) -> int:
+    """Static FLOPs of one full tile_sweep pass (upper bound: every
+    candidate valid and in-band).  Per pixel per candidate per channel:
+    1 sub + 1 mul for the squared diff, then (mul + add) per separable
+    tap in x and y; plus ~4 compare/select ops per pixel per candidate
+    for the accept test."""
+    from image_analogies_tpu.kernels.patchmatch_tile import K_TOTAL, LANE
+
+    per_px_cand = sum(
+        2 + 2 * len(sp.wx) + 2 * len(sp.wy) for sp in specs
+    ) + 4
+    px = geom.n_ty * geom.n_tx * geom.thp * LANE
+    return px * K_TOTAL * per_px_cand
+
+
 def _kernel_utilization(cfg, size: int, iters: int = 16):
     """Steady-state tile_sweep throughput at the headline level-0
-    geometry: (achieved GB/s, roofline fraction, bytes/sweep).
+    geometry: achieved HBM GB/s AND achieved VPU GFLOP/s, each with its
+    roofline fraction.
 
     Traffic model per pm iteration: every A band is fetched once
     (constant-index blocks are not re-fetched across grid steps) and
@@ -125,9 +172,12 @@ def _kernel_utilization(cfg, size: int, iters: int = 16):
     oy = jnp.zeros((n_ty * thp, n_tx * LANE), jnp.int32)
     ox = jnp.zeros((n_ty * thp, n_tx * LANE), jnp.int32)
     d = jnp.full((n_ty * thp, n_tx * LANE), jnp.inf, jnp.float32)
-    cand_y, cand_x = sample_candidates(
-        jnp.zeros((size, size), jnp.int32), jnp.zeros((size, size), jnp.int32),
-        jax.random.PRNGKey(0), geom, size, size,
+    # Random state -> no duplicate candidates -> the timing measures the
+    # all-candidates-evaluated upper bound the FLOP model assumes.
+    ry = jnp.asarray(rng.integers(-size, size, (size, size), dtype=np.int32))
+    rx = jnp.asarray(rng.integers(-size, size, (size, size), dtype=np.int32))
+    cand_y, cand_x, cand_valid = sample_candidates(
+        ry, rx, jax.random.PRNGKey(0), geom, size, size,
     )
     bounds = band_bounds(size, n_bands)
 
@@ -135,6 +185,7 @@ def _kernel_utilization(cfg, size: int, iters: int = 16):
         for band_planes, band in zip(a_planes, bounds):
             oy, ox, d = tile_sweep(
                 band_planes, b_blocked, cand_y, cand_x, oy, ox, d, band,
+                cand_valid,
                 specs=specs, geom=geom, ha=size, wa=size, coh_factor=1.0,
             )
         return oy, ox, d
@@ -151,66 +202,197 @@ def _kernel_utilization(cfg, size: int, iters: int = 16):
     tile_bytes = (n_chan + 6) * thp * LANE * 4  # B chans + 3 state in/out
     sweep_bytes = a_bytes + n_bands * n_ty * n_tx * tile_bytes
     gbps = iters * sweep_bytes / wall / 1e9
+    flops = _kernel_flops_per_sweep(specs, geom)
+    gflops = iters * flops / wall / 1e9
     return {
         "kernel_hbm_gbps": round(gbps, 1),
-        "kernel_roofline_frac": round(gbps / _V5E_HBM_GBPS, 3),
+        "kernel_hbm_roofline_frac": round(gbps / _V5E_HBM_GBPS, 3),
+        "kernel_vpu_gflops": round(gflops, 1),
+        "kernel_vpu_roofline_frac": round(gflops / _V5E_VPU_GFLOPS, 3),
+        "kernel_flops_per_sweep": flops,
         "kernel_bytes_per_sweep": sweep_bytes,
         "kernel_sweep_ms": round(wall / iters * 1000, 3),
         "kernel_n_bands": n_bands,
     }
 
 
+def _psnr_over_seeds(a, ap, b, levels, em_iters, seeds=(0, 1, 2)):
+    """PSNR of the patchmatch pipeline vs the exact-NN brute oracle at
+    full scale, one patchmatch run per seed.  The oracle runs ONCE: the
+    brute matcher ignores both the PRNG key and the incoming field
+    (models/brute.py), so its output is seed-independent."""
+    from image_analogies_tpu import SynthConfig, create_image_analogy, psnr
+
+    oracle = np.asarray(create_image_analogy(
+        a, ap, b,
+        SynthConfig(levels=levels, matcher="brute", em_iters=em_iters),
+    ))
+    out = []
+    for seed in seeds:
+        pm = create_image_analogy(
+            a, ap, b,
+            SynthConfig(
+                levels=levels, matcher="patchmatch", em_iters=em_iters,
+                pm_iters=6, seed=seed,
+            ),
+        )
+        out.append(round(psnr(np.asarray(pm), oracle), 2))
+    return out
+
+
+def _acceptance_configs(on_tpu: bool):
+    """Measured wall (+PSNR where an oracle is distinct) for all five
+    BASELINE.json acceptance configs — none extrapolated."""
+    import jax.numpy as jnp
+
+    from image_analogies_tpu import SynthConfig, create_image_analogy, psnr
+    from image_analogies_tpu.utils.examples import (
+        artistic_filter,
+        npr_frames,
+        super_resolution,
+        texture_by_numbers,
+    )
+
+    scale = 1 if on_tpu else 8  # CPU fallback keeps the bench runnable
+    rows = []
+
+    def dev(*arrays):
+        out = tuple(jnp.asarray(x, jnp.float32) for x in arrays)
+        for x in out:
+            _sync(x)
+        return out
+
+    def run_single(name, inputs, cfg, oracle_cfg=None):
+        a, ap, b = dev(*inputs)
+        fn = lambda: create_image_analogy(a, ap, b, cfg)  # noqa: E731
+        _sync(fn())  # compile
+        walls, out = _timed_runs(fn, 3)
+        row = {"config": name, "wall_s": statistics.median(walls),
+               "wall_runs_s": walls}
+        if oracle_cfg is not None:
+            oracle = create_image_analogy(a, ap, b, oracle_cfg)
+            row["psnr_db"] = round(
+                psnr(np.asarray(out), np.asarray(oracle)), 2
+            )
+        rows.append(row)
+
+    # 1: texture-by-numbers 256^2, 3 levels, brute NN — brute IS the
+    # exact oracle, so there is no distinct reference to PSNR against.
+    run_single(
+        "1:texture-by-numbers-256-brute",
+        texture_by_numbers(max(64, 256 // scale)),
+        SynthConfig(levels=3, matcher="brute", em_iters=2),
+    )
+    # 2: artistic filter 512^2, PatchMatch, kappa=5.
+    run_single(
+        "2:artistic-filter-512-patchmatch-kappa5",
+        artistic_filter(max(64, 512 // scale)),
+        SynthConfig(levels=5, matcher="patchmatch", em_iters=2, kappa=5.0),
+        SynthConfig(levels=5, matcher="brute", em_iters=2, kappa=5.0),
+    )
+    # 3: super-resolution 1024^2 (the headline; measured again here at
+    # this table's 2-run protocol for completeness).
+    run_single(
+        "3:super-resolution-1024",
+        super_resolution(max(128, 1024 // scale)),
+        SynthConfig(levels=5, matcher="patchmatch", em_iters=2, pm_iters=6),
+        SynthConfig(levels=5, matcher="brute", em_iters=2),
+    )
+    # 4: steerable features + luminance-only transfer, 1024^2.
+    run_single(
+        "4:steerable-luminance-1024",
+        super_resolution(max(128, 1024 // scale)),
+        SynthConfig(
+            levels=5, matcher="patchmatch", em_iters=2, steerable=True,
+            color_mode="luminance",
+        ),
+        SynthConfig(
+            levels=5, matcher="brute", em_iters=2, steerable=True,
+            color_mode="luminance",
+        ),
+    )
+    # 5: batched NPR 8x1024^2, data-parallel; on the single v5e-1 the
+    # mesh degrades to 1 chip and frames_per_step=1 microbatches HBM.
+    from image_analogies_tpu.parallel.batch import synthesize_batch
+    from image_analogies_tpu.parallel.mesh import make_mesh
+
+    a, ap, frames = npr_frames(n_frames=8, size=max(128, 1024 // scale))
+    a, ap, frames = dev(a, ap, frames)
+    mesh = make_mesh()
+    cfg5 = SynthConfig(levels=5, matcher="patchmatch", em_iters=2, kappa=2.0)
+    fn5 = lambda: synthesize_batch(  # noqa: E731
+        a, ap, frames, cfg5, mesh, frames_per_step=1
+    )
+    _sync(fn5())  # compile
+    walls5, out5 = _timed_runs(fn5, 3)
+    oracle5 = synthesize_batch(
+        a, ap, frames,
+        SynthConfig(levels=5, matcher="brute", em_iters=2, kappa=2.0),
+        mesh, frames_per_step=1,
+    )
+    rows.append({
+        "config": "5:batched-npr-8x1024-fps1",
+        "wall_s": statistics.median(walls5),
+        "wall_runs_s": walls5,
+        "psnr_db": round(psnr(np.asarray(out5), np.asarray(oracle5)), 2),
+    })
+    return rows
+
+
 def main() -> None:
-    import jax
+    import jax.numpy as jnp
 
     from image_analogies_tpu.utils.cache import enable_compilation_cache
 
     enable_compilation_cache()
 
-    from image_analogies_tpu import SynthConfig, create_image_analogy, psnr
-    from image_analogies_tpu.utils.examples import super_resolution
+    from image_analogies_tpu import SynthConfig, create_image_analogy
 
     on_tpu = _tpu_available()
     size = 1024 if on_tpu else 128  # CPU fallback keeps the bench runnable
     levels = 5 if on_tpu else 4
     em_iters = 2
 
-    a, ap, b = super_resolution(size)
+    from image_analogies_tpu.utils.examples import super_resolution
+
+    a_h, ap_h, b_h = super_resolution(size)
     cfg = SynthConfig(
         levels=levels, matcher="patchmatch", em_iters=em_iters, pm_iters=6,
-        pm_random_candidates=6,
     )
 
-    # Warmup: compile every per-level step (first compile ~20-40 s on TPU;
-    # the metric is synthesis wall-clock, not compile time), then DRAIN
-    # the device queue (_sync) so the timed runs start from idle.
-    bp = create_image_analogy(a, ap, b, cfg)
-    _sync(bp)
-
-    # Best-of-3 steady state, each run closed by the scalar-readback
-    # barrier (see _sync: block_until_ready under-measures on axon).
-    walls = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        bp = create_image_analogy(a, ap, b, cfg)
-        _sync(bp)
-        walls.append(time.perf_counter() - t0)
-    wall = min(walls)
-
-    # FULL-SCALE PSNR acceptance vs the exact-NN oracle (same size, same
-    # schedule): the streaming Pallas brute kernel makes the exact pass
-    # feasible on-TPU at 1024^2 [BASELINE.json:2 ">= 35 dB"].
+    # Host->device transfer, measured separately (see module docstring:
+    # the tunnelled backend's ~10 MB/s would otherwise dominate and its
+    # weather would masquerade as synthesis variance).
     t0 = time.perf_counter()
-    oracle = create_image_analogy(
-        a, ap, b,
-        SynthConfig(levels=levels, matcher="brute", em_iters=em_iters),
-    )
-    _sync(oracle)
-    oracle_wall = time.perf_counter() - t0
-    psnr_db = psnr(np.asarray(bp), np.asarray(oracle))
+    a = jnp.asarray(a_h, jnp.float32)
+    ap = jnp.asarray(ap_h, jnp.float32)
+    b = jnp.asarray(b_h, jnp.float32)
+    for x in (a, ap, b):
+        _sync(x)
+    transfer_s = round(time.perf_counter() - t0, 3)
 
-    level_wall_ms = _level_walls(a, ap, b, cfg)
+    # Warmup: compile every per-level step (first compile ~20-40 s on
+    # TPU; the metric is synthesis wall-clock, not compile time), then
+    # drain the queue so the timed runs start from idle.
+    run = lambda: create_image_analogy(a, ap, b, cfg)  # noqa: E731
+    _sync(run())
+
+    walls, _ = _timed_runs(run, 5)
+    wall = statistics.median(walls)
+
+    # Config-default schedule (em_iters=3) — the headline uses 2.
+    cfg3 = SynthConfig(levels=levels, matcher="patchmatch", pm_iters=6)
+    run3 = lambda: create_image_analogy(a, ap, b, cfg3)  # noqa: E731
+    _sync(run3())
+    walls_default, _ = _timed_runs(run3, 2)
+
+    # FULL-SCALE PSNR acceptance vs the exact-NN oracle over 3 seeds
+    # (same size, same schedule) [BASELINE.json:2 ">= 35 dB"].
+    psnr_seeds = _psnr_over_seeds(a, ap, b, levels, em_iters)
+
+    prologue_ms, level_wall_ms = _phase_breakdown(a, ap, b, cfg)
     util = _kernel_utilization(cfg, size) if on_tpu else None
+    config_rows = _acceptance_configs(on_tpu)
 
     rec = {
         "metric": f"{size}x{size} B' synth wall-clock "
@@ -218,17 +400,20 @@ def main() -> None:
         "value": round(wall, 4),
         "unit": "s",
         "vs_baseline": round(10.0 / wall, 3),
-        "wall_runs_s": [round(w, 3) for w in walls],
+        "wall_runs_s": walls,
+        "wall_best_s": min(walls),
+        "input_transfer_s": transfer_s,
         "device": "tpu" if on_tpu else "cpu-fallback",
         "em_iters": em_iters,
-        "psnr_vs_cpu_ref_db": round(psnr_db, 2),
+        "value_default_schedule_s": statistics.median(walls_default),
+        "wall_runs_default_schedule_s": walls_default,
+        "psnr_vs_cpu_ref_db": min(psnr_seeds),
+        "psnr_seeds_db": psnr_seeds,
+        "psnr_mean_db": round(float(np.mean(psnr_seeds)), 2),
         "psnr_probe_size": size,
-        # Single (unwarmed) oracle pass: includes compile-cache load /
-        # any first-compile cost, labeled as such — the oracle runs once
-        # for the PSNR number, so a warmed timing would double bench
-        # time for a non-headline figure.
-        "oracle_wall_s_inc_compile": round(oracle_wall, 3),
+        "prologue_ms": prologue_ms,
         "level_wall_ms": level_wall_ms,
+        "acceptance_configs": config_rows,
     }
     if util:
         rec.update(util)
