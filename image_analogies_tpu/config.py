@@ -81,11 +81,15 @@ class SynthConfig:
     # Estimated f32 feature-table HBM bytes above which a
     # kernel-eligible level switches to the LEAN path: feature tables
     # are assembled chunk-wise into bf16 (halving the lane-padded
-    # table cost that OOMs at 4096^2+ — models/analogy.py
-    # `_feature_table_bytes`), distance evaluations are chunked, and
-    # the NN field is carried as (H, W) planes.  Same staging and
-    # metric as the standard kernel path, up to bf16 quantization.
-    feature_bytes_budget: int = 6 * 1024**3
+    # table cost — models/analogy.py `_feature_table_bytes`), distance
+    # evaluations are chunked, and the NN field is carried as (H, W)
+    # planes.  Same staging and metric as the standard kernel path, up
+    # to bf16 quantization.  2 GB puts the 1024^2 headline on the
+    # exact path (1.07 GB of tables) and 2048^2+ on lean: the standard
+    # path's fused level graph at 2048^2 holds two ~2 GB lane-padded
+    # tables plus assembly temps and measured 20 GB of HLO temp
+    # against 15.75 GB of HBM.
+    feature_bytes_budget: int = 2 * 1024**3
 
     # Brute-force matcher query chunk (rows of the distance matrix computed
     # per step; bounds peak HBM for the (chunk, N_A) distance tile).

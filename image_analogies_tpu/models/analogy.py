@@ -306,16 +306,46 @@ def _warn_kernel_noop_knobs(cfg: SynthConfig) -> None:
         _warned_kernel_noop = True
 
 
+# Standard-path levels whose single f32 feature table exceeds this run
+# the A-side assembly as its OWN jit call (round-2 staging): fusing it
+# into the level graph makes XLA hold the A assembly's layout-padded
+# temps (fine-res coarse blocks pad 14x) concurrently with both EM
+# steps' — measured 20 GB of HLO temp at 2048^2 against 15.75 GB of
+# HBM.  Split, the temps die with the assembly call.
+_SPLIT_ASSEMBLY_BYTES = 1536 * 1024**2
+
+
+def _fa_external(ha: int, wa: int, lean: bool) -> bool:
+    return not lean and ha * wa * 128 * 4 > _SPLIT_ASSEMBLY_BYTES
+
+
+def _assemble_fa_fn(cfg: SynthConfig, has_coarse: bool):
+    return _assemble_fa_fn_cached(_strip_noncompute(cfg), has_coarse)
+
+
+@functools.lru_cache(maxsize=32)
+def _assemble_fa_fn_cached(cfg: SynthConfig, has_coarse: bool):
+    """Standalone compiled A-side feature assembly (+PCA) for levels
+    where `_fa_external` splits it out of the fused level graph."""
+
+    def assemble(src_a_l, flt_a_l, src_a_c, flt_a_c):
+        f_a = assemble_features(src_a_l, flt_a_l, cfg, src_a_c, flt_a_c)
+        return pca_fit_and_project(f_a, cfg.pca_dims)
+
+    return jax.jit(assemble)
+
+
 def _level_fn(cfg: SynthConfig, level: int, has_coarse: bool, lean: bool,
-              prev_kind: str):
+              prev_kind: str, fa_external: bool = False):
     return _level_fn_cached(
-        _strip_noncompute(cfg), level, has_coarse, lean, prev_kind
+        _strip_noncompute(cfg), level, has_coarse, lean, prev_kind,
+        fa_external,
     )
 
 
 @functools.lru_cache(maxsize=64)
 def _level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
-                     lean: bool, prev_kind: str):
+                     lean: bool, prev_kind: str, fa_external: bool = False):
     """One pyramid level as ONE compiled call: state upsampling glue +
     A-side feature assembly (+PCA) + kernel A-plane prep + all
     `cfg.em_iters` EM steps.
@@ -329,11 +359,14 @@ def _level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
     step = make_em_step(cfg, level, has_coarse, lean)
 
     def run_level(src_a_l, flt_a_l, src_a_c, flt_a_c, src_b_l, src_b_c,
-                  raw_b_l, copy_a_l, prev_nnf, prev_bp, level_key):
+                  raw_b_l, copy_a_l, prev_nnf, prev_bp, level_key,
+                  f_a_ext=None, proj_ext=None):
         h, w = src_b_l.shape[:2]
         ha, wa = src_a_l.shape[:2]
 
-        if lean:
+        if fa_external:
+            f_a, proj = f_a_ext, proj_ext
+        elif lean:
             f_a = assemble_features_lean(
                 src_a_l, flt_a_l, cfg, src_a_c, flt_a_c
             )
@@ -632,7 +665,16 @@ def create_image_analogy(
             "none" if not has_coarse
             else ("planes" if isinstance(nnf, tuple) else "stacked")
         )
-        run = _level_fn(cfg, level, has_coarse, lean, prev_kind)
+        fa_ext = _fa_external(ha, wa, lean)
+        f_a_ext = proj_ext = None
+        if fa_ext:
+            f_a_ext, proj_ext = _assemble_fa_fn(cfg, has_coarse)(
+                pyr_src_a[level],
+                pyr_flt_a[level],
+                pyr_src_a[level + 1] if has_coarse else None,
+                pyr_flt_a[level + 1] if has_coarse else None,
+            )
+        run = _level_fn(cfg, level, has_coarse, lean, prev_kind, fa_ext)
         nnf, dist, bp = run(
             pyr_src_a[level],
             pyr_flt_a[level],
@@ -645,6 +687,8 @@ def create_image_analogy(
             nnf,
             bp,
             jax.random.fold_in(key, level),
+            f_a_ext,
+            proj_ext,
         )
 
         aux["nnf"][level] = nnf

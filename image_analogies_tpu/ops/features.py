@@ -40,6 +40,15 @@ def extract_patches(img: jnp.ndarray, patch: int) -> jnp.ndarray:
 
     Channel-major layout: index [c*patch*patch + dy*patch + dx] is channel c
     at window offset (dy, dx).
+
+    Layout note: the conv-im2col lowering materializes a
+    (1, C*p^2, H, W) intermediate whose TPU layout pads ~5x; at 1024^2
+    that is a few hundred MB of temp and compiles fine, and levels big
+    enough for it to matter run the LEAN path, which assembles in row
+    slabs and never sees full-image patch tensors.  (A shifted-slice +
+    stack formulation was tried and is WORSE: stacking 2-D planes on a
+    new trailing axis makes XLA pad each (H, W, 1) input 128x on the
+    unit lane axis — 27 GB of temps at 1024^2.)
     """
     if img.ndim == 2:
         img = img[..., jnp.newaxis]
@@ -114,10 +123,16 @@ def assemble_features(
     ]
     has_coarse = src_coarse is not None
     if has_coarse:
+        # q -> q//2 parent lookup as row/col gathers (values identical
+        # to repeat-then-crop): jnp.repeat materializes an
+        # (H, W/2, 2, D) intermediate whose trailing-dim lane pad
+        # expands 14x — four 2 GB temps in the 2048^2 brute-oracle
+        # graph, the difference between fitting HBM and OOM.
+        iy = jnp.arange(h) // 2
+        ix = jnp.arange(w) // 2
         for img in (src_coarse, flt_coarse):
             p = extract_patches(img, cfg.coarse_patch_size)
-            # q -> q//2 lookup == nearest-neighbor 2x upsample, cropped.
-            p = jnp.repeat(jnp.repeat(p, 2, axis=0), 2, axis=1)[:h, :w]
+            p = jnp.take(jnp.take(p, iy, axis=0), ix, axis=1)
             parts.append(p)
     feats = jnp.concatenate(parts, axis=-1)
     wvec = jnp.asarray(feature_weights(n_src, n_flt, cfg, has_coarse))

@@ -1,0 +1,104 @@
+"""Shared tile-kernel microbench harness (used by bench.py and
+tools/tune_kernel.py so the published utilization numbers and the
+recorded tuning results measure the SAME kernel setup by construction).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def sync(x) -> float:
+    """Completion barrier: force x with a scalar readback —
+    `block_until_ready()` can return early on the tunnelled axon
+    platform (see bench.py)."""
+    return float(jnp.sum(x))
+
+
+def sweep_setup(cfg, size: int):
+    """Build a steady-state all-bands tile_sweep closure at the
+    (size x size, coarse-channel) geometry.
+
+    Returns (one_iter, state0, meta) where one_iter(oy, ox, d) runs one
+    full pm-iteration's band calls, state0 is the initial blocked state,
+    and meta carries (specs, geom, n_bands, a_planes).  Candidates come
+    from a RANDOM field, so no slots dedup away and timings measure the
+    all-candidates-evaluated upper bound the static FLOP model assumes.
+    Returns None when the geometry is kernel-ineligible.
+    """
+    from ..kernels.patchmatch_tile import (
+        LANE,
+        band_bounds,
+        plan_channels,
+        prepare_a_planes,
+        sample_candidates,
+        tile_geometry,
+        tile_sweep,
+        to_blocked,
+    )
+
+    plan = plan_channels(1, 1, cfg, True, size, size, size, size)
+    if plan is None:
+        return None
+    specs, use_coarse, n_bands = plan
+    geom = tile_geometry(size, size, specs)
+    rng = np.random.default_rng(0)
+    mk = lambda *s: jnp.asarray(rng.random(s, np.float32))  # noqa: E731
+    a_planes = prepare_a_planes(
+        mk(size, size), mk(size, size),
+        mk(size // 2, size // 2) if use_coarse else None,
+        mk(size // 2, size // 2) if use_coarse else None,
+        specs, n_bands=n_bands,
+    )
+    n_chan = int(a_planes[0].shape[0])
+    b_blocked = jnp.stack(
+        [to_blocked(mk(size, size), geom) for _ in range(n_chan)]
+    )
+    thp, n_ty, n_tx = geom.thp, geom.n_ty, geom.n_tx
+    oy = jnp.zeros((n_ty * thp, n_tx * LANE), jnp.int32)
+    ox = jnp.zeros((n_ty * thp, n_tx * LANE), jnp.int32)
+    d = jnp.full((n_ty * thp, n_tx * LANE), jnp.inf, jnp.float32)
+    ry = jnp.asarray(rng.integers(-size, size, (size, size), dtype=np.int32))
+    rx = jnp.asarray(rng.integers(-size, size, (size, size), dtype=np.int32))
+    cand_y, cand_x, cand_valid = sample_candidates(
+        ry, rx, jax.random.PRNGKey(0), geom, size, size,
+    )
+    bounds = band_bounds(size, n_bands)
+
+    def one_iter(oy, ox, d):
+        for band_planes, band in zip(a_planes, bounds):
+            oy, ox, d = tile_sweep(
+                band_planes, b_blocked, cand_y, cand_x, oy, ox, d, band,
+                cand_valid,
+                specs=specs, geom=geom, ha=size, wa=size, coh_factor=1.0,
+            )
+        return oy, ox, d
+
+    meta = {
+        "specs": specs,
+        "geom": geom,
+        "n_bands": n_bands,
+        "a_planes": a_planes,
+        "n_chan": n_chan,
+    }
+    return one_iter, (oy, ox, d), meta
+
+
+def sweep_time_ms(cfg, size: int, iters: int = 16):
+    """Steady-state ms per full all-bands sweep, plus the setup meta.
+    None when ineligible."""
+    setup = sweep_setup(cfg, size)
+    if setup is None:
+        return None
+    one_iter, (oy, ox, d), meta = setup
+    oy, ox, d = one_iter(oy, ox, d)  # warm/compile
+    sync(d)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        oy, ox, d = one_iter(oy, ox, d)
+    sync(d)
+    return (time.perf_counter() - t0) / iters * 1000, meta
