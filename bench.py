@@ -131,79 +131,31 @@ def _kernel_flops_per_sweep(specs, geom) -> int:
 def _kernel_utilization(cfg, size: int, iters: int = 16):
     """Steady-state tile_sweep throughput at the headline level-0
     geometry: achieved HBM GB/s AND achieved VPU GFLOP/s, each with its
-    roofline fraction.
+    roofline fraction.  The harness lives in utils/kernelbench.py and is
+    shared with tools/tune_kernel.py so the published numbers and the
+    recorded tuning results measure the same kernel setup.
 
     Traffic model per pm iteration: every A band is fetched once
     (constant-index blocks are not re-fetched across grid steps) and
     every tile moves its B channels plus 3 state planes in and 3 out.
     """
-    import jax
-    import jax.numpy as jnp
+    from image_analogies_tpu.kernels.patchmatch_tile import LANE
+    from image_analogies_tpu.utils.kernelbench import sweep_time_ms
 
-    from image_analogies_tpu.kernels.patchmatch_tile import (
-        LANE,
-        band_bounds,
-        plan_channels,
-        prepare_a_planes,
-        sample_candidates,
-        tile_geometry,
-        tile_sweep,
-        to_blocked,
-    )
-
-    plan = plan_channels(1, 1, cfg, True, size, size, size, size)
-    if plan is None:
+    timed = sweep_time_ms(cfg, size, iters)
+    if timed is None:
         return None
-    specs, use_coarse, n_bands = plan
-    geom = tile_geometry(size, size, specs)
-    rng = np.random.default_rng(0)
-    mk = lambda *s: jnp.asarray(rng.random(s, np.float32))  # noqa: E731
-    a_planes = prepare_a_planes(
-        mk(size, size), mk(size, size),
-        mk(size // 2, size // 2) if use_coarse else None,
-        mk(size // 2, size // 2) if use_coarse else None,
-        specs, n_bands=n_bands,
-    )
-    n_chan = int(a_planes[0].shape[0])
-    b_blocked = jnp.stack(
-        [to_blocked(mk(size, size), geom) for _ in range(n_chan)]
-    )
+    ms, meta = timed
+    specs, geom, n_bands = meta["specs"], meta["geom"], meta["n_bands"]
+    a_planes, n_chan = meta["a_planes"], meta["n_chan"]
     thp, n_ty, n_tx = geom.thp, geom.n_ty, geom.n_tx
-    oy = jnp.zeros((n_ty * thp, n_tx * LANE), jnp.int32)
-    ox = jnp.zeros((n_ty * thp, n_tx * LANE), jnp.int32)
-    d = jnp.full((n_ty * thp, n_tx * LANE), jnp.inf, jnp.float32)
-    # Random state -> no duplicate candidates -> the timing measures the
-    # all-candidates-evaluated upper bound the FLOP model assumes.
-    ry = jnp.asarray(rng.integers(-size, size, (size, size), dtype=np.int32))
-    rx = jnp.asarray(rng.integers(-size, size, (size, size), dtype=np.int32))
-    cand_y, cand_x, cand_valid = sample_candidates(
-        ry, rx, jax.random.PRNGKey(0), geom, size, size,
-    )
-    bounds = band_bounds(size, n_bands)
-
-    def one_iter(oy, ox, d):
-        for band_planes, band in zip(a_planes, bounds):
-            oy, ox, d = tile_sweep(
-                band_planes, b_blocked, cand_y, cand_x, oy, ox, d, band,
-                cand_valid,
-                specs=specs, geom=geom, ha=size, wa=size, coh_factor=1.0,
-            )
-        return oy, ox, d
-
-    oy, ox, d = one_iter(oy, ox, d)  # warm/compile
-    _sync(d)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        oy, ox, d = one_iter(oy, ox, d)
-    _sync(d)
-    wall = time.perf_counter() - t0
 
     a_bytes = sum(int(np.prod(p.shape)) * 4 for p in a_planes)
     tile_bytes = (n_chan + 6) * thp * LANE * 4  # B chans + 3 state in/out
     sweep_bytes = a_bytes + n_bands * n_ty * n_tx * tile_bytes
-    gbps = iters * sweep_bytes / wall / 1e9
+    gbps = sweep_bytes / (ms / 1000) / 1e9
     flops = _kernel_flops_per_sweep(specs, geom)
-    gflops = iters * flops / wall / 1e9
+    gflops = flops / (ms / 1000) / 1e9
     return {
         "kernel_hbm_gbps": round(gbps, 1),
         "kernel_hbm_roofline_frac": round(gbps / _V5E_HBM_GBPS, 3),
@@ -211,7 +163,7 @@ def _kernel_utilization(cfg, size: int, iters: int = 16):
         "kernel_vpu_roofline_frac": round(gflops / _V5E_VPU_GFLOPS, 3),
         "kernel_flops_per_sweep": flops,
         "kernel_bytes_per_sweep": sweep_bytes,
-        "kernel_sweep_ms": round(wall / iters * 1000, 3),
+        "kernel_sweep_ms": round(ms, 3),
         "kernel_n_bands": n_bands,
     }
 

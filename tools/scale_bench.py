@@ -1,0 +1,171 @@
+"""Large-image scaling bench: 2048^2 and 4096^2 lean-path rows (round-2
+VERDICT task 5: the large-scale numbers must live in an artifact, not
+prose).
+
+Prints one JSON line per size with warm wall, per-level walls, final
+NN-field energy, and an EXACT-NN PROBE quality metric: M=128K query
+pixels of the final level-0 feature field are exact-searched against
+the full A database with the streaming brute kernel, and the run's
+achieved distances are compared against the exact optima on those
+pixels (mean-distance ratio; 1.0 = the field is exactly optimal on the
+probe).  A full-synthesis exact oracle is NOT run at these sizes: the
+2048^2 all-pairs pass is a ~134M-step kernel grid that reproducibly
+crashes the TPU worker (two attempts, 2026-07-30), while the probe's
+few-million-step grid is the same regime the 1024^2 oracle uses safely.
+
+Run on the TPU box:  python tools/scale_bench.py [max_size]
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from image_analogies_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+from image_analogies_tpu import SynthConfig, create_image_analogy
+from image_analogies_tpu.utils.examples import super_resolution
+from image_analogies_tpu.utils.progress import ProgressWriter
+
+_N_PROBE = 1 << 17
+
+
+def _sync(x):
+    return float(jnp.sum(x))
+
+
+def _exact_probe(a, ap, b, cfg, aux):
+    """(mean achieved dist / mean exact dist, exact-match fraction) on
+    _N_PROBE random pixels of the final level-0 field, measured at the
+    EM fixed point: features are rebuilt from the run's own final
+    estimates (B'_l = gather(A'_l, nnf_l) — per-level estimates are
+    fully determined by the aux fields), both sides in the lean bf16
+    feature space so achieved and exact distances share one metric."""
+    from image_analogies_tpu.kernels.nn_brute import exact_nn_pallas
+    from image_analogies_tpu.models.analogy import (
+        _prologue_fn,
+        assemble_features_lean,
+    )
+
+    levels = cfg.clamp_levels(a.shape[:2], b.shape[:2])
+    (
+        pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, _pyr_raw_b, _yiq
+    ) = _prologue_fn(cfg, levels)(a, ap, b)
+
+    def planes(lvl):
+        nnf = aux["nnf"][lvl]
+        if isinstance(nnf, tuple):
+            return nnf
+        return nnf[..., 0], nnf[..., 1]
+
+    def estimate(lvl):
+        py, px = planes(lvl)
+        copy_a = pyr_copy_a[lvl]
+        ha_l, wa_l = copy_a.shape[:2]
+        flat = copy_a.reshape(ha_l * wa_l, -1)
+        out = jnp.take(flat, (py * wa_l + px).reshape(-1), axis=0)
+        out = out.reshape(*py.shape, -1)
+        return out[..., 0] if copy_a.ndim == 2 else out
+
+    py0, px0 = planes(0)
+    h, w = py0.shape
+    ha, wa = pyr_src_a[0].shape[:2]
+    flt0 = estimate(0)
+    flt1 = estimate(1)
+
+    f_b_tab = assemble_features_lean(
+        pyr_src_b[0], flt0, cfg, pyr_src_b[1], flt1
+    )
+    f_a_tab = assemble_features_lean(
+        pyr_src_a[0], pyr_flt_a[0], cfg, pyr_src_a[1], pyr_flt_a[1]
+    )
+
+    rng = np.random.default_rng(0)
+    probe = jnp.asarray(
+        rng.choice(h * w, size=_N_PROBE, replace=False).astype(np.int32)
+    )
+    fb_rows = jnp.take(f_b_tab, probe, axis=0).astype(jnp.float32)
+    idx_ach = jnp.take((py0 * wa + px0).reshape(-1), probe, axis=0)
+
+    idx_exact, d_exact = exact_nn_pallas(
+        fb_rows, f_a_tab, match_dtype=jnp.bfloat16
+    )
+    rows = jnp.take(f_a_tab, idx_ach, axis=0).astype(jnp.float32)
+    d_ach = jnp.sum((fb_rows - rows) ** 2, axis=-1)
+    ratio = float(jnp.mean(d_ach)) / max(float(jnp.mean(d_exact)), 1e-30)
+    match = float(jnp.mean((idx_ach == idx_exact).astype(jnp.float32)))
+    return round(ratio, 4), round(match, 4)
+
+
+def main():
+    max_size = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    # 1024^2 is the CALIBRATION row: its field is independently known
+    # good (35.9 dB PSNR vs the full exact-synthesis oracle, bench.py),
+    # so its probe numbers anchor what ratio/match a ">=35 dB field"
+    # produces under this metric.
+    for size in (1024, 2048, 4096):
+        if size > max_size:
+            break
+        a, ap, b = super_resolution(size)
+        a, ap, b = (jnp.asarray(x, jnp.float32) for x in (a, ap, b))
+        for x in (a, ap, b):
+            _sync(x)
+        cfg = SynthConfig(
+            levels=6 if size > 1024 else 5, matcher="patchmatch",
+            em_iters=2, pm_iters=6,
+        )
+        _sync(create_image_analogy(a, ap, b, cfg))  # compile
+        walls = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = create_image_analogy(a, ap, b, cfg)
+            _sync(out)
+            walls.append(round(time.perf_counter() - t0, 2))
+
+        fd, path = tempfile.mkstemp(suffix=".jsonl")
+        os.close(fd)
+        level_ms, energy = [], None
+        try:
+            # One instrumented run yields both the per-level walls AND
+            # the aux fields the probe needs (same run, not merely the
+            # same seed).
+            aux = create_image_analogy(
+                a, ap, b, cfg, return_aux=True,
+                progress=ProgressWriter(path),
+            )
+            _sync(aux["bp"])
+            for line in open(path):
+                rec = json.loads(line)
+                if rec.get("event") == "level_done":
+                    level_ms.append(rec["wall_ms"])
+                    if rec["level"] == 0:
+                        energy = rec["nnf_energy"]
+        finally:
+            os.unlink(path)
+
+        ratio, match = _exact_probe(a, ap, b, cfg, aux)
+
+        row = {
+            "size": size,
+            "wall_s": min(walls),
+            "wall_runs_s": walls,
+            "level_wall_ms": level_ms,
+            "nnf_energy_level0": energy,
+            "exact_probe_pixels": _N_PROBE,
+            "dist_ratio_vs_exact": ratio,
+            "exact_match_frac": match,
+        }
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
