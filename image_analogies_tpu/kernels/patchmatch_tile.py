@@ -77,7 +77,13 @@ LANE = 128
 TILE_H = 64
 
 # Candidate budget per tile per sweep (static; SMEM-resident per tile).
-K_OWN = 16     # samples of the tile's own per-pixel offsets (coherence)
+# Tuned 2026-07-30 (tools/tune_kernel.py, recorded in README): 4/16/12/4
+# beats the round-2 16/16/12/4 on every axis at the 1024^2 headline —
+# sweep 12.6 ms vs 14.8, wall 1.137 s vs 1.181, PSNR 35.93 vs 35.91 dB.
+# Converged fields make large own-sample sets redundant (the dedup mask
+# already skipped most of them); propagation coverage stays full
+# (K_PROP = 4*K_OWN, the neighbor tiles' whole sample set).
+K_OWN = 4      # samples of the tile's own per-pixel offsets (coherence)
 K_PROP = 16    # samples from the 4 neighbor tiles (propagation)
 K_LOCAL = 12   # shrinking-radius perturbations (random search)
 K_GLOBAL = 4   # uniform over A (random restart)
@@ -349,7 +355,8 @@ def sample_candidates(
     n_ty, n_tx = geom.n_ty, geom.n_tx
     k_jit, k_loc, k_gy, k_gx = jax.random.split(key, 4)
 
-    # Own-tile samples: a jittered 4x4 subgrid of each tile's offsets.
+    # Own-tile samples: a jittered side x side (side = sqrt(K_OWN))
+    # subgrid of each tile's offsets.
     uy, ux = _subgrid(k_jit, geom)
     py = jnp.clip(
         (jnp.arange(n_ty) * th)[:, None, None, None] + uy[None, None, :, None],
@@ -396,7 +403,8 @@ def sample_candidates_blocked(
     """`sample_candidates` reading own-tile samples straight from the
     halo-BLOCKED state planes, so the pm-iteration loop never needs the
     compact layout (round-2 VERDICT: `from_blocked` ran twice per pm
-    iteration only to feed sampling, which reads a 4x4 subgrid/tile).
+    iteration only to feed sampling, which reads a sqrt(K_OWN)-sided
+    subgrid per tile).
 
     Equivalent up to edge tiles: compact sampling clamps out-of-image
     subgrid coordinates to the last row/col, while blocked interiors

@@ -227,3 +227,28 @@ class TestRegistry:
         )
         assert nnf.shape == (6, 6, 2)
         assert float(dist.min()) >= 0.0
+
+
+class TestLeanDistance:
+    def test_matches_dense_reference_all_raggedness(self, rng):
+        """candidate_dist_lean == the dense formulation for every chunk
+        raggedness class: n below one chunk, n a non-128-multiple, and
+        n spanning multiple chunks with a ragged tail (the case where a
+        naive pad would copy the whole B table)."""
+        from image_analogies_tpu.models.matcher import (
+            candidate_dist,
+            candidate_dist_lean,
+        )
+
+        d_feat = 20
+        for n, chunk in [(100, 1 << 20), (1000, 256), (777, 256)]:
+            f_b = jnp.asarray(rng.random((n, d_feat)).astype(np.float32))
+            f_a = jnp.asarray(rng.random((n, d_feat)).astype(np.float32))
+            idx = jnp.asarray(
+                rng.integers(0, n, n, dtype=np.int64).astype(np.int32)
+            )
+            want = candidate_dist(f_b, f_a, idx)
+            got = candidate_dist_lean(f_b, f_a, idx, chunk=chunk)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+            )

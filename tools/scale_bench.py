@@ -35,12 +35,9 @@ enable_compilation_cache()
 from image_analogies_tpu import SynthConfig, create_image_analogy
 from image_analogies_tpu.utils.examples import super_resolution
 from image_analogies_tpu.utils.progress import ProgressWriter
+from image_analogies_tpu.utils.kernelbench import sync as _sync
 
 _N_PROBE = 1 << 17
-
-
-def _sync(x):
-    return float(jnp.sum(x))
 
 
 def _exact_probe(a, ap, b, cfg, aux):
