@@ -100,6 +100,160 @@ def _lean_step_fn_cached(
     )
 
 
+def _batch_prologue_fn(cfg: SynthConfig, levels: int, mesh_key):
+    from ..models.analogy import _strip_noncompute
+
+    return _batch_prologue_fn_cached(
+        _strip_noncompute(cfg), levels, mesh_key
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _batch_prologue_fn_cached(cfg: SynthConfig, levels: int, mesh_key):
+    """Whole batch-chunk prologue as ONE compiled call: channel split +
+    shared-stack remap + every pyramid (A side replicated, frame side
+    vmapped/sharded).  Dispatched eagerly this was ~100 device calls
+    per chunk; on the tunnelled platform host dispatch overhead made
+    the 8x1024^2 config's wall 2.5-3.5x its device time."""
+    mesh = _MESHES[mesh_key]
+    shard = batch_sharding(mesh)
+    repl = replicated(mesh)
+
+    def prologue(a, ap, frames, b_stats):
+        src_a, flt_a, src_b, copy_a, yiq_b = _batched_channels(
+            a, ap, frames, cfg, b_stats=b_stats
+        )
+        pyr_src_a = tuple(
+            _with_steerable(x, cfg) for x in build_pyramid(src_a, levels)
+        )
+        pyr_flt_a = tuple(build_pyramid(flt_a, levels))
+        pyr_copy_a = tuple(build_pyramid(copy_a, levels))
+        vpyr = jax.vmap(lambda x: tuple(build_pyramid(x, levels)))
+        raw_b = vpyr(src_b)
+        pyr_src_b = tuple(
+            jax.vmap(lambda x: _with_steerable(x, cfg))(lvl)
+            for lvl in raw_b
+        )
+        return (
+            pyr_src_a, pyr_flt_a, pyr_copy_a, pyr_src_b, tuple(raw_b),
+            yiq_b,
+        )
+
+    return jax.jit(
+        prologue, in_shardings=(repl, repl, shard, repl)
+    )
+
+
+def _batch_level_fn(cfg: SynthConfig, level: int, has_coarse: bool,
+                    mesh_key, fa_external: bool = False):
+    from ..models.analogy import _strip_noncompute
+
+    return _batch_level_fn_cached(
+        _strip_noncompute(cfg), level, has_coarse, mesh_key, fa_external
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _batch_level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
+                           mesh_key, fa_external: bool = False):
+    """One batch pyramid level as ONE compiled call: A-side feature
+    assembly (+PCA) + kernel A-plane prep + vmapped state glue + all
+    `cfg.em_iters` vmapped EM steps, with data-parallel shardings.
+
+    MAINTENANCE NOTE: this mirrors models/analogy._level_fn_cached (the
+    per-frame PRNG streams are bit-identical to the unfused runner's
+    `frame_keys` derivation) — a change to the level body there (state
+    kinds, lean init, plan dispatch, fa_external policy) must be
+    mirrored here; the bodies differ only by jax.vmap wrapping,
+    shardings, and per-frame key derivation.  `fa_external=True` takes
+    the A-side features as arguments, assembled by the same standalone
+    `_assemble_fa_fn` jit the single driver uses for big style pairs
+    (fusing assembly with the EM steps measured 20 GB of HLO temp at
+    2048^2 — models/analogy._SPLIT_ASSEMBLY_BYTES)."""
+    mesh = _MESHES[mesh_key]
+    shard = batch_sharding(mesh)
+    repl = replicated(mesh)
+    step = make_em_step(cfg, level, has_coarse)
+
+    def run_level(src_a_l, flt_a_l, src_a_c, flt_a_c, src_b_l, src_b_c,
+                  raw_b_l, copy_a_l, prev_nnf, prev_bp, level_key,
+                  frame_idx, f_a_ext=None, proj_ext=None):
+        from ..models.analogy import _level_plan
+        from ..ops.pca import fit_and_project
+
+        h, w = src_b_l.shape[1:3]
+        ha, wa = src_a_l.shape[:2]
+        if fa_external:
+            f_a, proj = f_a_ext, proj_ext
+        else:
+            f_a = assemble_features(
+                src_a_l, flt_a_l, cfg, src_a_c, flt_a_c
+            )
+            f_a, proj = fit_and_project(f_a, cfg.pca_dims)
+
+        a_planes = None
+        plan = _level_plan(cfg, src_a_l, flt_a_l, has_coarse, h, w)
+        if plan is not None:
+            from ..kernels.patchmatch_tile import prepare_a_planes
+
+            specs, use_coarse, n_bands = plan
+            a_planes = prepare_a_planes(
+                src_a_l,
+                flt_a_l,
+                src_a_c if use_coarse else None,
+                flt_a_c if use_coarse else None,
+                specs,
+                n_bands=n_bands,
+            )
+
+        def frame_keys(base_key):
+            return jax.vmap(
+                lambda i: jax.random.fold_in(base_key, i)
+            )(frame_idx)
+
+        if has_coarse:
+            nnf = jax.vmap(
+                lambda n: upsample_nnf(n, (h, w), ha, wa)
+            )(prev_nnf)
+            flt_bp_coarse = prev_bp
+            flt_bp = jax.vmap(lambda x: upsample(x, (h, w)))(prev_bp)
+        else:
+            nnf = jax.vmap(
+                lambda k: random_init(k, h, w, ha, wa)
+            )(frame_keys(jax.random.fold_in(level_key, 0x1217)))
+            flt_bp = raw_b_l
+            flt_bp_coarse = flt_bp
+
+        vstep = jax.vmap(
+            step, in_axes=(0, 0, 0, 0, None, None, 0, 0, None, None)
+        )
+        dist = bp = None
+        for em in range(cfg.em_iters):
+            nnf, dist, bp = vstep(
+                src_b_l,
+                flt_bp,
+                src_b_c if has_coarse else src_b_l,
+                flt_bp_coarse if has_coarse else flt_bp,
+                f_a,
+                copy_a_l,
+                nnf,
+                frame_keys(jax.random.fold_in(level_key, em)),
+                proj,
+                a_planes,
+            )
+            flt_bp = bp
+        return nnf, dist, bp
+
+    return jax.jit(
+        run_level,
+        in_shardings=(
+            repl, repl, repl, repl, shard, shard, shard, repl,
+            shard, shard, repl, repl, repl, repl,
+        ),
+        out_shardings=(shard, shard, shard),
+    )
+
+
 # jit caches need hashable mesh handles; Mesh objects are hashable but we
 # key the lru_cache on a stable token so reruns reuse compilations.
 _MESHES = {}
@@ -219,13 +373,12 @@ def synthesize_batch(
 
     levels = cfg.clamp_levels(a.shape[:2], frames.shape[1:3])
     key = jax.random.PRNGKey(cfg.seed)
-    bp = flt_bp = flt_bp_coarse = nnf = None
+    bp = nnf = None
     # Global frame indices (offset by the chunk position) make per-frame
-    # keys — and therefore outputs — invariant to frames_per_step.
+    # keys — and therefore outputs — invariant to frames_per_step (the
+    # fused level function derives the per-frame key streams from these,
+    # bit-identically to the old host-side frame_keys helper).
     frame_idx = jnp.arange(frames.shape[0]) + _frame_offset
-
-    def frame_keys(base_key):
-        return jax.vmap(lambda i: jax.random.fold_in(base_key, i))(frame_idx)
 
     # Checkpoint identity: the padded chunk shape plus the whole-stack
     # length and this chunk's offset — per-chunk state depends on the
@@ -237,7 +390,6 @@ def synthesize_batch(
     resumed = resume_prologue(resume_from, levels, cfg, fp_shape, progress)
     if resumed is not None:
         start_level, nnf, bp, _aux = resumed
-        flt_bp = bp
         if start_level < 0:
             # Fully-checkpointed run: skip feature/pyramid construction
             # entirely — only the chroma planes are needed to finalize.
@@ -248,72 +400,43 @@ def synthesize_batch(
             )
             return _finalize_batch(bp, yiq_b, frames, cfg)[:n_frames]
 
-    src_a, flt_a, src_b, copy_a, yiq_b = _batched_channels(
-        a, ap, frames, cfg, b_stats=_b_stats
-    )
-
-    pyr_src_a = [_with_steerable(x, cfg) for x in build_pyramid(src_a, levels)]
-    pyr_flt_a = build_pyramid(flt_a, levels)
-    pyr_copy_a = build_pyramid(copy_a, levels)
-
-    vpyr = jax.vmap(lambda x: tuple(build_pyramid(x, levels)))
-    pyr_src_b = [
-        jax.vmap(lambda x: _with_steerable(x, cfg))(lvl)
-        for lvl in vpyr(src_b)
-    ]
-    pyr_raw_b = list(vpyr(src_b))
+    (
+        pyr_src_a, pyr_flt_a, pyr_copy_a, pyr_src_b, pyr_raw_b, yiq_b
+    ) = _batch_prologue_fn(cfg, levels, token)(a, ap, frames, _b_stats)
 
     for level in range(start_level, -1, -1):
-        f_a_src = pyr_src_a[level]
         h, w = pyr_src_b[level].shape[1:3]
-        ha, wa = f_a_src.shape[:2]
         has_coarse = level < levels - 1
 
-        f_a = assemble_features(
-            f_a_src,
+        from ..models.analogy import _assemble_fa_fn, _fa_external
+
+        ha, wa = pyr_src_a[level].shape[:2]
+        fa_ext = _fa_external(ha, wa, lean=False)
+        f_a_ext = proj_ext = None
+        if fa_ext:
+            f_a_ext, proj_ext = _assemble_fa_fn(cfg, has_coarse)(
+                pyr_src_a[level],
+                pyr_flt_a[level],
+                pyr_src_a[level + 1] if has_coarse else None,
+                pyr_flt_a[level + 1] if has_coarse else None,
+            )
+        run = _batch_level_fn(cfg, level, has_coarse, token, fa_ext)
+        nnf, dist, bp = run(
+            pyr_src_a[level],
             pyr_flt_a[level],
-            cfg,
             pyr_src_a[level + 1] if has_coarse else None,
             pyr_flt_a[level + 1] if has_coarse else None,
+            pyr_src_b[level],
+            pyr_src_b[level + 1] if has_coarse else None,
+            pyr_raw_b[level],
+            pyr_copy_a[level],
+            nnf,
+            bp,
+            jax.random.fold_in(key, level),
+            frame_idx,
+            f_a_ext,
+            proj_ext,
         )
-        from ..ops.pca import fit_and_project
-
-        f_a, proj = fit_and_project(f_a, cfg.pca_dims)
-
-        from ..models.analogy import _maybe_a_planes
-
-        a_planes = _maybe_a_planes(
-            cfg, pyr_src_a, pyr_flt_a, level, has_coarse, (h, w)
-        )
-
-        level_key = jax.random.fold_in(key, level)
-        if has_coarse:
-            nnf = jax.vmap(lambda n: upsample_nnf(n, (h, w), ha, wa))(nnf)
-            flt_bp_coarse = flt_bp
-            flt_bp = jax.vmap(lambda x: upsample(x, (h, w)))(flt_bp)
-        else:
-            nnf = jax.vmap(
-                lambda k: random_init(k, h, w, ha, wa)
-            )(frame_keys(jax.random.fold_in(level_key, 0x1217)))
-            flt_bp = pyr_raw_b[level]
-
-        step = _batch_step_fn(cfg, level, has_coarse, token)
-        for em in range(cfg.em_iters):
-            em_keys = frame_keys(jax.random.fold_in(level_key, em))
-            args = (
-                pyr_src_b[level],
-                flt_bp,
-                pyr_src_b[level + 1] if has_coarse else pyr_src_b[level],
-                flt_bp_coarse if has_coarse else flt_bp,
-                f_a,
-                pyr_copy_a[level],
-                nnf,
-                em_keys,
-                proj,
-                a_planes,
-            )
-            nnf, dist, bp = step(*args)
-            flt_bp = bp
 
         if progress is not None:
             progress.emit(

@@ -226,3 +226,21 @@ def test_batch_resume_rejects_stale_stack(tmp_path, rng):
         )
     )
     np.testing.assert_array_equal(resumed6, fresh6)
+
+
+def test_resume_warns_when_nothing_loadable(rng, tmp_path, caplog):
+    """An explicitly-requested resume that finds nothing must warn
+    (ADVICE r2): a silent from-scratch recompute hides a multi-hour
+    surprise."""
+    import logging
+
+    from image_analogies_tpu.models.analogy import resume_prologue
+    from image_analogies_tpu.config import SynthConfig
+
+    with caplog.at_level(logging.WARNING, logger="image_analogies_tpu"):
+        out = resume_prologue(
+            str(tmp_path / "does_not_exist"), 3, SynthConfig(), (32, 32),
+            None,
+        )
+    assert out is None
+    assert any("no usable checkpoint" in r.message for r in caplog.records)

@@ -163,3 +163,36 @@ class TestEndToEnd:
         assert len(r["nnf"]) == 2
         assert r["nnf"][0].shape == (32, 32, 2)
         assert float(r["dist"][0].min()) >= 0.0
+
+
+def test_pm_random_candidates_noop_warning(rng, caplog, monkeypatch):
+    """Tuning pm_random_candidates at kernel-eligible sizes is a no-op
+    on the Pallas path (static K budget) and must say so once
+    (ADVICE r2)."""
+    import logging
+
+    import jax.numpy as jnp
+
+    import image_analogies_tpu.models.analogy as an_mod
+
+    monkeypatch.setattr(an_mod, "_warned_kernel_noop", False)
+    a = rng.random((128, 128)).astype(np.float32)
+    cfg = SynthConfig(
+        matcher="patchmatch", pallas_mode="interpret",
+        pm_random_candidates=9,
+    )
+    with caplog.at_level(logging.WARNING, logger="image_analogies_tpu"):
+        eligible = an_mod._kernel_eligible(
+            cfg, jnp.asarray(a), jnp.asarray(a), False, 128, 128
+        )
+    assert eligible
+    assert any(
+        "pm_random_candidates" in r.message for r in caplog.records
+    )
+    # One-time: a second eligible call must not warn again.
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="image_analogies_tpu"):
+        an_mod._kernel_eligible(
+            cfg, jnp.asarray(a), jnp.asarray(a), False, 128, 128
+        )
+    assert not caplog.records
