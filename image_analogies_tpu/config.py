@@ -54,6 +54,15 @@ class SynthConfig:
     # at no wall-clock cost; doubling again costs ~2x wall for ~+0.3 dB.
     pm_polish_iters: int = 2
     pm_polish_random: int = 4
+    # Run the per-pixel polish only on a level's FINAL EM iteration.
+    # Profiled 2026-07-31 (tools/profile_phases.py): each polish
+    # candidate evaluation gathers every query's (128-lane-padded)
+    # feature row — ~27 ms per candidate at 1024^2, making the polish
+    # ~320 ms of the ~410 ms level-0 EM step.  Mid-EM polish only
+    # refines a field that the next EM iteration re-searches anyway;
+    # the final iteration's polish (which sets the level's output
+    # contract) is kept.  Set False to polish every EM iteration.
+    pm_polish_final_only: bool = True
     seed: int = 0
 
     # Feature weighting: Gaussian falloff over the neighborhood window.

@@ -36,53 +36,83 @@ from jax.experimental.pallas import tpu as pltpu
 _TQ = 256
 _TA = 512
 
+# Grid-size ceiling per pallas_call.  The axon TPU worker reproducibly
+# crashes on very large sequential grids (measured 2026-07-30: the
+# ~134M-step grid of a full 2048^2 all-pairs call kills the worker,
+# while the 8.4M-step 1024^2 grid runs routinely).  Queries are chunked
+# across multiple pallas_call invocations so no single grid exceeds
+# this; 16M sits between the proven-safe 8.4M and the crashing 134M
+# with margin on the safe side of the failure, and was validated by the
+# round-4 full-synthesis 2048^2 oracle run (SCALE_r04).
+_MAX_GRID_STEPS = 16_000_000
 
-def _nn_kernel(fb_ref, fa_ref, asq_ref, idx_ref, dist_ref, best_d, best_i):
-    """One (query-tile i, A-tile j) grid step."""
-    j = pl.program_id(1)
-    n_j = pl.num_programs(1)
 
-    @pl.when(j == 0)
-    def _init():
-        best_d[:] = jnp.full_like(best_d, jnp.inf)
-        best_i[:] = jnp.zeros_like(best_i)
+def _make_nn_kernel(ta: int):
+    """Kernel closure over the A-tile row count (needed for the global
+    index offset j * ta)."""
 
-    # (TQ, D) x (D, TA) on the MXU; f32 accumulation.
-    cross = jax.lax.dot_general(
-        fb_ref[:],
-        fa_ref[:],
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    d = asq_ref[:] - 2.0 * cross  # (TQ, TA); asq broadcasts from (1, TA)
+    def _nn_kernel(fb_ref, fa_ref, asq_ref, idx_ref, dist_ref, best_d,
+                   best_i):
+        """One (query-tile i, A-tile j) grid step."""
+        j = pl.program_id(1)
+        n_j = pl.num_programs(1)
 
-    local_min = jnp.min(d, axis=1, keepdims=True)  # (TQ, 1)
-    local_arg = jnp.argmin(d, axis=1).astype(jnp.int32)[:, None] + j * _TA
+        @pl.when(j == 0)
+        def _init():
+            best_d[:] = jnp.full_like(best_d, jnp.inf)
+            best_i[:] = jnp.zeros_like(best_i)
 
-    better = local_min < best_d[:]
-    best_i[:] = jnp.where(better, local_arg, best_i[:])
-    best_d[:] = jnp.where(better, local_min, best_d[:])
+        # (TQ, D) x (D, TA) on the MXU; f32 accumulation.
+        cross = jax.lax.dot_general(
+            fb_ref[:],
+            fa_ref[:],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        d = asq_ref[:] - 2.0 * cross  # (TQ, TA); asq broadcasts (1, TA)
 
-    @pl.when(j == n_j - 1)
-    def _write():
-        idx_ref[:] = best_i[:]
-        dist_ref[:] = best_d[:]
+        local_min = jnp.min(d, axis=1, keepdims=True)  # (TQ, 1)
+        local_arg = (
+            jnp.argmin(d, axis=1).astype(jnp.int32)[:, None] + j * ta
+        )
+
+        better = local_min < best_d[:]
+        best_i[:] = jnp.where(better, local_arg, best_i[:])
+        best_d[:] = jnp.where(better, local_min, best_d[:])
+
+        @pl.when(j == n_j - 1)
+        def _write():
+            idx_ref[:] = best_i[:]
+            dist_ref[:] = best_d[:]
+
+    return _nn_kernel
 
 
 @functools.partial(
-    jax.jit, static_argnames=("match_dtype", "interpret")
+    jax.jit, static_argnames=("match_dtype", "interpret", "tq", "ta")
 )
 def exact_nn_pallas(
     f_b_flat: jnp.ndarray,
     f_a_flat: jnp.ndarray,
     match_dtype=jnp.float32,
     interpret: bool = False,
+    tq: int = _TQ,
+    ta: int = _TA,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Exact NN via the streaming kernel; mirrors `brute.exact_nn`.
 
     Returns (idx (N,), dist (N,)) with `dist` recomputed exactly (direct
     subtraction in f32) for the winning rows, like the XLA path, so the
     kappa accept tests downstream see a cancellation-free metric.
+
+    `tq`/`ta` override the query/database tile rows.  The kernel's HBM
+    traffic is |B| + (N_B/tq) * |A| — the whole A table streams through
+    VMEM once per query tile — so giant-A calls (the full-synthesis
+    2048^2 oracle, the 4096^2 stratified probe) want the largest tq the
+    (tq, ta) f32 distance tile leaves VMEM room for: (4096, 256) puts
+    the distance tile at 4 MB and cuts A re-streaming 16x vs the
+    (256, 512) default, which stays optimal for the small-N calls the
+    synthesis pipeline makes.
     """
     n, d_feat = f_b_flat.shape
     n_a = f_a_flat.shape[0]
@@ -90,8 +120,8 @@ def exact_nn_pallas(
 
     # Pad D to lanes, N_B/N_A to tile multiples.
     d_pad = (-d_feat) % 128
-    q_pad = (-n) % _TQ
-    a_pad = (-n_a) % _TA
+    q_pad = (-n) % tq
+    a_pad = (-n_a) % ta
     fb = jnp.pad(f_b_flat, ((0, q_pad), (0, d_pad))).astype(match_dtype)
     fa = jnp.pad(f_a_flat, ((0, a_pad), (0, d_pad))).astype(match_dtype)
     # ||a||^2 in f32; +inf on padded rows so they never win the argmin.
@@ -100,37 +130,70 @@ def exact_nn_pallas(
     )
     a_sq = jnp.pad(a_sq, (0, a_pad), constant_values=jnp.inf)[None, :]
 
-    grid = (fb.shape[0] // _TQ, fa.shape[0] // _TA)
-    idx, dist = pl.pallas_call(
-        _nn_kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(
-                (_TQ, fb.shape[1]), lambda i, j: (i, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (_TA, fa.shape[1]), lambda i, j: (j, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, _TA), lambda i, j: (0, j), memory_space=pltpu.VMEM
-            ),
-        ],
-        out_specs=[
-            pl.BlockSpec((_TQ, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((_TQ, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((fb.shape[0], 1), jnp.int32),
-            jax.ShapeDtypeStruct((fb.shape[0], 1), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((_TQ, 1), jnp.float32),
-            pltpu.VMEM((_TQ, 1), jnp.int32),
-        ],
-        interpret=interpret,
-    )(fb, fa, a_sq)
+    grid_a = fa.shape[0] // ta
+    # Chunk the query axis so no single pallas_call's grid exceeds
+    # _MAX_GRID_STEPS (the ~134M-step full 2048^2 grid crashed the TPU
+    # worker — see the constant above).  A-tiles never need chunking:
+    # grid_a alone exceeding the cap would take an N_A beyond any
+    # supported image.  Chunks are equal-sized (fb re-padded up to a
+    # chunk multiple) so one compiled kernel serves every chunk.
+    q_tiles = fb.shape[0] // tq
+    chunk_tiles = max(1, min(q_tiles, _MAX_GRID_STEPS // grid_a))
+    n_chunks = -(-q_tiles // chunk_tiles)
+    chunk_rows = chunk_tiles * tq
+    fb = jnp.pad(fb, ((0, n_chunks * chunk_rows - fb.shape[0]), (0, 0)))
+
+    def one_chunk(fb_chunk):
+        return pl.pallas_call(
+            _make_nn_kernel(ta),
+            grid=(chunk_tiles, grid_a),
+            in_specs=[
+                pl.BlockSpec(
+                    (tq, fb_chunk.shape[1]), lambda i, j: (i, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (ta, fa.shape[1]), lambda i, j: (j, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, ta), lambda i, j: (0, j), memory_space=pltpu.VMEM
+                ),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (tq, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+                ),
+                pl.BlockSpec(
+                    (tq, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+                ),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((fb_chunk.shape[0], 1), jnp.int32),
+                jax.ShapeDtypeStruct((fb_chunk.shape[0], 1), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((tq, 1), jnp.float32),
+                pltpu.VMEM((tq, 1), jnp.int32),
+            ],
+            interpret=interpret,
+        )(fb_chunk, fa, a_sq)
+
+    if n_chunks == 1:
+        idx = one_chunk(fb)[0]
+    else:
+        idx = jnp.concatenate(
+            [
+                one_chunk(
+                    jax.lax.slice(
+                        fb, (c * chunk_rows, 0),
+                        ((c + 1) * chunk_rows, fb.shape[1]),
+                    )
+                )[0]
+                for c in range(n_chunks)
+            ],
+            axis=0,
+        )
 
     idx = idx[:n, 0]
     # Exact winner distance (direct subtraction, f32), immune to the

@@ -20,24 +20,46 @@ divergence"):
     per-pixel state each sweep.
   - **Raw planes, not feature vectors.**  Distances are computed from the
     raw (source, filtered, upsampled-coarse) image planes with the
-    separable Gaussian window applied in-kernel, so the VMEM-resident
-    A-side is C planes of (Ha, Wa) f32 instead of a (Ha*Wa, D) feature
-    table (200 MB at 1024^2).  Planes are f32, not bf16: Mosaic on this
-    toolchain cannot dynamically slice bf16 arrays on sublane dims at all
-    (vector.load internal error even 8-aligned — verified).  To stay
-    inside VMEM, `plan_channels` picks the largest channel set and the
-    smallest A row-band count that fit the budget; an A side larger than
-    VMEM streams band by band (one sweep call per band; each candidate
-    is evaluated only in the band containing its clamped origin, so
-    sweep compute does not scale with the band count, and the per-pixel
-    best carried across bands makes the union a global search).
+    separable Gaussian window applied in-kernel, so the A-side is C
+    planes of (Ha, Wa) f32 instead of a (Ha*Wa, D) feature table (200 MB
+    at 1024^2).  Planes are f32, not bf16: Mosaic on this toolchain
+    cannot dynamically slice bf16 arrays on sublane dims at all
+    (vector.load internal error even 8-aligned — verified).
+  - **A stays in HBM; candidate slices stream in by DMA.**  The A planes
+    are ONE (Hp, Wq, C, 128) HBM-resident operand (`memory_space=ANY`);
+    each candidate's (thp, 2, C, 128) window is fetched into a
+    double-buffered VMEM slot with `pltpu.make_async_copy`, prefetched
+    one candidate ahead so the DMA hides behind the previous
+    candidate's arithmetic.  Rounds 1-3 instead kept a whole A row-band
+    VMEM-resident and called the sweep once per band; measured 2026-07-31
+    (README kernel log), that design was PIPELINE-bound, not
+    compute-bound: every band call re-streamed all B channel tiles and
+    6 state planes, so a 3-band 1024^2 sweep spent 12.3 of its 12.9 ms
+    moving tiles (copy-only kernel body) and a 17-band 4096^2 sweep paid
+    the restream 17x.  With A in HBM there is exactly one sweep call per
+    pm iteration at EVERY size, the B/state streaming happens once, and
+    the channel plan no longer shrinks at large sizes — 2048^2/4096^2
+    get the full coarse channel set back.  The banded path (ownership
+    bounds + per-band calls) remains available behind an explicit
+    budget for the spatially-sharded-A runner, where each device owns an
+    A row range by construction.
   - **Lane alignment via dynamic rotate.**  Mosaic cannot dynamically
     slice the lane (minor) dimension at unaligned offsets.  A-planes are
     stored as (C, Hp, Wq, 128); a candidate column range [sx, sx+128) is
     materialized by slicing two adjacent 128-lane blocks and combining
-    them with `pltpu.roll` (tpu.dynamic_rotate) + an iota select.  The
-    5x5 window sum is separable (Gaussian/uniform), applied as static
-    lane/sublane rolls — no lane slicing anywhere.
+    them with `pltpu.roll` (tpu.dynamic_rotate) + an iota select.
+  - **Window sums on the MXU.**  The separable 5x5 window sum is two
+    banded-matrix contractions: along lanes `xs = dq @ Wx` with a banded
+    (LANE, LANE) weight matrix, along sublanes `d += Wy @ xs` with a
+    banded (THP, THP) one — systolic-array work instead of the 10 serial
+    VPU roll+mul+add passes per channel the round-3 kernel used (which
+    held it at 7.3% of VPU peak with the MXU idle).  Channels sharing a
+    window spec (fine vs dilated-coarse) are summed *before* the
+    contraction, so a 4-channel candidate costs 4 diff-square passes and
+    4 matmuls total.  The banded matrices clip at tile edges rather than
+    wrapping like the rolls did; interior pixels (the only ones
+    `from_blocked` keeps and the only ones sampled) are bit-identical
+    because the halo always covers the window reach.
   - **Candidate generation stays in XLA.**  Sampling offsets from the
     NN-field state (own-tile samples = Ashikhmin coherence candidates,
     neighbor-tile samples = PatchMatch propagation, shrinking-radius
@@ -227,22 +249,32 @@ def prepare_a_planes(
     n_bands: int = 1,
 ) -> Tuple[jnp.ndarray, ...]:
     """A-side planes packed for the kernel: a tuple of `n_bands` arrays,
-    each (C, band_rows+TILE_H-1+2P+pad, Wq, 128) f32 covering A rows
-    [i*band_rows, (i+1)*band_rows) with window halos.
+    each (band_rows+TILE_H-1+2P+pad, Wq, C, 128) f32 covering A rows
+    [i*band_rows, (i+1)*band_rows) with window halos.  The channel axis
+    sits THIRD so ONE in-kernel DMA fetches a candidate's (thp, 2, C,
+    128) all-channel window (per-channel planes would cost C DMA issues
+    per candidate) while both dynamically-sliced axes (rows, Wq blocks)
+    stay untiled — Mosaic requires tiled-axis slices be whole/8-aligned,
+    so a (.., Wq, C*128) packing whose Wq is the sublane axis cannot be
+    sliced 2 blocks at a time (verified: "Slice shape along dimension 1
+    must be aligned to tiling (8)").  The trailing (C, 128) pays the
+    C -> 8 sublane pad in HBM and in the DMA, the price of arbitrary
+    dynamic offsets on the sliced axes.
 
-    Bands OWN a disjoint origin range [i*band_rows, (i+1)*band_rows)
-    (the kernel's in_band test) but are RESIDENT for TILE_H-1 extra
-    rows past it, so a tile origin anywhere in the owned range is
-    evaluated at its true position — no origin is clamped/displaced at
-    a band seam, and none is evaluated twice (ADVICE r2: the previous
-    layout displaced origins in each band's last TILE_H-1 rows to the
-    band's final resident origin).
+    The default is a single HBM-resident plane set (the kernel streams
+    candidate windows from it by DMA).  With n_bands > 1, bands OWN a
+    disjoint origin range [i*band_rows, (i+1)*band_rows) (the kernel's
+    in_band test) but are RESIDENT for TILE_H-1 extra rows past it, so
+    a tile origin anywhere in the owned range is evaluated at its true
+    position — no origin is clamped/displaced at a band seam, and none
+    is evaluated twice.  Banding is for callers that split A ownership
+    across devices (parallel/spatial.py); single-device plans are
+    always 1 band.
 
     Edge padding mirrors ops.features.extract_patches (windows at A's
     border replicate edge pixels).  One guard lane-block on the right
     keeps the two-block candidate load in bounds for any clamped sx.
-    Pass `src_coarse=None` to build the fine-only channel subset; bands
-    > 1 stream an A side that exceeds VMEM (plan_channels decides both).
+    Pass `src_coarse=None` to build the fine-only channel subset.
     """
     p = halo_for(specs)
     chans = channel_images(src, flt, src_coarse, flt_coarse)
@@ -261,16 +293,16 @@ def prepare_a_planes(
         c = jnp.pad(
             c, ((p, pad_bottom), (p, wq * LANE - wa - p)), mode="edge"
         )
-        full.append(c.reshape(c.shape[0], wq, LANE))
-    stacked = jnp.stack(full).astype(jnp.float32)
+        full.append(c.reshape(c.shape[0], wq, LANE).astype(jnp.float32))
+    packed = jnp.stack(full, axis=2)  # (Hp, Wq, C, LANE)
     bands = []
     for i in range(n_bands):
         bands.append(
             jax.lax.slice_in_dim(
-                stacked,
+                packed,
                 i * rows_b,
                 i * rows_b + rows_b + overlap + 2 * p + extra,
-                axis=1,
+                axis=0,
             )
         )
     return tuple(bands)
@@ -489,6 +521,65 @@ def _candidate_tables(own_y, own_x, k_loc, k_gy, k_gx, geom, ha, wa):
 # The kernel
 
 
+def spec_groups(
+    specs: Tuple[ChannelSpec, ...],
+) -> Tuple[Tuple[ChannelSpec, Tuple[int, ...]], ...]:
+    """Channels grouped by identical window spec, preserving first-seen
+    order: the windowed-SSD sum over a group's channels commutes with the
+    (shared) window contraction, so each group needs one Wx/Wy matmul
+    pair regardless of how many channels it holds."""
+    groups: list = []
+    for c, sp in enumerate(specs):
+        for g, (gsp, chans) in enumerate(groups):
+            if gsp == sp:
+                groups[g] = (gsp, chans + (c,))
+                break
+        else:
+            groups.append((sp, (c,)))
+    return tuple(groups)
+
+
+def _band_matrix(n: int, weights, dilation: int) -> np.ndarray:
+    """Banded window matrix B with B[i, i + (t-r)*dilation] = weights[t]
+    (rows clip at the edges — no wraparound; the halo keeps interior
+    pixels' windows fully in range, so interiors match the roll
+    formulation exactly while halo rows differ only in values that
+    from_blocked drops)."""
+    m = np.zeros((n, n), np.float32)
+    r = len(weights) // 2
+    idx = np.arange(n)
+    for t, wgt in enumerate(weights):
+        j = idx + (t - r) * dilation
+        ok = (j >= 0) & (j < n)
+        m[idx[ok], j[ok]] += wgt
+    return m
+
+
+def window_matrices(
+    specs: Tuple[ChannelSpec, ...], thp: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(wx, wy) stacked per group: wx (G, LANE, LANE) with xs = dq @ wx[g]
+    the lane-axis window sum, wy (G, THP, THP) with d = wy[g] @ xs the
+    sublane-axis one."""
+    groups = spec_groups(specs)
+    wx = np.stack(
+        [_band_matrix(LANE, sp.wx, sp.dilation).T for sp, _ in groups]
+    )
+    wy = np.stack(
+        [_band_matrix(thp, sp.wy, sp.dilation) for sp, _ in groups]
+    )
+    return wx, wy
+
+
+# Candidate-window prefetch depth: slot k%D is refilled for candidate
+# k+D right after candidate k's arithmetic consumes it, so each DMA has
+# D-1 candidate evaluations of latency cover (measured 2026-07-31: the
+# candidate fetch runs ~3.5 us through the DMA engines vs ~1 us of
+# per-candidate arithmetic, so depth 2 left the sweep DMA-latency-bound
+# at 18.4 ms; deeper slots trade ~300 KB of VMEM each for full overlap).
+_PREFETCH_DEPTH = 6
+
+
 def _make_kernel(
     specs: Tuple[ChannelSpec, ...],
     geom: TileGeometry,
@@ -498,23 +589,37 @@ def _make_kernel(
 ):
     """The SMEM `band_ref` (row0, rows_own) selects the A row *band*
     this call can match into (global origin rows [row0, row0+rows_own));
-    with one band it is (0, ha).  Banding streams an A side larger than
-    VMEM: each band gets its own sweep call, a candidate is evaluated
-    only in the one band OWNING its globally-clamped origin (the
-    in_band cond below — out-of-band candidates skip all vector work),
-    and the carried per-pixel best makes the union over bands a global
-    search.  Bands are resident for TILE_H-1 rows past their owned
-    range (prepare_a_planes), so every owned origin is evaluated at its
-    true position — no seam displacement, no double evaluation.  The
-    bounds are scalar operands, not static args, so one compiled kernel
-    serves every band of a level."""
+    single-device plans pass (0, ha).  A candidate counts only in the
+    band OWNING its globally-clamped origin (the `ok` mask below), and
+    the carried per-pixel best makes the union over band calls a global
+    search — the ownership contract the spatial sharded-A runner needs.
+    Bands are resident for TILE_H-1 rows past their owned range
+    (prepare_a_planes), so every owned origin is evaluated at its true
+    position — no seam displacement, no double evaluation.  The bounds
+    are scalar operands, not static args, so one compiled kernel serves
+    every band of a level.
+
+    Structure (round-4 redesign, measured rationale in the module
+    docstring): candidate windows are DMA-streamed from the HBM A
+    operand into double-buffered VMEM slots; evaluation is straight-line
+    (no lax.cond, no fori_loop — a round-3 bisect measured the serial
+    cond+fori skeleton alone at 8.6 ms of the 12.9 ms sweep because each
+    iteration's scalar->vector dependency chain serialized); masked-out
+    candidates (out-of-band / dedup-duplicate) contribute +inf instead
+    of branching.  Coherent and approximate candidates accumulate into
+    two independent running minima merged once through the kappa factor
+    — Hertzmann §3.2's actual rule (best coherent vs best approximate),
+    order-independent, unlike the round-3 sequential cascade where an
+    early-accepted random candidate's raw distance became the bar for
+    later coherent ones."""
     p, th, tw = geom.halo, geom.tile_h, geom.tile_w
     thp = geom.thp
-    n_chan = len(specs)
+    groups = spec_groups(specs)
     sx_max = wa - tw
 
-    def kernel(band_ref, cy_ref, cx_ref, valid_ref, a_ref, b_ref, oyi_ref,
-               oxi_ref, di_ref, oyo_ref, oxo_ref, do_ref):
+    def kernel(band_ref, cy_ref, cx_ref, valid_ref, wx_ref, wy_ref, a_ref,
+               b_ref, oyi_ref, oxi_ref, di_ref, oyo_ref, oxo_ref, do_ref,
+               slots_ref, sems_ref):
         i = pl.program_id(0)
         j = pl.program_id(1)
         ty0 = i * th
@@ -525,79 +630,104 @@ def _make_kernel(
         row = (i * geom.n_tx + j) % 8
         row0 = band_ref[0]
         # Band-local slice bound: resident rows cover every owned origin
-        # exactly (defensive clip only — in_band already bounds sy).
-        sy_cap = a_ref.shape[1] - thp
+        # exactly (defensive clip only — `ok` already bounds sy).
+        sy_cap = a_ref.shape[0] - thp
 
-        b_blk = b_ref[:].astype(jnp.float32)  # (C, THP, LANE)
-        lane = jax.lax.broadcasted_iota(jnp.int32, (thp, LANE), 1)
-
-        def eval_candidate(k, carry):
+        def scalars(k):
             oy = cy_ref[row, k]
             ox = cx_ref[row, k]
-            # Bands partition [0, ha) by ownership: evaluate a candidate
-            # only in the one band owning its (globally clamped) tile
-            # origin, so banded sweeps cost one evaluation per candidate
-            # per pm iteration rather than n_bands of them — the scalar
-            # cond is tile-uniform, so out-of-band candidates skip all
-            # vector work.  `valid` additionally skips candidates that
-            # duplicate an earlier SMEM slot (converged fields make
-            # own/prop samples collide; re-evaluating identical offsets
-            # wastes whole-window SSD work).
             sy_g = jnp.clip(ty0 + oy, 0, ha - th)
-            in_band = (
+            ok = (
                 (sy_g >= row0)
                 & (sy_g < row0 + band_ref[1])
                 & (valid_ref[row, k] > 0)
             )
+            sy = jnp.clip(sy_g - row0, 0, sy_cap)  # band-local
+            sx = jnp.clip(tx0 + ox, 0, sx_max)
+            return ok, sy, sx
 
-            def do_eval(carry):
-                best_d, best_y, best_x = carry
-                sy = jnp.clip(sy_g - row0, 0, sy_cap)  # band-local
-                sx = jnp.clip(tx0 + ox, 0, sx_max)
-                xq = sx // LANE
-                xr = sx % LANE
-                rot_amt = (LANE - xr) % LANE
+        def copy_for(k, slot):
+            """Async fetch of candidate k's (thp, 2, C, LANE) all-channel
+            window from the HBM A operand into VMEM slot `slot` (the
+            wait side rebuilds the same descriptor — it only decrements
+            the slot's semaphore)."""
+            _, sy, sx = scalars(k)
+            return pltpu.make_async_copy(
+                a_ref.at[pl.ds(sy, thp), pl.ds(sx // LANE, 2)],
+                slots_ref.at[slot],
+                sems_ref.at[slot],
+            )
 
-                d = jnp.zeros((thp, LANE), jnp.float32)
-                for c in range(n_chan):
-                    sp = specs[c]
-                    r = len(sp.wy) // 2
+        for k in range(_PREFETCH_DEPTH):
+            copy_for(k, k).start()
+
+        b_blk = b_ref[:].astype(jnp.float32)  # (C, THP, LANE)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (thp, LANE), 1)
+
+        d_coh, y_coh, x_coh = di_ref[:], oyi_ref[:], oxi_ref[:]
+        d_app = jnp.full((thp, LANE), jnp.inf, jnp.float32)
+        y_app = jnp.zeros((thp, LANE), jnp.int32)
+        x_app = jnp.zeros((thp, LANE), jnp.int32)
+        for k in range(K_TOTAL):
+            slot = k % _PREFETCH_DEPTH
+            copy_for(k, slot).wait()
+            ok, sy, sx = scalars(k)
+            xr = sx % LANE
+            rot_amt = (LANE - xr) % LANE
+
+            d = jnp.zeros((thp, LANE), jnp.float32)
+            for g, (_sp, chans) in enumerate(groups):
+                acc = None
+                for c in chans:
                     # Two adjacent lane blocks -> rotate -> select: the
                     # unaligned 128-lane window [sx, sx+128) of plane c.
-                    blk = a_ref[c, pl.ds(sy, thp), pl.ds(xq, 2), :]
+                    blk = slots_ref[slot, :, :, c, :]
                     rot = pltpu.roll(blk, rot_amt, 2)
                     al = jnp.where(
                         lane < LANE - xr, rot[:, 0, :], rot[:, 1, :]
                     ).astype(jnp.float32)
                     dq = b_blk[c] - al
                     dq = dq * dq
-                    # Separable window: static lane then sublane rolls.
-                    xs = jnp.zeros_like(dq)
-                    for t, wgt in enumerate(sp.wx):
-                        dx = (t - r) * sp.dilation
-                        xs = xs + wgt * pltpu.roll(dq, (LANE - dx) % LANE, 1)
-                    for t, wgt in enumerate(sp.wy):
-                        dy = (t - r) * sp.dilation
-                        d = d + wgt * pltpu.roll(xs, (thp - dy) % thp, 0)
+                    acc = dq if acc is None else acc + dq
+                # Separable window sum as two banded contractions on the
+                # MXU (HIGHEST precision: bf16x6 passes, f32-accurate —
+                # the interpret-mode oracle tests compare at rtol 1e-4
+                # and the exact-metric merge downstream assumes a sane
+                # kernel metric).
+                xs = jax.lax.dot_general(
+                    acc,
+                    wx_ref[g],
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+                d = d + jax.lax.dot_general(
+                    wy_ref[g],
+                    xs,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+            d = jnp.where(ok, d, jnp.inf)
+            oy_out = sy + row0 - ty0
+            ox_out = sx - tx0
+            if k < K_COHERENT:
+                acc_c = d < d_coh
+                d_coh = jnp.where(acc_c, d, d_coh)
+                y_coh = jnp.where(acc_c, oy_out, y_coh)
+                x_coh = jnp.where(acc_c, ox_out, x_coh)
+            else:
+                acc_a = d < d_app
+                d_app = jnp.where(acc_a, d, d_app)
+                y_app = jnp.where(acc_a, oy_out, y_app)
+                x_app = jnp.where(acc_a, ox_out, x_app)
+            if k + _PREFETCH_DEPTH < K_TOTAL:
+                copy_for(k + _PREFETCH_DEPTH, slot).start()
 
-                factor = jnp.where(k < K_COHERENT, 1.0, coh_factor)
-                accept = d * factor < best_d
-                best_d = jnp.where(accept, d, best_d)
-                best_y = jnp.where(accept, sy + row0 - ty0, best_y)
-                best_x = jnp.where(accept, sx - tx0, best_x)
-                return best_d, best_y, best_x
-
-            return jax.lax.cond(in_band, do_eval, lambda c: c, carry)
-
-        best = jax.lax.fori_loop(
-            0,
-            K_TOTAL,
-            eval_candidate,
-            (di_ref[:], oyi_ref[:], oxi_ref[:]),
-        )
-        do_ref[:] = best[0]
-        oyo_ref[:] = best[1]
-        oxo_ref[:] = best[2]
+        take_app = d_app * coh_factor < d_coh
+        do_ref[:] = jnp.where(take_app, d_app, d_coh)
+        oyo_ref[:] = jnp.where(take_app, y_app, y_coh)
+        oxo_ref[:] = jnp.where(take_app, x_app, x_coh)
 
     return kernel
 
@@ -627,15 +757,17 @@ def tile_sweep(
     """One propagate+random-search sweep over every tile, against the A
     band described by `band` = (row0, rows_own) int32 (None: all of A).
 
-    `off_y/off_x/dist` are halo-blocked state planes; `dist` is carried in
-    the kernel's metric across sweeps (monotone non-increasing per pixel).
-    `cand_valid` is the dedup mask the samplers produce (None: computed
-    here — the samplers hoist it so banded levels don't recompute it per
-    band call).
+    `a_planes` is ONE (rows, Wq, C*128) f32 array (prepare_a_planes); it
+    stays in HBM (`memory_space=ANY`) and the kernel DMA-streams each
+    candidate's window from it.  `off_y/off_x/dist` are halo-blocked
+    state planes; `dist` is carried in the kernel's metric across sweeps
+    (monotone non-increasing per pixel).  `cand_valid` is the dedup mask
+    the samplers produce (None: computed here — the samplers hoist it so
+    multi-band callers don't recompute it per band call).
     """
     thp = geom.thp
     n_ty, n_tx = geom.n_ty, geom.n_tx
-    n_chan = a_planes.shape[0]
+    n_chan = a_planes.shape[2]
     if band is None:
         band = jnp.asarray([0, ha], jnp.int32)
     if cand_valid is None:
@@ -654,6 +786,12 @@ def tile_sweep(
     cand_valid = jnp.pad(
         cand_valid.reshape(n_tiles, K_TOTAL), ((0, pad8), (0, 0))
     )
+
+    # Banded window matrices, one (Wx, Wy) pair per spec group; constant
+    # across the grid, so the pipeline fetches them into VMEM once.
+    wx_np, wy_np = window_matrices(specs, thp)
+    wx = jnp.asarray(wx_np)
+    wy = jnp.asarray(wy_np)
 
     kernel = _make_kernel(specs, geom, ha, wa, coh_factor)
     state_blk = lambda i, j: (i, j)  # noqa: E731
@@ -686,9 +824,14 @@ def tile_sweep(
                 memory_space=pltpu.SMEM,
             ),
             pl.BlockSpec(
-                a_planes.shape, lambda i, j: (0, 0, 0, 0),
-                memory_space=pltpu.VMEM,
+                wx.shape, lambda i, j: (0, 0, 0), memory_space=pltpu.VMEM
             ),
+            pl.BlockSpec(
+                wy.shape, lambda i, j: (0, 0, 0), memory_space=pltpu.VMEM
+            ),
+            # The A planes stay in HBM; the kernel streams candidate
+            # windows from them with manual async copies.
+            pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(
                 (n_chan, thp, LANE), lambda i, j: (0, i, j),
                 memory_space=pltpu.VMEM,
@@ -707,9 +850,15 @@ def tile_sweep(
             jax.ShapeDtypeStruct((n_ty * thp, n_tx * LANE), jnp.int32),
             jax.ShapeDtypeStruct((n_ty * thp, n_tx * LANE), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM(
+                (_PREFETCH_DEPTH, thp, 2, n_chan, LANE), jnp.float32
+            ),
+            pltpu.SemaphoreType.DMA((_PREFETCH_DEPTH,)),
+        ],
         interpret=interpret,
-    )(band, cand_y, cand_x, cand_valid, a_planes, b_blocked, off_y, off_x,
-      dist)
+    )(band, cand_y, cand_x, cand_valid, wx, wy, a_planes, b_blocked, off_y,
+      off_x, dist)
     return out  # (off_y, off_x, dist) blocked
 
 
@@ -718,8 +867,11 @@ def tile_sweep(
 
 
 def vmem_estimate(specs, ha: int, wa: int, n_bands: int = 1) -> int:
-    """Bytes of VMEM one resident A band needs (f32 planes), including
-    the TILE_H-1 ownership-overlap rows banding adds (prepare_a_planes)."""
+    """Bytes one prepared A band array occupies (f32 planes), including
+    the TILE_H-1 ownership-overlap rows banding adds (prepare_a_planes).
+    Since the round-4 HBM-streaming redesign this is HBM residency, not
+    VMEM — it sizes the banded path's per-device A share for the
+    spatial sharded-A runner, and the explicit-budget test path."""
     p = halo_for(specs)
     wq = -(-(wa + 2 * p) // LANE) + 1
     geom = tile_geometry(ha, wa, specs)
@@ -729,17 +881,21 @@ def vmem_estimate(specs, ha: int, wa: int, n_bands: int = 1) -> int:
     return len(specs) * rows * wq * LANE * 4
 
 
-def non_a_vmem(specs) -> int:
-    """Static estimate of the kernel's non-A VMEM per grid step, derived
-    from the same plan the A estimate uses (VERDICT r2: replaces the
-    former hand-measured constant budget):
+def kernel_vmem(specs) -> int:
+    """Static estimate of the kernel's VMEM per grid step (the A side is
+    HBM-resident since the round-4 redesign, so this is the WHOLE VMEM
+    story):
 
       - the B channel tile block, double-buffered across grid steps by
         the Pallas pipeline, plus its in-kernel f32 working copy;
       - 6 state planes (oy/ox/d in and out), double-buffered;
-      - candidate-evaluation temporaries (two 2-lane-block A slices,
-        rotate result, aligned window, squared diff, separable partial,
-        accumulator — all (THP, LANE) f32).
+      - the candidate-window DMA slots ((DEPTH, THP, 2, C->8pad, LANE)
+        f32 — the trailing (C, LANE) dims pay the 8-sublane pad);
+      - the per-group banded window matrices (Wx (LANE, LANE) + Wy
+        (THP, THP->LANE-padded) f32, fetched once);
+      - evaluation temporaries (rotate result, aligned window, squared
+        diff / group accumulator, matmul operand+result, two reduction
+        chains — all (THP, LANE) f32).
 
     The SMEM candidate tables live in the separate 1 MB SMEM space and
     are not counted here.
@@ -748,56 +904,30 @@ def non_a_vmem(specs) -> int:
     thp = -(-(TILE_H + 2 * p) // 8) * 8
     plane = thp * LANE * 4
     n_chan = len(specs)
+    n_groups = len(spec_groups(specs))
     b_tiles = n_chan * plane * 3        # 2x pipeline buffers + f32 copy
     state = 6 * plane * 2               # 3 in + 3 out, double-buffered
-    temps = (2 * 2 + 4) * plane         # two 2-block slices + 4 planes
-    return b_tiles + state + temps
+    c_pad = -(-n_chan // 8) * 8
+    slots = _PREFETCH_DEPTH * thp * 2 * c_pad * LANE * 4
+    temps = 10 * plane                  # rotate/select/dq/matmul/chains
+    wmats = n_groups * (LANE * LANE + thp * LANE) * 4
+    return b_tiles + state + slots + temps + wmats
 
 
-# VMEM budget for the resident A band: the 16 MB/core spec minus the
-# statically-derived non-A footprint minus a scheduler reserve for
-# Mosaic scratch the static model cannot see (spills, live-range
-# overlap of the unrolled per-channel temporaries, vector constant
-# pools, vmap batching overhead).  The reserve scales with the channel
-# count: calibration points on this toolchain — 12-channel steerable
-# 1024^2 measured 6.63 MB of scoped non-A VMEM (a 4 MB flat reserve
-# compile-OOMed by 752 KB), 4-channel vmap-batched 8x1024^2 measured
-# ~6.3 MB in round 2 — both sit under flat 4 MB + 256 KB/channel +
-# the static model.
 VMEM_SPEC = 16 * 1024 * 1024
-VMEM_SCHED_RESERVE_FLAT = 4 * 1024 * 1024
-VMEM_SCHED_RESERVE_PER_CHAN = 256 * 1024
 
-
-def vmem_budget(specs) -> int:
-    reserve = (
-        VMEM_SCHED_RESERVE_FLAT
-        + VMEM_SCHED_RESERVE_PER_CHAN * len(specs)
-    )
-    return VMEM_SPEC - reserve - non_a_vmem(specs)
-# Candidates are evaluated only in the band that OWNS them (the
-# kernel's in_band cond), so sweep COMPUTE does not scale with the band
-# count — but the per-band-call costs do: every band call re-streams
-# the blocked B channels and state planes ((n_chan + 6) tile blocks per
-# tile), so sweep HBM traffic grows linearly in n_bands.  The derived
-# VMEM budget (vmem_budget) already minimizes n_bands per channel set;
-# past ~40 band calls the restream dominates any search benefit of the
-# richer channel set, and the plan prefers fewer channels (fine-only)
-# or hands off to the XLA gather path.  Current landscape (4-channel
-# default config; pinned by tests/test_pallas_patchmatch.py
-# TestEligibility): 1024^2 coarse/3 bands, 2048^2 coarse/10, 4096^2
-# fine-only/17 (the largest-band design point), 6144^2+ gather path.
+# Bound on the banded path's band count (explicit-budget callers only:
+# the spatial sharded-A runner and tests).  Single-device plans are
+# always 1 band since the HBM-streaming redesign.
 MAX_BANDS = 40
 
 
 def _bands_needed(specs, ha: int, wa: int, budget: int) -> Optional[int]:
-    """Smallest band count whose resident band fits `budget`, or None.
+    """Smallest band count whose band array fits `budget`, or None.
 
     Any owned-row count >= 1 is valid under the ownership scheme (bands
     are resident TILE_H-1 rows past their owned range, so no clamp
-    bound can invert — the constraint that previously forced every
-    band, including the remainder last one, to keep >= TILE_H rows is
-    gone)."""
+    bound can invert)."""
     for n in range(1, MAX_BANDS + 1):
         if ha - (n - 1) * band_rows(ha, n) < 1:
             continue  # degenerate split: last band owns nothing
@@ -811,14 +941,23 @@ def plan_channels(
     h: int, w: int, ha: int, wa: int,
     budget: Optional[int] = None,
 ):
-    """Pick the largest channel set (and smallest A band count) that fits
-    the VMEM budget (derived per channel set by `vmem_budget` unless an
-    explicit override is given — tests force tiny budgets).
+    """Resolve the kernel plan (specs, use_coarse, n_bands) for a level,
+    or None when the level's geometry is kernel-ineligible.
 
-    Returns (specs, use_coarse, n_bands) or None when the level is
-    ineligible for the kernel.  Both the driver (A-plane prep) and the
-    matcher (B-side prep) derive the same plan from the same static
-    shapes, so the two sides always agree on the layout.
+    Since the round-4 HBM-streaming redesign the A side no longer
+    competes for VMEM, so the default plan is always the FULL channel
+    set (coarse context included whenever a coarser level exists) in a
+    single band, at every image size — the former VMEM-driven landscape
+    (1024^2 coarse/3 bands, 2048^2 coarse/10, 4096^2 fine-only/17,
+    6144^2+ handed to the XLA gather path) is gone.  The static per-step
+    VMEM (`kernel_vmem`, ~3 MB at 4 channels) is asserted against the
+    16 MB spec as a sanity check.  An explicit `budget` forces the
+    banded path (ownership-split A) — used by tests and by callers that
+    shard A's rows across devices.
+
+    Both the driver (A-plane prep) and the matcher (B-side prep) derive
+    the same plan from the same static shapes, so the two sides always
+    agree on the layout.
     """
     geom_ok = (
         min(h, w) >= LANE
@@ -827,17 +966,13 @@ def plan_channels(
     )
     if not geom_ok:
         return None
-    if has_coarse:
-        specs = channel_specs(n_src, n_flt, cfg, True)
-        n = _bands_needed(
-            specs, ha, wa, budget if budget is not None else vmem_budget(specs)
-        )
+    for coarse in ([True, False] if has_coarse else [False]):
+        specs = channel_specs(n_src, n_flt, cfg, coarse)
+        if budget is None:
+            if kernel_vmem(specs) <= VMEM_SPEC // 2:
+                return specs, coarse, 1
+            continue
+        n = _bands_needed(specs, ha, wa, budget)
         if n is not None:
-            return specs, True, n
-    specs = channel_specs(n_src, n_flt, cfg, False)
-    n = _bands_needed(
-        specs, ha, wa, budget if budget is not None else vmem_budget(specs)
-    )
-    if n is not None:
-        return specs, False, n
+            return specs, coarse, n
     return None

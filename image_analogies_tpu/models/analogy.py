@@ -112,8 +112,13 @@ def random_init_planes(key: jax.Array, h: int, w: int, ha: int, wa: int):
 
 
 def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
-                 lean: bool = False):
+                 lean: bool = False, polish_iters=None):
     """One EM step at one pyramid level: features -> match -> render.
+
+    `polish_iters` overrides cfg.pm_polish_iters for the matcher's
+    per-pixel polish (the level loop passes 0 on non-final EM
+    iterations when cfg.pm_polish_final_only — see config.py for the
+    measured rationale).
 
     Pure function of its array arguments (vmap-able over a frame axis for
     the batched runner, SURVEY.md C15).  With `cfg.pca_dims`, `f_a` is
@@ -163,7 +168,7 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
             py, px, dist = tile_patchmatch_lean(
                 f_b_tab, f_a, py, px, key, raw=raw, cfg=cfg, level=level,
                 interpret=bool(resolve_pallas(cfg)), plan=plan,
-                ha=ha, wa=wa,
+                ha=ha, wa=wa, polish_iters=polish_iters,
             )
             flat = copy_a.reshape(ha * wa, -1)
             out = jnp.take(
@@ -197,7 +202,8 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
                 a_planes,
             )
         nnf, dist = matcher.match(
-            f_b, f_a, nnf, key=key, level=level, cfg=cfg, raw=raw
+            f_b, f_a, nnf, key=key, level=level, cfg=cfg, raw=raw,
+            polish_iters=polish_iters,
         )
         bp = _gather_image(copy_a, nnf)
         return nnf, dist, bp
@@ -356,7 +362,16 @@ def _level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
     ('none' | 'stacked' | 'planes') is the static layout of the
     incoming coarser-level NN field.
     """
-    step = make_em_step(cfg, level, has_coarse, lean)
+    step_final = make_em_step(cfg, level, has_coarse, lean)
+    # Non-final EM iterations skip the per-pixel polish (gather-bound,
+    # ~320 ms of the ~410 ms level-0 EM step at 1024^2 — config.py
+    # pm_polish_final_only); their field feeds the next EM search, not
+    # the level's output.
+    step_mid = (
+        make_em_step(cfg, level, has_coarse, lean, polish_iters=0)
+        if cfg.pm_polish_final_only
+        else step_final
+    )
 
     def run_level(src_a_l, flt_a_l, src_a_c, flt_a_c, src_b_l, src_b_c,
                   raw_b_l, copy_a_l, prev_nnf, prev_bp, level_key,
@@ -421,6 +436,7 @@ def _level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
 
         dist = bp = None
         for em in range(cfg.em_iters):
+            step = step_final if em == cfg.em_iters - 1 else step_mid
             nnf, dist, bp = step(
                 src_b_l,
                 flt_bp,

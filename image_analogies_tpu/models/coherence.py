@@ -84,9 +84,10 @@ class CoherenceWrapper(Matcher):
         self.sweeps = sweeps
 
     def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig,
-              raw=None):
+              raw=None, polish_iters=None):
         nnf, dist = self.base.match(
-            f_b, f_a, nnf, key=key, level=level, cfg=cfg, raw=raw
+            f_b, f_a, nnf, key=key, level=level, cfg=cfg, raw=raw,
+            polish_iters=polish_iters,
         )
         if cfg.kappa > 0.0:
             nnf, dist = coherence_sweeps(

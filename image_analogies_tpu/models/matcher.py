@@ -68,9 +68,16 @@ def candidate_dist(
     f_a_flat: jnp.ndarray,
     idx: jnp.ndarray,
 ) -> jnp.ndarray:
-    """Distance between each query row and A-row `idx[q]`; (N,)."""
-    rows = jnp.take(f_a_flat, idx, axis=0)
-    diff = f_b_flat - rows
+    """Distance between each query row and A-row `idx[q]`; (N,).
+
+    Math runs in f32 regardless of table dtype (casts fuse into the
+    gather), so callers may pass bf16 tables to halve the gather's HBM
+    traffic — a (N, D<=128) table gathers 128-lane-padded rows, so the
+    bytes depend only on the dtype, and the random-row access pattern
+    runs at ~16-19 GB/s (profiled 2026-07-31), which makes these
+    gathers the polish pass's whole cost."""
+    rows = jnp.take(f_a_flat, idx, axis=0).astype(jnp.float32)
+    diff = f_b_flat.astype(jnp.float32) - rows
     return jnp.sum(diff * diff, axis=-1)
 
 
@@ -158,10 +165,14 @@ class Matcher:
         level: int,
         cfg: SynthConfig,
         raw=None,
+        polish_iters=None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """`raw` optionally carries the raw channel planes
         (models.patchmatch.RawPlanes) backing the Pallas tile kernel;
-        matchers that work on assembled features ignore it."""
+        matchers that work on assembled features ignore it.
+        `polish_iters` overrides cfg.pm_polish_iters for this call (the
+        driver passes 0 on non-final EM iterations when
+        cfg.pm_polish_final_only); exact-search matchers ignore it."""
         raise NotImplementedError
 
     def __repr__(self):

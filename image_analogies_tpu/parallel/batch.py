@@ -39,20 +39,24 @@ from ..ops.remap import luminance_stats
 from .mesh import BATCH_AXIS, batch_sharding, make_mesh, replicated
 
 
-def _batch_step_fn(cfg: SynthConfig, level: int, has_coarse: bool, mesh_key):
+def _batch_step_fn(cfg: SynthConfig, level: int, has_coarse: bool, mesh_key,
+                   polish_iters=None):
     # save_level_artifacts is not step-shaping (it only names a host-side
     # checkpoint dir); stripping it keeps one compiled step per
     # (cfg, level) even when chunked runs vary the per-chunk subdir.
     cfg = dataclasses.replace(cfg, save_level_artifacts=None)
-    return _batch_step_fn_cached(cfg, level, has_coarse, mesh_key)
+    return _batch_step_fn_cached(
+        cfg, level, has_coarse, mesh_key, polish_iters
+    )
 
 
 @functools.lru_cache(maxsize=64)
 def _batch_step_fn_cached(
-    cfg: SynthConfig, level: int, has_coarse: bool, mesh_key
+    cfg: SynthConfig, level: int, has_coarse: bool, mesh_key,
+    polish_iters=None,
 ):
     mesh = _MESHES[mesh_key]
-    step = make_em_step(cfg, level, has_coarse)
+    step = make_em_step(cfg, level, has_coarse, polish_iters=polish_iters)
     # Frame-carried args are vmapped; the A-side (f_a, copy_a), the PCA
     # basis, and the kernel's A planes are shared across frames.  The
     # Pallas tile kernel batches under vmap (the frame axis becomes a
@@ -71,20 +75,26 @@ def _batch_step_fn_cached(
     )
 
 
-def _lean_step_fn(cfg: SynthConfig, level: int, has_coarse: bool, mesh_key):
+def _lean_step_fn(cfg: SynthConfig, level: int, has_coarse: bool, mesh_key,
+                  polish_iters=None):
     """Vmapped LEAN em step (plane-pair NN field, bf16 chunked tables)
     for the sharded runners — same sharding layout as `_batch_step_fn`
     but with the field carried as a (py, px) tuple per slab/frame."""
     cfg = dataclasses.replace(cfg, save_level_artifacts=None)
-    return _lean_step_fn_cached(cfg, level, has_coarse, mesh_key)
+    return _lean_step_fn_cached(
+        cfg, level, has_coarse, mesh_key, polish_iters
+    )
 
 
 @functools.lru_cache(maxsize=64)
 def _lean_step_fn_cached(
-    cfg: SynthConfig, level: int, has_coarse: bool, mesh_key
+    cfg: SynthConfig, level: int, has_coarse: bool, mesh_key,
+    polish_iters=None,
 ):
     mesh = _MESHES[mesh_key]
-    step = make_em_step(cfg, level, has_coarse, lean=True)
+    step = make_em_step(
+        cfg, level, has_coarse, lean=True, polish_iters=polish_iters
+    )
     in_axes = (0, 0, 0, 0, None, None, (0, 0), 0, None, None)
     shard = batch_sharding(mesh)
     repl = replicated(mesh)
@@ -144,21 +154,41 @@ def _batch_prologue_fn_cached(cfg: SynthConfig, levels: int, mesh_key):
     )
 
 
+def _batch_feature_table_bytes(
+    n_frames: int, h: int, w: int, ha: int, wa: int
+) -> int:
+    """HBM cost of a batch level's assembled f32 feature tables: one
+    128-lane-padded B table per resident frame plus the shared A table
+    (see models/analogy._feature_table_bytes for the padding law)."""
+    return (n_frames * h * w + ha * wa) * 128 * 4
+
+
 def _batch_level_fn(cfg: SynthConfig, level: int, has_coarse: bool,
-                    mesh_key, fa_external: bool = False):
+                    mesh_key, fa_external: bool = False,
+                    lean: bool = False, prev_kind: str = "stacked"):
     from ..models.analogy import _strip_noncompute
 
     return _batch_level_fn_cached(
-        _strip_noncompute(cfg), level, has_coarse, mesh_key, fa_external
+        _strip_noncompute(cfg), level, has_coarse, mesh_key, fa_external,
+        lean, prev_kind,
     )
 
 
 @functools.lru_cache(maxsize=64)
 def _batch_level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
-                           mesh_key, fa_external: bool = False):
+                           mesh_key, fa_external: bool = False,
+                           lean: bool = False, prev_kind: str = "stacked"):
     """One batch pyramid level as ONE compiled call: A-side feature
     assembly (+PCA) + kernel A-plane prep + vmapped state glue + all
     `cfg.em_iters` vmapped EM steps, with data-parallel shardings.
+
+    `lean=True` mirrors the single driver's lean levels (bf16 chunked
+    feature tables, per-frame (py, px) plane-pair fields — a stacked
+    (F, H, W, 2) field pads 2 -> 128 lanes) for batch levels whose
+    resident tables would exceed cfg.feature_bytes_budget
+    (`_batch_feature_table_bytes`: F B-tables + the shared A table).
+    `prev_kind` ('stacked' | 'planes') is the static layout of the
+    incoming coarser level's field, exactly as in the single driver.
 
     MAINTENANCE NOTE: this mirrors models/analogy._level_fn_cached (the
     per-frame PRNG streams are bit-identical to the unfused runner's
@@ -173,18 +203,36 @@ def _batch_level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
     mesh = _MESHES[mesh_key]
     shard = batch_sharding(mesh)
     repl = replicated(mesh)
-    step = make_em_step(cfg, level, has_coarse)
+    step_final = make_em_step(cfg, level, has_coarse, lean)
+    # Mirrors models/analogy._level_fn_cached: non-final EM iterations
+    # skip the gather-bound per-pixel polish (config.py
+    # pm_polish_final_only).
+    step_mid = (
+        make_em_step(cfg, level, has_coarse, lean, polish_iters=0)
+        if cfg.pm_polish_final_only
+        else step_final
+    )
 
     def run_level(src_a_l, flt_a_l, src_a_c, flt_a_c, src_b_l, src_b_c,
                   raw_b_l, copy_a_l, prev_nnf, prev_bp, level_key,
                   frame_idx, f_a_ext=None, proj_ext=None):
-        from ..models.analogy import _level_plan
+        from ..models.analogy import (
+            _level_plan,
+            assemble_features_lean,
+            random_init_planes,
+            upsample_nnf_planes,
+        )
         from ..ops.pca import fit_and_project
 
         h, w = src_b_l.shape[1:3]
         ha, wa = src_a_l.shape[:2]
         if fa_external:
             f_a, proj = f_a_ext, proj_ext
+        elif lean:
+            f_a = assemble_features_lean(
+                src_a_l, flt_a_l, cfg, src_a_c, flt_a_c
+            )
+            proj = None
         else:
             f_a = assemble_features(
                 src_a_l, flt_a_l, cfg, src_a_c, flt_a_c
@@ -212,23 +260,40 @@ def _batch_level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
             )(frame_idx)
 
         if has_coarse:
-            nnf = jax.vmap(
-                lambda n: upsample_nnf(n, (h, w), ha, wa)
-            )(prev_nnf)
+            if lean:
+                p_py, p_px = (
+                    prev_nnf if prev_kind == "planes"
+                    else (prev_nnf[..., 0], prev_nnf[..., 1])
+                )
+                nnf = jax.vmap(
+                    lambda py, px: upsample_nnf_planes(
+                        py, px, (h, w), ha, wa
+                    )
+                )(p_py, p_px)
+            else:
+                nnf = jax.vmap(
+                    lambda n: upsample_nnf(n, (h, w), ha, wa)
+                )(prev_nnf)
             flt_bp_coarse = prev_bp
             flt_bp = jax.vmap(lambda x: upsample(x, (h, w)))(prev_bp)
         else:
+            init = random_init_planes if lean else random_init
             nnf = jax.vmap(
-                lambda k: random_init(k, h, w, ha, wa)
+                lambda k: init(k, h, w, ha, wa)
             )(frame_keys(jax.random.fold_in(level_key, 0x1217)))
             flt_bp = raw_b_l
             flt_bp_coarse = flt_bp
 
-        vstep = jax.vmap(
-            step, in_axes=(0, 0, 0, 0, None, None, 0, 0, None, None)
+        nnf_ax = (0, 0) if lean else 0
+        mk_vstep = lambda s: jax.vmap(  # noqa: E731
+            s, in_axes=(0, 0, 0, 0, None, None, nnf_ax, 0, None, None)
         )
+        vstep_final, vstep_mid = mk_vstep(step_final), mk_vstep(step_mid)
         dist = bp = None
         for em in range(cfg.em_iters):
+            vstep = (
+                vstep_final if em == cfg.em_iters - 1 else vstep_mid
+            )
             nnf, dist, bp = vstep(
                 src_b_l,
                 flt_bp,
@@ -408,10 +473,29 @@ def synthesize_batch(
         h, w = pyr_src_b[level].shape[1:3]
         has_coarse = level < levels - 1
 
-        from ..models.analogy import _assemble_fa_fn, _fa_external
+        from ..models.analogy import (
+            _assemble_fa_fn,
+            _fa_external,
+            _kernel_eligible,
+        )
 
         ha, wa = pyr_src_a[level].shape[:2]
-        fa_ext = _fa_external(ha, wa, lean=False)
+        # Lean levels mirror the single driver's rule (the decision must
+        # precede assembly — assembly is what OOMs), with the batch's
+        # per-frame multiplicity in the byte estimate.
+        lean = (
+            _kernel_eligible(
+                cfg, pyr_src_a[level], pyr_flt_a[level], has_coarse, h, w
+            )
+            and _batch_feature_table_bytes(
+                frames.shape[0], h, w, ha, wa
+            ) > cfg.feature_bytes_budget
+        )
+        prev_kind = (
+            "none" if not has_coarse
+            else ("planes" if isinstance(nnf, tuple) else "stacked")
+        )
+        fa_ext = _fa_external(ha, wa, lean)
         f_a_ext = proj_ext = None
         if fa_ext:
             f_a_ext, proj_ext = _assemble_fa_fn(cfg, has_coarse)(
@@ -420,7 +504,9 @@ def synthesize_batch(
                 pyr_src_a[level + 1] if has_coarse else None,
                 pyr_flt_a[level + 1] if has_coarse else None,
             )
-        run = _batch_level_fn(cfg, level, has_coarse, token, fa_ext)
+        run = _batch_level_fn(
+            cfg, level, has_coarse, token, fa_ext, lean, prev_kind
+        )
         nnf, dist, bp = run(
             pyr_src_a[level],
             pyr_flt_a[level],
@@ -447,8 +533,19 @@ def synthesize_batch(
             # Whole-batch per-level state through the single-image writer:
             # atomic tmp+rename and a fingerprint covering the padded
             # frame-stack shape (the arrays just carry a frame axis).
+            nnf_save = nnf
+            if isinstance(nnf, tuple):
+                # Lean plane pair stacked on the HOST, exactly as the
+                # single driver does: checkpoints keep the standard
+                # (..., 2) schema without materializing the lane-padded
+                # stack on device.
+                import numpy as _np
+
+                nnf_save = _np.stack(
+                    [_np.asarray(nnf[0]), _np.asarray(nnf[1])], axis=-1
+                )
             _save_level(
-                cfg.save_level_artifacts, level, nnf, dist, bp, cfg,
+                cfg.save_level_artifacts, level, nnf_save, dist, bp, cfg,
                 fp_shape,
             )
 

@@ -31,12 +31,24 @@ field, bf16 chunk-assembled per-slab B tables), so per-device residency
 is the slab's share of the B side plus the replicated A side — the
 runner reaches the single-chip lean path's ceiling TIMES the mesh on
 the B' axis (e.g. ~8192^2 B' on 4 chips that each handle lean 4096^2
-slabs).  The remaining hard walls are (a) the replicated A-side lean
-table + kernel A-planes, which do NOT shard (A parallelism would need
-band-sharded search + cross-device argmin reduction — not built), and
-(b) kernel eligibility of the slab geometry itself (plan_channels);
-slabs too large for any band plan fall back to the XLA gather path's
-memory behavior.
+slabs).  The remaining hard wall is the replicated A side.  Its
+sharded design is VALIDATED at the kernel level: A's rows split into
+ownership bands (`prepare_a_planes(n_bands=n)` + `band_bounds` — each
+band evaluates only candidates whose clamped origin it owns), each
+device sweeps its own band under `shard_map`, and an elementwise
+distance argmin merges the per-device fields bit-identically to the
+sequential banded search (tests/test_spatial.py
+test_sharded_a_band_search_matches_sequential).  What is NOT built is
+the full runner around it, for a measured reason: since the round-4
+HBM-streaming kernel the A planes cost HBM only (~19 MB/1024^2-channel
+set — a 16 GB chip fits a ~45000^2-pixel A side), so the binding
+A-side residency is the lean bf16 FEATURE TABLE the exact-metric
+merge/polish gathers from (N_A * 256 B ≈ 4.3 GB at 4096^2), and
+sharding THAT requires distributed gathers in the polish (every
+device's candidates index arbitrary A rows), a different mechanism
+from band ownership.  Until a style pair within 4x of a chip's HBM
+exists as a use case, the banded kernel contract above is the
+shippable unit.
 """
 
 from __future__ import annotations
@@ -300,10 +312,19 @@ def synthesize_spatial(
             else None
         )
 
-        step = (
-            _spatial_lean_step_fn(cfg, level, has_coarse, token)
+        mk_step = (  # noqa: E731
+            (lambda p: _spatial_lean_step_fn(cfg, level, has_coarse, token,
+                                             polish_iters=p))
             if lean
-            else _spatial_step_fn(cfg, level, has_coarse, token)
+            else (lambda p: _spatial_step_fn(cfg, level, has_coarse, token,
+                                             polish_iters=p))
+        )
+        step_final = mk_step(None)
+        # Non-final EM iterations skip the gather-bound per-pixel polish
+        # (config.py pm_polish_final_only), mirroring the single-image
+        # and batch level functions.
+        step_mid = (
+            mk_step(0) if cfg.pm_polish_final_only else step_final
         )
         # One host-side slab placement per level; between EM iterations
         # the state stays in (sharded) slab form and is re-haloed by the
@@ -335,6 +356,9 @@ def synthesize_spatial(
                 slab_keys,
                 proj,
                 a_planes,
+            )
+            step = (
+                step_final if em == cfg.em_iters - 1 else step_mid
             )
             nnf_s, dist_s, bp_s = step(*args)
             if em < cfg.em_iters - 1:

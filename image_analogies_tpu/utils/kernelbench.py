@@ -54,7 +54,7 @@ def sweep_setup(cfg, size: int):
         mk(size // 2, size // 2) if use_coarse else None,
         specs, n_bands=n_bands,
     )
-    n_chan = int(a_planes[0].shape[0])
+    n_chan = int(a_planes[0].shape[2])
     b_blocked = jnp.stack(
         [to_blocked(mk(size, size), geom) for _ in range(n_chan)]
     )
@@ -89,16 +89,29 @@ def sweep_setup(cfg, size: int):
 
 
 def sweep_time_ms(cfg, size: int, iters: int = 16):
-    """Steady-state ms per full all-bands sweep, plus the setup meta.
-    None when ineligible."""
+    """Steady-state ms per full sweep, plus the setup meta.  None when
+    ineligible.
+
+    Differenced timing: the closing scalar-readback barrier costs a
+    full tunnel round trip (~75-105 ms measured on this box), which at
+    16 iterations inflated a naive (loop + sync)/N by ~5-7 ms/sweep —
+    round 3's published 12.9 ms sweep carried that bias.  Timing N and
+    2N iterations and differencing cancels the constant sync cost."""
     setup = sweep_setup(cfg, size)
     if setup is None:
         return None
     one_iter, (oy, ox, d), meta = setup
     oy, ox, d = one_iter(oy, ox, d)  # warm/compile
     sync(d)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        oy, ox, d = one_iter(oy, ox, d)
-    sync(d)
-    return (time.perf_counter() - t0) / iters * 1000, meta
+
+    def timed(n):
+        s = (oy, ox, d)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            s = one_iter(*s)
+        sync(s[2])
+        return time.perf_counter() - t0
+
+    t_n = timed(iters)
+    t_2n = timed(2 * iters)
+    return (t_2n - t_n) / iters * 1000, meta

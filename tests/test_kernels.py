@@ -36,6 +36,32 @@ def test_streaming_nn_matches_xla_twin(rng, n_b, n_a, d):
     )
 
 
+def test_streaming_nn_query_chunking_matches_single_call(rng):
+    """Forcing a tiny grid cap splits the queries over several
+    pallas_call invocations (the crash-avoidance path full-synthesis
+    oracles at >= 2048^2 rely on); results must be identical to the
+    unchunked call and the XLA twin."""
+    from unittest import mock
+
+    import image_analogies_tpu.kernels.nn_brute as nb
+
+    f_b = jnp.asarray(rng.standard_normal((1030, 40)), jnp.float32)
+    f_a = jnp.asarray(rng.standard_normal((700, 40)), jnp.float32)
+    idx_ref, dist_ref = exact_nn(f_b, f_a, chunk=256)
+
+    # grid_a = ceil(700/512) = 2; cap 4 -> 2 query tiles (512 rows) per
+    # call -> 3 chunked calls over the padded 1280 query rows.
+    with mock.patch.object(nb, "_MAX_GRID_STEPS", 4):
+        exact_nn_pallas.clear_cache()
+        idx_c, dist_c = exact_nn_pallas(f_b, f_a, interpret=True)
+    exact_nn_pallas.clear_cache()
+
+    np.testing.assert_array_equal(np.asarray(idx_c), np.asarray(idx_ref))
+    np.testing.assert_allclose(
+        np.asarray(dist_c), np.asarray(dist_ref), rtol=1e-5, atol=1e-5
+    )
+
+
 def test_streaming_nn_tie_breaks_to_lowest_index(rng):
     # Duplicate A rows across tile boundaries: winner must be the lowest
     # flat index, matching jnp.argmin in the XLA twin.

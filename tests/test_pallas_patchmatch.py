@@ -360,58 +360,45 @@ class TestEligibility:
         assert plan_channels(1, 1, cfg, False, 64, 64, 64, 64) is None
         assert plan_channels(1, 1, cfg, False, 128, 128, 128, 128) is not None
 
-    def test_channel_plan_adapts_to_vmem(self):
-        from image_analogies_tpu.kernels.patchmatch_tile import plan_channels
+    def test_channel_plan_single_band_full_channels(self):
+        """Since the HBM-streaming redesign the A side no longer competes
+        for VMEM: the default plan is the full coarse channel set in ONE
+        band at every size (the former banded landscape — 1024^2/3
+        bands, 2048^2/10, 4096^2 fine-only/17, 6144^2+ gather path — is
+        gone)."""
+        from image_analogies_tpu.kernels.patchmatch_tile import (
+            kernel_vmem,
+            plan_channels,
+        )
 
         cfg = SynthConfig()
-        # 512^2: all four channels fit in one band.
-        plan = plan_channels(1, 1, cfg, True, 512, 512, 512, 512)
-        assert plan is not None and plan[1] is True and plan[2] == 1
-        assert vmem_estimate(plan[0], 512, 512) < 11e6
-        # 1024^2: coarse channels kept by splitting A into row bands.
-        plan = plan_channels(1, 1, cfg, True, 1024, 1024, 1024, 1024)
-        assert plan is not None and plan[1] is True and plan[2] > 1
-        assert vmem_estimate(plan[0], 1024, 1024, plan[2]) < 11e6
-        # Steerable at 1024^2 (5 src channels): eligible via banding.
+        for size in (512, 1024, 2048, 4096, 6144, 8192):
+            plan = plan_channels(1, 1, cfg, True, size, size, size, size)
+            assert plan is not None, size
+            assert plan[1] is True and plan[2] == 1, (size, plan)
+        # Steerable (5 src channels): still one band, and the static
+        # per-step VMEM stays well inside the 16 MB spec.
         cfg_s = SynthConfig(steerable=True)
         plan = plan_channels(5, 1, cfg_s, True, 1024, 1024, 1024, 1024)
-        assert plan is not None and plan[2] > 1
-        # A too small for even one banded tile row: ineligible.
+        assert plan is not None and plan[2] == 1
+        assert kernel_vmem(plan[0]) < 8 * 1024 * 1024
+        # A too small for even one tile row: ineligible (geometry).
         assert plan_channels(1, 1, cfg, False, 128, 128, 32, 128) is None
 
-    def test_band_fallback_boundary(self):
-        """Pin exactly where the banded kernel hands off to the XLA
-        gather path as A grows (VMEM budget / MAX_BANDS geometry):
-        4096^2 keeps all four channels via 33 A-bands, 6144^2 drops to
-        fine-only, 8192^2 is gather-path territory."""
+    def test_explicit_budget_forces_bands(self):
+        """The banded ownership path stays reachable behind an explicit
+        budget (the spatial sharded-A runner's contract)."""
         from image_analogies_tpu.kernels.patchmatch_tile import (
             MAX_BANDS,
             plan_channels,
         )
 
         cfg = SynthConfig()
-        expected = {
-            1024: (True, 3),    # all 4 channels, 3 A-bands
-            2048: (True, 10),
-            # 4096^2: coarse channels would need > MAX_BANDS bands under
-            # the ownership-overlap layout; the plan prefers fine-only
-            # at 17 bands (~3x less per-sweep B/state restream than the
-            # round-2 coarse/33 plan — the exact-metric merge + polish
-            # still sees full features).
-            4096: (False, 17),
-        }
-        for size, (use_coarse, n_bands) in expected.items():
-            plan = plan_channels(1, 1, cfg, True, size, size, size, size)
-            assert plan is not None, size
-            assert (plan[1], plan[2]) == (use_coarse, n_bands), (
-                size, plan[1], plan[2],
-            )
-            assert plan[2] <= MAX_BANDS
-        # Past the band budget the XLA gather (lean) path takes over:
-        # at 6144^2+ even fine-only needs > MAX_BANDS bands, and the
-        # per-band B/state restream would dwarf the gather cost anyway.
-        assert plan_channels(1, 1, cfg, True, 6144, 6144, 6144, 6144) is None
-        assert plan_channels(1, 1, cfg, True, 8192, 8192, 8192, 8192) is None
+        budget = vmem_estimate(_specs(cfg, has_coarse=True), 1024, 1024, 4)
+        plan = plan_channels(1, 1, cfg, True, 1024, 1024, 1024, 1024, budget)
+        assert plan is not None and plan[2] > 1
+        assert plan[2] <= MAX_BANDS
+        assert vmem_estimate(plan[0], 1024, 1024, plan[2]) <= budget
 
 
 class TestKernelMatcherPath:
@@ -709,6 +696,68 @@ class TestBatchedKernelPath:
         # The single-image kernel path on one frame stays healthy too.
         single = np.asarray(create_image_analogy(a, ap, frames[0], cfg))
         assert np.isfinite(single).all()
+
+
+class TestBatchLeanPath:
+    def test_batch_runner_composes_with_lean_path(self, rng):
+        """Batch x lean composition (round-3 VERDICT task 4): with a
+        forced-tiny feature_bytes_budget the batch runner must take the
+        LEAN step per frame (plane-pair field under vmap, bf16 chunked
+        tables) and its output must track the normal batch path's
+        quality against the batch brute oracle."""
+        from unittest import mock
+
+        import image_analogies_tpu.models.patchmatch as pm_mod
+        from image_analogies_tpu.parallel.batch import synthesize_batch
+        from image_analogies_tpu.parallel.mesh import make_mesh
+        from image_analogies_tpu.utils.metrics import psnr
+
+        a = rng.random((128, 128))
+        k = np.ones(13) / 13.0
+        for _ in range(3):
+            a = np.apply_along_axis(
+                lambda r: np.convolve(r, k, mode="same"), 1, a
+            )
+            a = np.apply_along_axis(
+                lambda c: np.convolve(c, k, mode="same"), 0, a
+            )
+        a = ((a - a.min()) / (a.max() - a.min())).astype(np.float32)
+        ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
+        frames = np.stack([a[:, ::-1], np.flipud(a)]).astype(np.float32)
+        kw = dict(
+            levels=1, matcher="patchmatch", pallas_mode="interpret",
+            em_iters=1, pm_iters=2,
+        )
+        cfg_lean = SynthConfig(feature_bytes_budget=1, **kw)
+
+        lean_calls = []
+        real = pm_mod.tile_patchmatch_lean
+
+        def counting(*args, **kwargs):
+            lean_calls.append(1)
+            return real(*args, **kwargs)
+
+        mesh = make_mesh(2)
+        with mock.patch.object(pm_mod, "tile_patchmatch_lean", counting):
+            lean_out = np.asarray(
+                synthesize_batch(a, ap, frames, cfg_lean, mesh)
+            )
+        assert lean_calls, "batch runner never took the lean step"
+        assert lean_out.shape == frames.shape
+        assert np.isfinite(lean_out).all()
+
+        normal = np.asarray(
+            synthesize_batch(a, ap, frames, SynthConfig(**kw), mesh)
+        )
+        oracle = np.asarray(
+            synthesize_batch(
+                a, ap, frames,
+                SynthConfig(levels=1, matcher="brute", em_iters=1), mesh,
+            )
+        )
+        p_lean, p_norm = psnr(lean_out, oracle), psnr(normal, oracle)
+        assert p_lean > 25.0, (p_lean, p_norm)
+        assert p_lean > p_norm - 3.0, (p_lean, p_norm)
 
 
 class TestEndToEnd:
