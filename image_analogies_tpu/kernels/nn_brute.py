@@ -36,15 +36,25 @@ from jax.experimental.pallas import tpu as pltpu
 _TQ = 256
 _TA = 512
 
-# Grid-size ceiling per pallas_call.  The axon TPU worker reproducibly
-# crashes on very large sequential grids (measured 2026-07-30: the
-# ~134M-step grid of a full 2048^2 all-pairs call kills the worker,
-# while the 8.4M-step 1024^2 grid runs routinely).  Queries are chunked
-# across multiple pallas_call invocations so no single grid exceeds
-# this; 16M sits between the proven-safe 8.4M and the crashing 134M
-# with margin on the safe side of the failure, and was validated by the
+# Work ceiling per device EXECUTION, in distance-tile elements
+# (grid_steps * tq * ta) — a wall-clock proxy that normalizes across
+# tile sizes where a raw step count does not (per-step work is tq*ta).
+# The axon TPU worker reproducibly kills long-running executions, and
+# the boundary is per XLA execution, not per pallas_call: one
+# 4.4e12-element call (~100 s, 2026-07-31) crashes it, and so does one
+# jit containing four sequential 1.2e12-element pallas_calls (~110 s
+# total, same day) — while single executions up to ~2.2e12 elements
+# (~25-50 s: the 1024^2 all-pairs call on either tile geometry, and
+# the fused 1024^2 brute oracle level) complete routinely.  Query
+# chunks must therefore be SEPARATE executions: `exact_nn_pallas` is
+# deliberately NOT jitted at the top level, so when called eagerly
+# (the scale probes, the eager oracle levels) each chunk dispatches on
+# its own and stays in the proven-safe regime.  Callers that trace it
+# into a larger jit own the enclosing execution's budget — the driver
+# un-fuses brute levels whose search exceeds it
+# (models/analogy.py _SAFE_EXEC_DIST_ELEMS).  Validated by the
 # round-4 full-synthesis 2048^2 oracle run (SCALE_r04).
-_MAX_GRID_STEPS = 16_000_000
+_MAX_TILE_ELEMS = 1_200_000_000_000
 
 
 def _make_nn_kernel(ta: int):
@@ -89,8 +99,50 @@ def _make_nn_kernel(ta: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("match_dtype", "interpret", "tq", "ta")
+    jax.jit, static_argnames=("tq", "ta", "interpret")
 )
+def _nn_chunk_call(fb_chunk, fa, a_sq, tq: int, ta: int, interpret: bool):
+    """One query chunk's streaming search as its own jitted call — ONE
+    device execution per chunk when the caller runs eagerly (see
+    _MAX_TILE_ELEMS: the worker's kill boundary is per execution)."""
+    grid_a = fa.shape[0] // ta
+    chunk_tiles = fb_chunk.shape[0] // tq
+    return pl.pallas_call(
+        _make_nn_kernel(ta),
+        grid=(chunk_tiles, grid_a),
+        in_specs=[
+            pl.BlockSpec(
+                (tq, fb_chunk.shape[1]), lambda i, j: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (ta, fa.shape[1]), lambda i, j: (j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, ta), lambda i, j: (0, j), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (tq, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (tq, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((fb_chunk.shape[0], 1), jnp.int32),
+            jax.ShapeDtypeStruct((fb_chunk.shape[0], 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tq, 1), jnp.float32),
+            pltpu.VMEM((tq, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(fb_chunk, fa, a_sq)
+
+
 def exact_nn_pallas(
     f_b_flat: jnp.ndarray,
     f_a_flat: jnp.ndarray,
@@ -108,11 +160,14 @@ def exact_nn_pallas(
     `tq`/`ta` override the query/database tile rows.  The kernel's HBM
     traffic is |B| + (N_B/tq) * |A| — the whole A table streams through
     VMEM once per query tile — so giant-A calls (the full-synthesis
-    2048^2 oracle, the 4096^2 stratified probe) want the largest tq the
-    (tq, ta) f32 distance tile leaves VMEM room for: (4096, 256) puts
-    the distance tile at 4 MB and cuts A re-streaming 16x vs the
-    (256, 512) default, which stays optimal for the small-N calls the
-    synthesis pipeline makes.
+    2048^2 oracle, the 4096^2 stratified probe) want the largest tq
+    that compiles: the scoped-VMEM footprint is ~5x the (tq, ta) f32
+    distance tile (Mosaic keeps the cross product, the distance tile,
+    and the select temporaries live at once), so at D=128 bf16 the
+    ceiling is (2048, 256) — (3072+, 256) exceeds the 16 MB scoped
+    limit (measured 2026-07-31: 22.26 MB at tq=4096).  (2048, 256)
+    cuts A re-streaming 8x vs the (256, 512) default, which stays
+    optimal for the small-N calls the synthesis pipeline makes.
     """
     n, d_feat = f_b_flat.shape
     n_a = f_a_flat.shape[0]
@@ -125,70 +180,48 @@ def exact_nn_pallas(
     fb = jnp.pad(f_b_flat, ((0, q_pad), (0, d_pad))).astype(match_dtype)
     fa = jnp.pad(f_a_flat, ((0, a_pad), (0, d_pad))).astype(match_dtype)
     # ||a||^2 in f32; +inf on padded rows so they never win the argmin.
-    a_sq = jnp.sum(
-        f_a_flat.astype(jnp.float32) ** 2, axis=-1
+    # Chunked: one whole-table f32 upcast of a giant A side (the 4096^2
+    # probe's (16.8M, 128) bf16 table) peaks at 2 x 8.6 GB of temps.
+    sq_rows = max(1, (256 << 20) // max(1, d_feat * 4))
+    sq_parts = []
+    for c in range(0, n_a, sq_rows):
+        blk = f_a_flat[c : c + sq_rows].astype(jnp.float32)
+        sq_parts.append(jnp.sum(blk * blk, axis=-1))
+    a_sq = (
+        sq_parts[0] if len(sq_parts) == 1
+        else jnp.concatenate(sq_parts, axis=0)
     )
     a_sq = jnp.pad(a_sq, (0, a_pad), constant_values=jnp.inf)[None, :]
 
     grid_a = fa.shape[0] // ta
-    # Chunk the query axis so no single pallas_call's grid exceeds
-    # _MAX_GRID_STEPS (the ~134M-step full 2048^2 grid crashed the TPU
-    # worker — see the constant above).  A-tiles never need chunking:
-    # grid_a alone exceeding the cap would take an N_A beyond any
-    # supported image.  Chunks are equal-sized (fb re-padded up to a
-    # chunk multiple) so one compiled kernel serves every chunk.
+    # Chunk the query axis so no single device execution exceeds
+    # _MAX_TILE_ELEMS of distance-tile work (long-running executions
+    # crash the TPU worker — see the constant above).  This function
+    # is NOT jitted: called eagerly, each chunk's `_nn_chunk_call` is
+    # its own execution, which is the point; traced inside a caller's
+    # jit, the loop inlines and the caller owns the execution budget.
+    # A-tiles never need chunking: grid_a alone exceeding the cap
+    # would take an N_A beyond any supported image.  Chunks are
+    # equal-sized (fb re-padded up to a chunk multiple) so one
+    # compiled kernel serves every chunk.
     q_tiles = fb.shape[0] // tq
-    chunk_tiles = max(1, min(q_tiles, _MAX_GRID_STEPS // grid_a))
+    max_steps = max(1, _MAX_TILE_ELEMS // (tq * ta))
+    chunk_tiles = max(1, min(q_tiles, max_steps // grid_a))
     n_chunks = -(-q_tiles // chunk_tiles)
     chunk_rows = chunk_tiles * tq
     fb = jnp.pad(fb, ((0, n_chunks * chunk_rows - fb.shape[0]), (0, 0)))
 
-    def one_chunk(fb_chunk):
-        return pl.pallas_call(
-            _make_nn_kernel(ta),
-            grid=(chunk_tiles, grid_a),
-            in_specs=[
-                pl.BlockSpec(
-                    (tq, fb_chunk.shape[1]), lambda i, j: (i, 0),
-                    memory_space=pltpu.VMEM,
-                ),
-                pl.BlockSpec(
-                    (ta, fa.shape[1]), lambda i, j: (j, 0),
-                    memory_space=pltpu.VMEM,
-                ),
-                pl.BlockSpec(
-                    (1, ta), lambda i, j: (0, j), memory_space=pltpu.VMEM
-                ),
-            ],
-            out_specs=[
-                pl.BlockSpec(
-                    (tq, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM
-                ),
-                pl.BlockSpec(
-                    (tq, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM
-                ),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((fb_chunk.shape[0], 1), jnp.int32),
-                jax.ShapeDtypeStruct((fb_chunk.shape[0], 1), jnp.float32),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((tq, 1), jnp.float32),
-                pltpu.VMEM((tq, 1), jnp.int32),
-            ],
-            interpret=interpret,
-        )(fb_chunk, fa, a_sq)
-
     if n_chunks == 1:
-        idx = one_chunk(fb)[0]
+        idx = _nn_chunk_call(fb, fa, a_sq, tq, ta, interpret)[0]
     else:
         idx = jnp.concatenate(
             [
-                one_chunk(
+                _nn_chunk_call(
                     jax.lax.slice(
                         fb, (c * chunk_rows, 0),
                         ((c + 1) * chunk_rows, fb.shape[1]),
-                    )
+                    ),
+                    fa, a_sq, tq, ta, interpret,
                 )[0]
                 for c in range(n_chunks)
             ],
@@ -196,8 +229,21 @@ def exact_nn_pallas(
         )
 
     idx = idx[:n, 0]
+    # The padded/cast working copies are dead past this point; drop the
+    # references eagerly — at giant-A sizes (the 2048^2 oracle: two
+    # 2.1 GB f32 tables resident in the caller) the re-rank below must
+    # not co-reside with another ~2.2 GB of bf16 copies.
+    del fb, fa, a_sq
     # Exact winner distance (direct subtraction, f32), immune to the
-    # ||a||^2 - 2ab expansion's cancellation error.
-    rows = jnp.take(f_a_flat, idx, axis=0)
-    diff = f_b_flat.astype(jnp.float32) - rows.astype(jnp.float32)
-    return idx, jnp.sum(diff * diff, axis=-1)
+    # ||a||^2 - 2ab expansion's cancellation error.  Chunked so the
+    # co-resident gathered-rows + diff temps peak at ~512 MB (2 x
+    # 256 MiB f32 blocks) instead of 2x the full table.
+    rerank_rows = max(1, (256 << 20) // max(1, d_feat * 4))
+    dists = []
+    for c in range(0, n, rerank_rows):
+        sl = idx[c : c + rerank_rows]
+        rows = jnp.take(f_a_flat, sl, axis=0).astype(jnp.float32)
+        diff = f_b_flat[c : c + rerank_rows].astype(jnp.float32) - rows
+        dists.append(jnp.sum(diff * diff, axis=-1))
+    dist = dists[0] if len(dists) == 1 else jnp.concatenate(dists, axis=0)
+    return idx, dist

@@ -341,17 +341,32 @@ def _assemble_fa_fn_cached(cfg: SynthConfig, has_coarse: bool):
     return jax.jit(assemble)
 
 
+# Per-execution distance-work ceiling for a FUSED brute level
+# (em_iters * N_B * N_A distance elements in one jit execution).  The
+# axon TPU worker kills executions past ~100 s (kernels/nn_brute.py
+# _MAX_TILE_ELEMS); the fused 1024^2 oracle level (2.2e12 elements,
+# ~50 s) is measured-safe, the 2048^2 one (35e12) is far past the
+# boundary.  Brute levels above this run the SAME level function
+# eagerly: every jnp op and each `exact_nn_pallas` query chunk
+# dispatches as its own execution, so no single execution outgrows the
+# safe regime.  Walls don't matter on this path — it exists for the
+# full-synthesis exact oracle at >= 2048^2 (SCALE_r04), not for
+# production synthesis (patchmatch lean covers that).
+_SAFE_EXEC_DIST_ELEMS = 2_400_000_000_000
+
+
 def _level_fn(cfg: SynthConfig, level: int, has_coarse: bool, lean: bool,
-              prev_kind: str, fa_external: bool = False):
+              prev_kind: str, fa_external: bool = False, fuse: bool = True):
     return _level_fn_cached(
         _strip_noncompute(cfg), level, has_coarse, lean, prev_kind,
-        fa_external,
+        fa_external, fuse,
     )
 
 
 @functools.lru_cache(maxsize=64)
 def _level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
-                     lean: bool, prev_kind: str, fa_external: bool = False):
+                     lean: bool, prev_kind: str, fa_external: bool = False,
+                     fuse: bool = True):
     """One pyramid level as ONE compiled call: state upsampling glue +
     A-side feature assembly (+PCA) + kernel A-plane prep + all
     `cfg.em_iters` EM steps.
@@ -452,7 +467,10 @@ def _level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
             flt_bp = bp
         return nnf, dist, bp
 
-    return jax.jit(run_level)
+    # fuse=False (oversized brute levels, _SAFE_EXEC_DIST_ELEMS): the
+    # same function eagerly — exact_nn_pallas then execution-chunks its
+    # query axis itself.
+    return jax.jit(run_level) if fuse else run_level
 
 
 _prologue_fn.cache_clear = _prologue_fn_cached.cache_clear
@@ -690,7 +708,16 @@ def create_image_analogy(
                 pyr_src_a[level + 1] if has_coarse else None,
                 pyr_flt_a[level + 1] if has_coarse else None,
             )
-        run = _level_fn(cfg, level, has_coarse, lean, prev_kind, fa_ext)
+        # Oversized brute levels run unfused (see _SAFE_EXEC_DIST_ELEMS):
+        # one fused execution of their exact search would outlive the
+        # TPU worker's per-execution tolerance.
+        fuse = (
+            cfg.matcher != "brute"
+            or cfg.em_iters * (h * w) * (ha * wa) <= _SAFE_EXEC_DIST_ELEMS
+        )
+        run = _level_fn(
+            cfg, level, has_coarse, lean, prev_kind, fa_ext, fuse
+        )
         nnf, dist, bp = run(
             pyr_src_a[level],
             pyr_flt_a[level],

@@ -165,19 +165,21 @@ def _batch_feature_table_bytes(
 
 def _batch_level_fn(cfg: SynthConfig, level: int, has_coarse: bool,
                     mesh_key, fa_external: bool = False,
-                    lean: bool = False, prev_kind: str = "stacked"):
+                    lean: bool = False, prev_kind: str = "stacked",
+                    fuse: bool = True):
     from ..models.analogy import _strip_noncompute
 
     return _batch_level_fn_cached(
         _strip_noncompute(cfg), level, has_coarse, mesh_key, fa_external,
-        lean, prev_kind,
+        lean, prev_kind, fuse,
     )
 
 
 @functools.lru_cache(maxsize=64)
 def _batch_level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
                            mesh_key, fa_external: bool = False,
-                           lean: bool = False, prev_kind: str = "stacked"):
+                           lean: bool = False, prev_kind: str = "stacked",
+                           fuse: bool = True):
     """One batch pyramid level as ONE compiled call: A-side feature
     assembly (+PCA) + kernel A-plane prep + vmapped state glue + all
     `cfg.em_iters` vmapped EM steps, with data-parallel shardings.
@@ -309,6 +311,15 @@ def _batch_level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
             flt_bp = bp
         return nnf, dist, bp
 
+    # fuse=False (oversized brute levels — models/analogy
+    # ._SAFE_EXEC_DIST_ELEMS): run eagerly so each jnp op and each
+    # exact_nn_pallas query chunk dispatches as its own execution;
+    # `synthesize_batch` forces frames_per_step=1 in this regime so the
+    # vmap axis never multiplies the per-execution work.  Shardings are
+    # moot there: the path exists for the single-chip full-synthesis
+    # oracle at >= 2048^2 (SCALE_r04), never for production synthesis.
+    if not fuse:
+        return run_level
     return jax.jit(
         run_level,
         in_shardings=(
@@ -378,6 +389,28 @@ def synthesize_batch(
     mesh = mesh or make_mesh()
     if frames_per_step is not None and frames_per_step < 1:
         raise ValueError("frames_per_step must be >= 1")
+    if cfg.matcher == "brute" and frames.shape[0] > 1:
+        # Oversized brute searches run UNFUSED (one execution per op /
+        # query chunk — models/analogy._SAFE_EXEC_DIST_ELEMS); frames
+        # must then microbatch one at a time, or the vmap axis would
+        # multiply every chunk execution's work right back past the
+        # budget the unfusing enforces.
+        from ..models.analogy import _SAFE_EXEC_DIST_ELEMS
+
+        h0, w0 = frames.shape[1:3]
+        work = cfg.em_iters * (h0 * w0) * (a.shape[0] * a.shape[1])
+        if (
+            work * min(frames_per_step or frames.shape[0], frames.shape[0])
+            > _SAFE_EXEC_DIST_ELEMS
+        ):
+            import logging
+
+            logging.getLogger("image_analogies_tpu").warning(
+                "brute matcher at this scale exceeds the safe "
+                "per-execution work budget: forcing frames_per_step=1 "
+                "(was %s) and unfused level dispatch", frames_per_step,
+            )
+            frames_per_step = 1
     n_stack = _n_stack if _n_stack is not None else frames.shape[0]
     if _b_stats is None and cfg.color_mode == "luminance" and cfg.luminance_remap:
         # One style normalization for the WHOLE (unpadded) stack: temporal
@@ -504,8 +537,18 @@ def synthesize_batch(
                 pyr_src_a[level + 1] if has_coarse else None,
                 pyr_flt_a[level + 1] if has_coarse else None,
             )
+        # Oversized brute levels run unfused, mirroring the single
+        # driver (models/analogy._SAFE_EXEC_DIST_ELEMS); the resident
+        # frame count scales the per-execution work.
+        from ..models.analogy import _SAFE_EXEC_DIST_ELEMS
+
+        fuse = (
+            cfg.matcher != "brute"
+            or frames.shape[0] * cfg.em_iters * (h * w) * (ha * wa)
+            <= _SAFE_EXEC_DIST_ELEMS
+        )
         run = _batch_level_fn(
-            cfg, level, has_coarse, token, fa_ext, lean, prev_kind
+            cfg, level, has_coarse, token, fa_ext, lean, prev_kind, fuse
         )
         nnf, dist, bp = run(
             pyr_src_a[level],

@@ -49,12 +49,14 @@ def test_streaming_nn_query_chunking_matches_single_call(rng):
     f_a = jnp.asarray(rng.standard_normal((700, 40)), jnp.float32)
     idx_ref, dist_ref = exact_nn(f_b, f_a, chunk=256)
 
-    # grid_a = ceil(700/512) = 2; cap 4 -> 2 query tiles (512 rows) per
-    # call -> 3 chunked calls over the padded 1280 query rows.
-    with mock.patch.object(nb, "_MAX_GRID_STEPS", 4):
-        exact_nn_pallas.clear_cache()
+    # grid_a = ceil(700/512) = 2; a 4-step work budget (4 * tq * ta
+    # tile elements) -> chunk_tiles = 4//2 = 2 query tiles (512 rows)
+    # per call -> q_tiles=3 splits into 2 chunked calls over the
+    # repadded 1024 query rows.  The ceiling only drives Python-level
+    # chunk-shape arithmetic (exact_nn_pallas is not jitted), so
+    # mocking it needs no compiled-cache control.
+    with mock.patch.object(nb, "_MAX_TILE_ELEMS", 4 * 256 * 512):
         idx_c, dist_c = exact_nn_pallas(f_b, f_a, interpret=True)
-    exact_nn_pallas.clear_cache()
 
     np.testing.assert_array_equal(np.asarray(idx_c), np.asarray(idx_ref))
     np.testing.assert_allclose(

@@ -164,6 +164,26 @@ class TestEndToEnd:
         assert r["nnf"][0].shape == (32, 32, 2)
         assert float(r["dist"][0].min()) >= 0.0
 
+    def test_unfused_brute_levels_match_fused(self):
+        """Brute levels past _SAFE_EXEC_DIST_ELEMS run the level
+        function EAGERLY (separate device executions — the TPU worker
+        kills fused executions of the 2048^2 oracle's size; the
+        SCALE_r04 crash-safety path).  The unfused run must be
+        bit-identical to the fused one: same function, different
+        dispatch granularity."""
+        from unittest import mock
+
+        import image_analogies_tpu.models.analogy as an
+
+        a, ap, b = artistic_filter(48)
+        kw = dict(levels=2, matcher="brute", em_iters=2)
+        fused = _run(a, ap, b, **kw)
+        an._level_fn.cache_clear()
+        with mock.patch.object(an, "_SAFE_EXEC_DIST_ELEMS", 1):
+            unfused = _run(a, ap, b, **kw)
+        an._level_fn.cache_clear()
+        np.testing.assert_array_equal(fused, unfused)
+
 
 def test_pm_random_candidates_noop_warning(rng, caplog, monkeypatch):
     """Tuning pm_random_candidates at kernel-eligible sizes is a no-op

@@ -1,0 +1,73 @@
+"""Batch x lean composition bench: 8 frames of 2048^2 through the
+batched runner on one chip (round-3 VERDICT task 4's measured row).
+
+Each 2048^2 frame's f32 feature tables exceed the default
+`feature_bytes_budget`, so `_batch_level_fn` takes the LEAN branch
+(per-frame plane-pair NN fields, bf16 chunk-assembled tables) at the
+fine levels — the same composition tests/test_pallas_patchmatch.py
+pins with a forced-tiny budget and counted `tile_patchmatch_lean`
+calls; this harness measures it at the real scale the budget actually
+trips at.  `frames_per_step=1` microbatches HBM exactly like the
+config-5 bench row (bench.py).
+
+Prints one JSON line:  python tools/batch_scale_bench.py [n_frames]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax.numpy as jnp
+
+from image_analogies_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+from image_analogies_tpu import SynthConfig
+from image_analogies_tpu.parallel.batch import synthesize_batch
+from image_analogies_tpu.parallel.mesh import make_mesh
+from image_analogies_tpu.utils.examples import npr_frames
+from image_analogies_tpu.utils.kernelbench import sync as _sync
+
+_SIZE = 2048
+
+
+def main():
+    n_frames = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    a, ap, frames = npr_frames(n_frames=n_frames, size=_SIZE)
+    a, ap, frames = (jnp.asarray(x, jnp.float32) for x in (a, ap, frames))
+    for x in (a, ap, frames):
+        _sync(x)
+
+    cfg = SynthConfig(levels=6, matcher="patchmatch", em_iters=2, kappa=2.0)
+    mesh = make_mesh()
+    fn = lambda: synthesize_batch(  # noqa: E731
+        a, ap, frames, cfg, mesh, frames_per_step=1
+    )
+    _sync(fn())  # compile
+    walls = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = fn()
+        _sync(out)
+        walls.append(round(time.perf_counter() - t0, 2))
+
+    print(
+        json.dumps(
+            {
+                "config": f"batched-npr-{n_frames}x{_SIZE}-fps1-lean",
+                "wall_s": min(walls),
+                "wall_runs_s": walls,
+                "per_frame_s": round(min(walls) / n_frames, 2),
+                "out_shape": list(out.shape),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

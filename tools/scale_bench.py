@@ -8,9 +8,11 @@ NN-field energy, and quality:
   synthesizes B' with exact NN at every level/EM step and the
   patchmatch output is PSNR'd against it — the same metric the 1024^2
   headline uses.  The exact-NN kernel chunks its grid
-  (kernels/nn_brute.py _MAX_GRID_STEPS) and runs at (tq=4096, ta=256)
-  tiles here, which cuts the A-table re-streaming 16x vs the default
-  tiles (traffic is (N_B/tq) * |A|).
+  (kernels/nn_brute.py _MAX_TILE_ELEMS) and runs at (tq=2048, ta=256)
+  tiles here, which cuts the A-table re-streaming 8x vs the default
+  tiles (traffic is (N_B/tq) * |A|; tq=2048 is the largest that fits
+  the 16 MB scoped-VMEM limit — measured 2026-07-31: tq=3072 and 4096
+  both fail AOT compile with scoped-vmem OOM at D=128 bf16).
 - **4096^2: stratified exact probe + bootstrap CI.**  A full-synthesis
   oracle at 4096^2 is ~2.4 PFLOP of exact NN per EM step — hours of
   wall for one row — so quality is bounded by a 1M-pixel STRATIFIED
@@ -51,7 +53,7 @@ _N_PROBE = 1 << 20
 # is ~16x that), so the full oracle runs up to 2048^2 and the 4096^2
 # row is bounded by the stratified probe.
 _FULL_ORACLE_MAX = 2048
-_NN_TILES = dict(tq=4096, ta=256)
+_NN_TILES = dict(tq=2048, ta=256)
 
 
 def _stratified_probe_idx(n_px: int, n_probe: int, rng) -> np.ndarray:
@@ -113,6 +115,9 @@ def _exact_probe(a, ap, b, cfg, aux):
     probe = jnp.asarray(_stratified_probe_idx(h * w, n_probe, rng))
     fb_rows = jnp.take(f_b_tab, probe, axis=0).astype(jnp.float32)
     idx_ach = jnp.take((py0 * wa + px0).reshape(-1), probe, axis=0)
+    # Only the gathered probe rows are needed from the B side; the full
+    # table is 4.3 GB at 4096^2 and the exact search wants that HBM.
+    del f_b_tab, flt0, flt1
 
     idx_exact, d_exact = exact_nn_pallas(
         fb_rows, f_a_tab, match_dtype=jnp.bfloat16, **_NN_TILES
@@ -195,12 +200,22 @@ def main():
             "nnf_energy_level0": energy,
         }
         row.update(_exact_probe(a, ap, b, cfg, aux))
+        # The oracle run needs every byte of HBM at 2048^2 (two 2.1 GB
+        # f32 tables + eager temps); drop the instrumented run's aux
+        # fields before it starts.
+        del aux
+        import gc
+
+        gc.collect()
 
         if size <= _FULL_ORACLE_MAX:
             # Full-synthesis exact-oracle PSNR, with the exact-NN kernel
-            # forced onto giant-A tiles (and grid-chunked — the pre-r4
-            # unchunked 2048^2 call's ~134M-step grid exceeded the safe
-            # grid regime; see nn_brute._MAX_GRID_STEPS).
+            # forced onto giant-A tiles.  Crash-safety is structural
+            # now: the driver runs oversized brute levels unfused
+            # (analogy._SAFE_EXEC_DIST_ELEMS) and exact_nn_pallas
+            # chunks its query axis into separate executions
+            # (nn_brute._MAX_TILE_ELEMS), so no single device
+            # execution outlives the worker's tolerance.
             orig = nb.exact_nn_pallas
 
             def big_tiles(fb, fa, **kw):
