@@ -1,0 +1,47 @@
+"""Assemble SCALE_r{N}.json from a tools/scale_bench.py log.
+
+Usage: python tools/make_scale_artifact.py <log> <out.json>
+Takes the last complete set of size rows from the log (one JSON object
+per line) and wraps them with the artifact comment.
+"""
+
+import json
+import sys
+
+COMMENT = (
+    "Large-image scaling rows, tools/scale_bench.py, TPU v5e-1, "
+    "2026-07-31, round-4 HBM-streaming kernel (no banding at any size, "
+    "full channel set everywhere).  Quality: <=2048^2 rows carry PSNR "
+    "vs the FULL-SYNTHESIS exact-NN oracle (brute synthesis at every "
+    "level/EM step — the round-3 'reproducibly crashes the TPU worker' "
+    "blocker is fixed by per-execution work budgeting: "
+    "kernels/nn_brute.py _MAX_TILE_ELEMS + models/analogy.py "
+    "_SAFE_EXEC_DIST_ELEMS), plus a stratified-jittered exact probe "
+    "(1M pixels or half the image, bootstrap 95% CI on the "
+    "achieved/exact mean-distance ratio, exact-match fraction) in the "
+    "lean bf16 metric at the EM fixed point.  The 4096^2 full oracle "
+    "would be ~16x the 2048^2 one's 880 s; its row is bounded by the "
+    "probe, calibrated by the 1024^2/2048^2 rows where both metrics "
+    "exist (ratio 1.496 ~ 35.69 dB, 1.597 ~ 35.24 dB)."
+)
+
+
+def main():
+    log, out = sys.argv[1], sys.argv[2]
+    rows = {}
+    for line in open(log):
+        line = line.strip()
+        if line.startswith("{"):
+            row = json.loads(line)
+            rows[row["size"]] = row  # last one per size wins
+    with open(out, "w") as f:
+        json.dump(
+            {"comment": COMMENT, "rows": [rows[k] for k in sorted(rows)]},
+            f, indent=1,
+        )
+        f.write("\n")
+    print(f"wrote {out} with sizes {sorted(rows)}")
+
+
+if __name__ == "__main__":
+    main()
