@@ -43,6 +43,12 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
         "matching (Hertzmann-style PCA; default off)",
     )
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--feature-bytes-budget", type=int, default=None,
+        help="per-level f32 feature-table HBM budget in bytes; levels "
+        "above it take the lean path (bf16 chunked tables, plane-pair "
+        "field).  Default: config default (2 GiB)",
+    )
     p.add_argument("--device", default=None, choices=["cpu", "tpu"])
     p.add_argument(
         "--pallas-mode",
@@ -67,7 +73,13 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
 def _config_from(args) -> "SynthConfig":
     from .config import SynthConfig
 
+    budget = (
+        {}
+        if args.feature_bytes_budget is None
+        else {"feature_bytes_budget": args.feature_bytes_budget}
+    )
     return SynthConfig(
+        **budget,
         levels=args.levels,
         patch_size=args.patch_size,
         coarse_patch_size=args.coarse_patch_size,
@@ -124,6 +136,22 @@ def cmd_synth(args) -> int:
                 a, ap, b, cfg, make_mesh(args.n_devices),
                 progress=level_progress,
                 resume_from=args.resume_from,
+            )
+        elif args.sharded_a:
+            from .parallel.mesh import make_mesh
+            from .parallel.sharded_a import synthesize_sharded_a
+
+            if args.resume_from or args.save_level_artifacts:
+                raise SystemExit(
+                    "--sharded-a does not support checkpointing "
+                    "(--resume-from / --save-level-artifacts) yet; "
+                    "checkpointed runs use the single-device or "
+                    "--spatial runner"
+                )
+            bp = synthesize_sharded_a(
+                a, ap, b, cfg,
+                make_mesh(args.n_devices, axis_names=("bands",)),
+                progress=level_progress,
             )
         else:
             bp = create_image_analogy(
@@ -220,10 +248,17 @@ def main(argv=None) -> int:
     p.add_argument("--ap", required=True)
     p.add_argument("--b", required=True)
     p.add_argument("--out", required=True)
-    p.add_argument(
+    mode = p.add_mutually_exclusive_group()
+    mode.add_argument(
         "--spatial", action="store_true",
         help="shard B' row-slabs over the device mesh (halo-exchange "
         "spatial parallelism) instead of single-device synthesis",
+    )
+    mode.add_argument(
+        "--sharded-a", action="store_true",
+        help="band-shard the A-side feature tables over the device "
+        "mesh (style pairs beyond one device's budget); bit-identical "
+        "to single-device synthesis at lean levels",
     )
     p.add_argument("--n-devices", type=int, default=None)
     _add_synth_flags(p)

@@ -111,6 +111,63 @@ def random_init_planes(key: jax.Array, h: int, w: int, ha: int, wa: int):
     )
 
 
+def lean_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
+                 polish_iters, src_b, flt_b, src_b_c, flt_b_c, f_a,
+                 copy_a, nnf, key, a_planes, *, interpret: bool,
+                 dist_fn=None, bounds=None, sweep_merge=None):
+    """One lean EM step: chunk-assembled bf16 B table, plane-pair
+    field, kernel + exact-metric merge + polish, gather render.
+
+    The SINGLE body behind both the single-device lean path
+    (make_em_step's lean closure) and the band-sharded-A runner
+    (parallel/sharded_a.py), which passes the three sharded hooks
+    through to tile_patchmatch_lean — the sharded runner's bit-identity
+    contract holds precisely because the ops live here once.
+
+    In lean steps the `f_a` slot carries the (Na, D) bf16 A-side table
+    (assemble_features_lean; the sharded runner passes its band's
+    slice) and the `nnf` slot a (py, px) plane pair; `a_planes` is the
+    kernel A-plane band tuple.
+    """
+    from ..kernels.patchmatch_tile import plan_channels
+    from .patchmatch import RawPlanes, tile_patchmatch_lean
+
+    py, px = nnf
+    h, w = src_b.shape[:2]
+    ha, wa = copy_a.shape[:2]
+    n_src = 1 if src_b.ndim == 2 else src_b.shape[-1]
+    n_flt = 1 if flt_b.ndim == 2 else flt_b.shape[-1]
+    plan = plan_channels(n_src, n_flt, cfg, has_coarse, h, w, ha, wa)
+    f_b_tab = assemble_features_lean(
+        src_b,
+        flt_b,
+        cfg,
+        src_b_c if has_coarse else None,
+        flt_b_c if has_coarse else None,
+    )
+    raw = RawPlanes(
+        src_b,
+        flt_b,
+        src_b_c if has_coarse else None,
+        flt_b_c if has_coarse else None,
+        a_planes,
+    )
+    if dist_fn is not None:
+        dist_fn = dist_fn(f_b_tab)
+    py, px, dist = tile_patchmatch_lean(
+        f_b_tab, f_a, py, px, key, raw=raw, cfg=cfg, level=level,
+        interpret=interpret, plan=plan,
+        ha=ha, wa=wa, polish_iters=polish_iters,
+        dist_fn=dist_fn, bounds=bounds, sweep_merge=sweep_merge,
+    )
+    flat = copy_a.reshape(ha * wa, -1)
+    out = jnp.take(
+        flat, (py * wa + px).reshape(-1), axis=0
+    ).reshape(h, w, -1)
+    bp = out[..., 0] if copy_a.ndim == 2 else out
+    return (py, px), dist, bp
+
+
 def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
                  lean: bool = False, polish_iters=None):
     """One EM step at one pyramid level: features -> match -> render.
@@ -136,46 +193,14 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
 
     if lean:
         from ..kernels import resolve_pallas
-        from ..kernels.patchmatch_tile import plan_channels
-        from .patchmatch import RawPlanes, tile_patchmatch_lean
 
         def em_step_lean(src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf,
                          key, proj=None, a_planes=None):
-            # In lean steps the f_a slot carries the (Na, D) bf16
-            # A-side table (assemble_features_lean), and the nnf slot a
-            # (py, px) plane pair; the B-side table is assembled
-            # in-step, chunk-wise, in the same layout.
-            py, px = nnf
-            h, w = src_b.shape[:2]
-            ha, wa = copy_a.shape[:2]
-            n_src = 1 if src_b.ndim == 2 else src_b.shape[-1]
-            n_flt = 1 if flt_b.ndim == 2 else flt_b.shape[-1]
-            plan = plan_channels(n_src, n_flt, cfg, has_coarse, h, w, ha, wa)
-            f_b_tab = assemble_features_lean(
-                src_b,
-                flt_b,
-                cfg,
-                src_b_c if has_coarse else None,
-                flt_b_c if has_coarse else None,
+            return lean_em_step(
+                cfg, level, has_coarse, polish_iters,
+                src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf, key,
+                a_planes, interpret=bool(resolve_pallas(cfg)),
             )
-            raw = RawPlanes(
-                src_b,
-                flt_b,
-                src_b_c if has_coarse else None,
-                flt_b_c if has_coarse else None,
-                a_planes,
-            )
-            py, px, dist = tile_patchmatch_lean(
-                f_b_tab, f_a, py, px, key, raw=raw, cfg=cfg, level=level,
-                interpret=bool(resolve_pallas(cfg)), plan=plan,
-                ha=ha, wa=wa, polish_iters=polish_iters,
-            )
-            flat = copy_a.reshape(ha * wa, -1)
-            out = jnp.take(
-                flat, (py * wa + px).reshape(-1), axis=0
-            ).reshape(h, w, -1)
-            bp = out[..., 0] if copy_a.ndim == 2 else out
-            return (py, px), dist, bp
 
         return em_step_lean
 

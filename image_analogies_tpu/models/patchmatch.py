@@ -326,6 +326,7 @@ def patchmatch_sweeps_lean(
     iters: int,
     n_random: int,
     coh_factor: float,
+    dist_fn=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """`patchmatch_sweeps` over the lean (N, D) bf16 tables and a
     PLANE-PAIR field; returns (py, px, dist).
@@ -338,13 +339,21 @@ def patchmatch_sweeps_lean(
     carried as separate (H, W) int32 planes — a stacked (H, W, 2) array
     tiles as T(8, 128) on its trailing dims, padding 2 -> 128 lanes
     (64x, 8 GB at 4096^2).
+
+    `dist_fn` (flat idx (N,) -> dist (N,)) overrides the candidate
+    metric; the band-sharded-A runner (parallel/sharded_a.py) passes a
+    masked local-shard evaluation merged by cross-device pmin, which is
+    value-identical to the default because every flat index has exactly
+    one owning band.
     """
     h, w = py.shape
+    if dist_fn is None:
+        dist_fn = lambda idx: candidate_dist_lean(  # noqa: E731
+            f_b_tab, f_a_tab, idx
+        )
     py = jnp.clip(py, 0, ha - 1)
     px = jnp.clip(px, 0, wa - 1)
-    dist = candidate_dist_lean(
-        f_b_tab, f_a_tab, (py * wa + px).reshape(-1)
-    ).reshape(h, w)
+    dist = dist_fn((py * wa + px).reshape(-1)).reshape(h, w)
 
     max_radius = max(ha, wa)
     radii = [max(1, int(max_radius * (0.5**s))) for s in range(n_random)]
@@ -354,9 +363,7 @@ def patchmatch_sweeps_lean(
         cy = jnp.clip(cy, 0, ha - 1)
         cx = jnp.clip(cx, 0, wa - 1)
         idx = cy * wa + cx
-        d_cand = candidate_dist_lean(
-            f_b_tab, f_a_tab, idx.reshape(-1)
-        ).reshape(h, w)
+        d_cand = dist_fn(idx.reshape(-1)).reshape(h, w)
         idx_cur = py_c * wa + px_c
         better = d_cand * factor < dist_cur
         tie_lower = (d_cand == dist_cur) & (idx < idx_cur)
@@ -407,6 +414,9 @@ def tile_patchmatch_lean(
     ha: int,
     wa: int,
     polish_iters: Optional[int] = None,
+    dist_fn=None,
+    bounds=None,
+    sweep_merge=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """PatchMatch for levels whose ROW-MAJOR feature tables would not
     fit HBM (models/analogy.py `_feature_table_bytes`); the field is a
@@ -426,6 +436,21 @@ def tile_patchmatch_lean(
     acceptance configs all run at standard-path sizes, so the lean
     asymmetry is latent until a kappa>0 use case above the feature
     budget exists.
+
+    Band-sharded-A hooks (parallel/sharded_a.py; defaults reproduce
+    the single-device behavior exactly):
+    - `dist_fn` — see patchmatch_sweeps_lean; used for the incumbent,
+      merge, and polish evaluations.
+    - `bounds` — overrides the band row-bounds derived from the plan
+      (each shard_map device passes ITS band's (lo, hi) with
+      raw.a_planes holding only that band's planes).
+    - `sweep_merge((oy, ox, d) blocked planes) -> same` — called after
+      every pm iteration; the sharded runner cross-device
+      argmin-merges here so the next iteration's candidates sample
+      from the GLOBAL best field, mirroring the sequential banded
+      search's carried state (strict-improvement accepts make the
+      merge order-equivalent — tests/test_spatial.py
+      test_sharded_a_band_search_matches_sequential).
     """
     from ..kernels.patchmatch_tile import (
         band_bounds,
@@ -439,11 +464,16 @@ def tile_patchmatch_lean(
 
     h, w = raw.src_b.shape[:2]
     specs, use_coarse, n_bands = plan
-    bounds = band_bounds(ha, n_bands)
+    if bounds is None:
+        bounds = band_bounds(ha, n_bands)
     geom = tile_geometry(h, w, specs)
     coh = kappa_factor(cfg.kappa, level)
     if polish_iters is None:
         polish_iters = cfg.pm_polish_iters
+    if dist_fn is None:
+        dist_fn = lambda idx: candidate_dist_lean(  # noqa: E731
+            f_b_tab, f_a_tab, idx
+        )
 
     chans_b = channel_images(
         raw.src_b,
@@ -461,9 +491,7 @@ def tile_patchmatch_lean(
     qx = jax.lax.broadcasted_iota(jnp.int32, (h, w), 1)
     off_y = py - qy
     off_x = px - qx
-    dist0 = candidate_dist_lean(
-        f_b_tab, f_a_tab, (py * wa + px).reshape(-1)
-    ).reshape(h, w)
+    dist0 = dist_fn((py * wa + px).reshape(-1)).reshape(h, w)
 
     oy_b = to_blocked(off_y, geom)
     ox_b = to_blocked(off_x, geom)
@@ -484,15 +512,15 @@ def tile_patchmatch_lean(
                 specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=coh,
                 interpret=interpret,
             )
+        if sweep_merge is not None:
+            oy_b, ox_b, d_b = sweep_merge(oy_b, ox_b, d_b)
     off_y = from_blocked(oy_b, geom, h, w)
     off_x = from_blocked(ox_b, geom, h, w)
 
     ky = jnp.clip(qy + off_y, 0, ha - 1)
     kx = jnp.clip(qx + off_x, 0, wa - 1)
     # Exact-metric merge: adopt the kernel's match only where it wins.
-    d_k = candidate_dist_lean(
-        f_b_tab, f_a_tab, (ky * wa + kx).reshape(-1)
-    ).reshape(h, w)
+    d_k = dist_fn((ky * wa + kx).reshape(-1)).reshape(h, w)
     better = d_k < dist0
     py_m = jnp.where(better, ky, py)
     px_m = jnp.where(better, kx, px)
@@ -509,6 +537,7 @@ def tile_patchmatch_lean(
         iters=polish_iters,
         n_random=cfg.pm_polish_random,
         coh_factor=coh,
+        dist_fn=dist_fn,
     )
 
 

@@ -31,24 +31,24 @@ field, bf16 chunk-assembled per-slab B tables), so per-device residency
 is the slab's share of the B side plus the replicated A side — the
 runner reaches the single-chip lean path's ceiling TIMES the mesh on
 the B' axis (e.g. ~8192^2 B' on 4 chips that each handle lean 4096^2
-slabs).  The remaining hard wall is the replicated A side.  Its
-sharded design is VALIDATED at the kernel level: A's rows split into
-ownership bands (`prepare_a_planes(n_bands=n)` + `band_bounds` — each
-band evaluates only candidates whose clamped origin it owns), each
-device sweeps its own band under `shard_map`, and an elementwise
-distance argmin merges the per-device fields bit-identically to the
-sequential banded search (tests/test_spatial.py
-test_sharded_a_band_search_matches_sequential).  What is NOT built is
-the full runner around it, for a measured reason: since the round-4
-HBM-streaming kernel the A planes cost HBM only (~19 MB/1024^2-channel
-set — a 16 GB chip fits a ~45000^2-pixel A side), so the binding
-A-side residency is the lean bf16 FEATURE TABLE the exact-metric
-merge/polish gathers from (N_A * 256 B ≈ 4.3 GB at 4096^2), and
-sharding THAT requires distributed gathers in the polish (every
-device's candidates index arbitrary A rows), a different mechanism
-from band ownership.  Until a style pair within 4x of a chip's HBM
-exists as a use case, the banded kernel contract above is the
-shippable unit.
+slabs).  The remaining hard wall here is the replicated A side — and
+for THAT, `parallel/sharded_a.py` is the runner (round-4): A's rows
+split into ownership bands (`prepare_a_planes(n_bands=n)` +
+`band_bounds` — each band evaluates only candidates whose clamped
+origin it owns), each device sweeps its own band under `shard_map`
+with a cross-device argmin merge after every pm iteration, and the
+exact-metric merge/polish gathers run as masked LOCAL-shard lookups
+merged by `pmin` (every flat A index has exactly one owner), so
+per-device A residency — the lean bf16 feature table, N_A * 256 B ≈
+4.3 GB at 4096^2, which since the round-4 HBM-streaming kernel binds
+long before the kernel planes (~19 MB/1024^2-channel set) — drops to
+1/n.  The sharded runner is BIT-IDENTICAL to the single-device lean
+path (tests/test_spatial.py
+test_sharded_a_runner_bit_identical_to_single_device; the kernel-level
+band contract is pinned separately by
+test_sharded_a_band_search_matches_sequential).  Composing it with
+THIS runner's B' slabs (a 2-D bands x slabs mesh) is the remaining
+step for pairs where both sides outgrow a chip.
 """
 
 from __future__ import annotations

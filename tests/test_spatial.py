@@ -340,6 +340,63 @@ def test_spatial_lean_checkpoint_roundtrip(rng, tmp_path):
     np.testing.assert_array_equal(resumed, full)
 
 
+def test_sharded_a_runner_bit_identical_to_single_device(rng):
+    """Full band-sharded-A synthesis (parallel/sharded_a.py, round-3
+    VERDICT task 7's 'full runner'): with the A-side lean tables and
+    kernel planes split into per-device ownership bands, the output
+    must be BIT-IDENTICAL to the single-device lean path — same PRNG
+    streams and candidate order; banded kernel == single-band kernel by
+    the ownership contract (test below); masked local gathers merged by
+    pmin == single-table gathers because every flat A index has exactly
+    one owner.  A forced-tiny feature budget makes every kernel-eligible
+    level lean, so the sharded step carries the whole synthesis."""
+    from unittest import mock
+
+    from image_analogies_tpu.parallel.sharded_a import synthesize_sharded_a
+
+    n_dev = 4
+    size = 128
+    base = rng.random((size, size), np.float32)
+    a = base
+    ap = np.clip(base * 0.6 + 0.3, 0, 1).astype(np.float32)
+    b = np.roll(base, 17, axis=0)
+    cfg = SynthConfig(
+        levels=2, matcher="patchmatch", em_iters=2, pm_iters=2,
+        feature_bytes_budget=1, pallas_mode="interpret",
+    )
+    single = np.asarray(create_image_analogy(a, ap, b, cfg))
+    mesh = make_mesh(n_dev, axis_names=("bands",))
+
+    # The claim the runner exists for: the table handed to the sharded
+    # level fn must actually be ROW-SHARDED — each device's addressable
+    # shard holds exactly 1/n of the A rows (a silently replicated
+    # table would still produce correct output).
+    import image_analogies_tpu.parallel.sharded_a as sa
+
+    real_level_fn = sa._sharded_level_fn
+    shard_rows = []
+
+    def spying_level_fn(*fargs, **fkw):
+        fn = real_level_fn(*fargs, **fkw)
+
+        def wrapper(f_a_tab, *rest):
+            shard_rows.append(
+                (f_a_tab.shape[0],
+                 [s.data.shape[0] for s in f_a_tab.addressable_shards])
+            )
+            return fn(f_a_tab, *rest)
+
+        return wrapper
+
+    with mock.patch.object(sa, "_sharded_level_fn", spying_level_fn):
+        sharded = np.asarray(synthesize_sharded_a(a, ap, b, cfg, mesh))
+    np.testing.assert_array_equal(sharded, single)
+    assert shard_rows, "no level ran the sharded step"
+    for total, per_dev in shard_rows:
+        assert len(per_dev) == n_dev
+        assert all(r == total // n_dev for r in per_dev)
+
+
 def test_sharded_a_band_search_matches_sequential(rng):
     """Sharded-A prototype (round-3 VERDICT task 7): A's rows are split
     into ownership bands, each mesh device runs the tile kernel against
