@@ -75,6 +75,42 @@ def coherence_sweeps(
     return nnf, dist
 
 
+def coherence_sweeps_lean(
+    py: jnp.ndarray,
+    px: jnp.ndarray,
+    dist: jnp.ndarray,
+    *,
+    ha: int,
+    wa: int,
+    factor: float,
+    sweeps: int,
+    dist_fn,
+) -> tuple:
+    """`coherence_sweeps` for the lean plane-pair field: identical
+    candidates, ceiling, and accept rule, with distances through the
+    caller's `dist_fn` (flat idx -> d; chunked bf16 tables on the lean
+    path, masked pmin-merged shard lookups on the sharded-A runner).
+    Bit-identical to the stacked twin on equal tables (tested)."""
+    ceiling = dist * factor
+    best_coh = jnp.full_like(dist, jnp.inf)
+
+    for _ in range(sweeps):
+        for dy, dx in _DELTAS:
+            cy = jnp.clip(
+                jnp.roll(py, (dy, dx), (0, 1)) + dy, 0, ha - 1
+            )
+            cx = jnp.clip(
+                jnp.roll(px, (dy, dx), (0, 1)) + dx, 0, wa - 1
+            )
+            d_cand = dist_fn((cy * wa + cx).reshape(-1)).reshape(py.shape)
+            accept = (d_cand < best_coh) & (d_cand <= ceiling)
+            py = jnp.where(accept, cy, py)
+            px = jnp.where(accept, cx, px)
+            dist = jnp.where(accept, d_cand, dist)
+            best_coh = jnp.where(accept, d_cand, best_coh)
+    return py, px, dist
+
+
 class CoherenceWrapper(Matcher):
     """base matcher + kappa-biased coherence sweeps (no-op at kappa=0)."""
 

@@ -430,12 +430,11 @@ def tile_patchmatch_lean(
     field stays in (H, W) planes (a stacked (H, W, 2) int32 pads
     2 -> 128 lanes = 8 GB at 4096^2).
     Output contract matches the standard kernel path up to bf16
-    quantization of the features, EXCEPT the kappa>0 Ashikhmin adoption
-    pass (tile_patchmatch runs coherence_sweeps after the polish; the
-    plane-pair field would need a lean variant of it) — the kappa
-    acceptance configs all run at standard-path sizes, so the lean
-    asymmetry is latent until a kappa>0 use case above the feature
-    budget exists.
+    quantization of the features, INCLUDING the kappa>0 Ashikhmin
+    adoption pass: `coherence_sweeps_lean` runs after the polish with
+    the same rule/sweep count as the standard path's
+    `coherence_sweeps` (bit-identical on equal tables — tested), so
+    kappa acceptance semantics hold above the feature budget too.
 
     Band-sharded-A hooks (parallel/sharded_a.py; defaults reproduce
     the single-device behavior exactly):
@@ -526,7 +525,7 @@ def tile_patchmatch_lean(
     px_m = jnp.where(better, kx, px)
     if polish_iters == 0:
         return py_m, px_m, jnp.where(better, d_k, dist0)
-    return patchmatch_sweeps_lean(
+    py_p, px_p, d_p = patchmatch_sweeps_lean(
         f_b_tab,
         f_a_tab,
         py_m,
@@ -539,6 +538,19 @@ def tile_patchmatch_lean(
         coh_factor=coh,
         dist_fn=dist_fn,
     )
+    if cfg.kappa > 0.0:
+        # Ashikhmin adoption pass on the plane-pair field — the same
+        # rule tile_patchmatch runs after ITS polish (the kappa-aware
+        # oracle's semantics; see the standard path's comment), so the
+        # kappa acceptance behavior no longer diverges above the
+        # feature budget.
+        from .coherence import coherence_sweeps_lean
+
+        py_p, px_p, d_p = coherence_sweeps_lean(
+            py_p, px_p, d_p, ha=ha, wa=wa, factor=coh, sweeps=2,
+            dist_fn=dist_fn,
+        )
+    return py_p, px_p, d_p
 
 
 class PatchMatchMatcher(Matcher):

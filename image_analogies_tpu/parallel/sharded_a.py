@@ -33,10 +33,22 @@ Data path per EM step (all inside one `shard_map` over the band axis):
    runs replicated on the merged distances, so all devices carry the
    same field.
 
-Equivalence: sharded-lean levels are BIT-IDENTICAL to the single-device
-lean path (same PRNG streams, same candidate order, banded kernel ==
-single-band kernel by the ownership contract, masked-gather distances
-== table distances) — pinned by tests/test_spatial.py.
+Equivalence: at kappa=0, sharded-lean levels are BIT-IDENTICAL to the
+single-device lean path (same PRNG streams, same candidate order,
+banded kernel == single-band kernel by the ownership contract,
+masked-gather distances == table distances) — pinned by
+tests/test_spatial.py.  At kappa>0 the kernel's accept is NOT a plain
+min (an approximate candidate must clear `d_app * coh_factor <
+d_coh`), so the cross-band raw-distance pmin is not order-equivalent
+to the sequential carry: a band may accept an approximate candidate
+that the sequential order would have rejected against another band's
+coherent one.  The result is still a valid field of the same accept
+family — strictly closer matches win, the coherence bias is just
+marginally weaker across band boundaries — and the post-polish
+Ashikhmin adoption pass (`coherence_sweeps_lean`, which runs on the
+EXACT merged distances via the sharded dist_fn) applies the oracle's
+kappa semantics identically.  Callers needing bit-level
+reproducibility of a kappa>0 single-device run should use one device.
 
 Levels below the lean/kernel threshold run the stock single-device
 level function (`models/analogy._level_fn`) with the A side
@@ -85,7 +97,9 @@ _AXIS = "bands"
 def _band_merge(oy, ox, d):
     """Cross-band elementwise argmin of the blocked kernel state, ties
     to the lower band — the parallel form of the sequential banded
-    carry (strict-improvement accepts make them order-equivalent)."""
+    carry.  Order-equivalent at kappa=0 (strict-improvement accepts);
+    at kappa>0 the raw-distance pmin slightly weakens the cross-band
+    coherence bias (module docstring, 'Equivalence')."""
     i = jax.lax.axis_index(_AXIS)
     d_min = jax.lax.pmin(d, _AXIS)
     mine = jnp.where(d == d_min, i, jnp.iinfo(jnp.int32).max)
@@ -199,9 +213,14 @@ def synthesize_sharded_a(
     with the mesh (module docstring: data path + equivalence).
 
     Sharded-lean levels are bit-identical to the single-device lean
-    path; sub-threshold levels run the stock replicated level function.
+    path at kappa=0 (kappa>0: same accept family, marginally weaker
+    cross-band coherence bias — module docstring, 'Equivalence');
+    sub-threshold levels run the stock replicated level function.
     Requires each sharded level's A rows to split evenly over the mesh
     (ha % n_devices == 0 — band planes must stack rectangularly).
+    Warns if NO level engaged the sharded step (the flag's purpose
+    unmet: every level fit under `cfg.feature_bytes_budget` or was not
+    kernel-eligible).
     `progress` is an optional utils.progress.ProgressWriter (one timed
     `level_done` event per level, like the single driver).
 
@@ -249,6 +268,7 @@ def synthesize_sharded_a(
 
     bp = None
     nnf = None  # stacked array (replicated levels) or (py, px) planes
+    n_sharded_levels = 0
     for level in range(levels - 1, -1, -1):
         level_t0 = time.perf_counter()
         h, w = pyr_src_b[level].shape[:2]
@@ -320,6 +340,7 @@ def synthesize_sharded_a(
             else:
                 p_py, p_px = nnf[..., 0], nnf[..., 1]
                 prev_bp = bp
+            n_sharded_levels += 1
             run = _sharded_level_fn(
                 _strip_noncompute(cfg), level, has_coarse, token,
                 interpret,
@@ -385,4 +406,16 @@ def synthesize_sharded_a(
                 nnf_energy=nnf_energy,
             )
 
+    if not n_sharded_levels:
+        import logging
+
+        logging.getLogger("image_analogies_tpu").warning(
+            "sharded-A run never engaged the sharded step: every level "
+            "fit under feature_bytes_budget (%d bytes) or was not "
+            "kernel-eligible, so the A side was REPLICATED on all %d "
+            "devices — the synthesis is correct but nothing was "
+            "sharded.  Lower cfg.feature_bytes_budget "
+            "(--feature-bytes-budget) to engage A-side sharding.",
+            cfg.feature_bytes_budget, n_dev,
+        )
     return _finalize(bp, yiq_b, b, cfg)

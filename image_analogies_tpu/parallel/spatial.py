@@ -43,10 +43,11 @@ per-device A residency — the lean bf16 feature table, N_A * 256 B ≈
 4.3 GB at 4096^2, which since the round-4 HBM-streaming kernel binds
 long before the kernel planes (~19 MB/1024^2-channel set) — drops to
 1/n.  The sharded runner is BIT-IDENTICAL to the single-device lean
-path (tests/test_spatial.py
-test_sharded_a_runner_bit_identical_to_single_device; the kernel-level
-band contract is pinned separately by
-test_sharded_a_band_search_matches_sequential).  Composing it with
+path at kappa=0 (tests/test_spatial.py
+test_sharded_a_runner_bit_identical_to_single_device; kappa>0 trades
+bit-identity for a marginally weaker cross-band coherence bias — see
+sharded_a.py 'Equivalence'; the kernel-level band contract is pinned
+separately by test_sharded_a_band_search_matches_sequential).  Composing it with
 THIS runner's B' slabs (a 2-D bands x slabs mesh) is the remaining
 step for pairs where both sides outgrow a chip.
 """

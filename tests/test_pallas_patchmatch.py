@@ -648,6 +648,83 @@ class TestLeanPath:
             )
         assert calls, "default budget must keep the exact-metric path"
 
+    def test_lean_coherence_sweeps_match_stacked(self, rng):
+        """`coherence_sweeps_lean` must be bit-identical to the stacked
+        `coherence_sweeps` on equal tables: same candidates (rolled
+        neighbors + relative offset), same ceiling/accept rule, same
+        sweep order — the kappa semantics above the feature budget are
+        literally the standard path's."""
+        import jax
+        import jax.numpy as jnp
+
+        from image_analogies_tpu.models.coherence import (
+            coherence_sweeps,
+            coherence_sweeps_lean,
+        )
+        from image_analogies_tpu.models.matcher import (
+            candidate_dist_lean,
+            nnf_dist,
+        )
+
+        h = w = ha = wa = 24
+        d = 7
+        f_b = jnp.asarray(rng.standard_normal((h, w, d)), jnp.float32)
+        f_a = jnp.asarray(rng.standard_normal((ha, wa, d)), jnp.float32)
+        f_a_flat = f_a.reshape(-1, d)
+        key = jax.random.PRNGKey(3)
+        py = jax.random.randint(key, (h, w), 0, ha)
+        px = jax.random.randint(jax.random.fold_in(key, 1), (h, w), 0, wa)
+        nnf = jnp.stack([py, px], axis=-1)
+        dist = nnf_dist(f_b, f_a_flat, nnf, wa)
+
+        nnf_s, dist_s = coherence_sweeps(
+            f_b, f_a, nnf, dist, factor=3.0, sweeps=2
+        )
+        f_b_tab = f_b.reshape(-1, d)
+        py_l, px_l, dist_l = coherence_sweeps_lean(
+            py, px, dist, ha=ha, wa=wa, factor=3.0, sweeps=2,
+            dist_fn=lambda idx: candidate_dist_lean(f_b_tab, f_a_flat, idx),
+        )
+        np.testing.assert_array_equal(np.asarray(py_l), np.asarray(nnf_s[..., 0]))
+        np.testing.assert_array_equal(np.asarray(px_l), np.asarray(nnf_s[..., 1]))
+        np.testing.assert_allclose(
+            np.asarray(dist_l), np.asarray(dist_s), rtol=1e-6
+        )
+
+    def test_lean_kappa_increases_coherence(self, rng):
+        """kappa=5 through the FORCED-LEAN path (feature_bytes_budget=1)
+        must make the synthesized s-map measurably more coherent than
+        kappa=0 — the adoption pass the lean path lacked until round 4
+        (its absence was a documented asymmetry vs the standard path)."""
+        from image_analogies_tpu import create_image_analogy
+
+        a, ap, b = self._abp(rng)
+
+        def coherence(py, px):
+            off_y = np.asarray(py) - np.arange(py.shape[0])[:, None]
+            off_x = np.asarray(px) - np.arange(px.shape[1])[None, :]
+            same = (
+                ((off_y[1:] == off_y[:-1]) & (off_x[1:] == off_x[:-1]))
+                .mean()
+                + (
+                    (off_y[:, 1:] == off_y[:, :-1])
+                    & (off_x[:, 1:] == off_x[:, :-1])
+                ).mean()
+            )
+            return same / 2
+
+        cohs = {}
+        for kappa in (0.0, 5.0):
+            cfg = SynthConfig(
+                levels=1, matcher="patchmatch", pallas_mode="interpret",
+                em_iters=1, pm_iters=2, kappa=kappa,
+                feature_bytes_budget=1,
+            )
+            aux = create_image_analogy(a, ap, b, cfg, return_aux=True)
+            py, px = aux["nnf"][0]
+            cohs[kappa] = coherence(py, px)
+        assert cohs[5.0] > cohs[0.0] + 0.02, cohs
+
 
 class TestBatchedKernelPath:
     def test_batch_runner_uses_kernel_under_vmap(self, rng):
