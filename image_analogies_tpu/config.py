@@ -104,6 +104,17 @@ class SynthConfig:
     # per step; bounds peak HBM for the (chunk, N_A) distance tile).
     brute_chunk: int = 4096
 
+    # Estimated f32 feature-table HBM bytes above which a BRUTE level
+    # runs the lean-brute path: both tables assembled chunk-wise into
+    # bf16 (assemble_features_lean), the exact search run as chunked
+    # eager executions (kernels/nn_brute.py), and the field carried as
+    # (H, W) planes.  Distinct from `feature_bytes_budget` on purpose:
+    # the brute matcher is the PSNR oracle, so it keeps the exact f32
+    # metric as long as the tables physically fit — 10 GiB ≈ what a
+    # 16 GB v5e-1 can host next to the pipeline's other residents
+    # (2048^2 tables are 4.3 GB: f32 path; 4096^2 are 17.2 GB: lean).
+    brute_lean_bytes: int = 10 * 1024**3
+
     # Approximation factor for the native kd-tree 'ann' matcher (C8):
     # returned neighbors are within (1+eps) of the true nearest distance;
     # 0 = exact search.  Pair with pca_dims (Hertzmann §3.1).
@@ -136,6 +147,8 @@ class SynthConfig:
             raise ValueError("pca_dims must be >= 1 (or None to disable)")
         if self.feature_bytes_budget < 1:
             raise ValueError("feature_bytes_budget must be >= 1")
+        if self.brute_lean_bytes < 1:
+            raise ValueError("brute_lean_bytes must be >= 1")
         if self.ann_eps < 0.0:
             raise ValueError("ann_eps must be >= 0")
 

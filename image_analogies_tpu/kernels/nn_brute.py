@@ -173,12 +173,23 @@ def exact_nn_pallas(
     n_a = f_a_flat.shape[0]
     match_dtype = jnp.dtype(match_dtype)
 
-    # Pad D to lanes, N_B/N_A to tile multiples.
+    # Pad D to lanes, N_B/N_A to tile multiples.  Pads and casts are
+    # conditional: when the caller's tables are already tile-shaped and
+    # in the match dtype (the lean-brute oracle pre-shapes its bf16
+    # tables exactly so), no working copy is made — at 4096^2 an
+    # unconditional pad+cast would co-host ~8.6 GB of dead copies next
+    # to the resident tables.
     d_pad = (-d_feat) % 128
     q_pad = (-n) % tq
     a_pad = (-n_a) % ta
-    fb = jnp.pad(f_b_flat, ((0, q_pad), (0, d_pad))).astype(match_dtype)
-    fa = jnp.pad(f_a_flat, ((0, a_pad), (0, d_pad))).astype(match_dtype)
+    fb = f_b_flat
+    if q_pad or d_pad:
+        fb = jnp.pad(fb, ((0, q_pad), (0, d_pad)))
+    fb = fb.astype(match_dtype)
+    fa = f_a_flat
+    if a_pad or d_pad:
+        fa = jnp.pad(fa, ((0, a_pad), (0, d_pad)))
+    fa = fa.astype(match_dtype)
     # ||a||^2 in f32; +inf on padded rows so they never win the argmin.
     # Chunked: one whole-table f32 upcast of a giant A side (the 4096^2
     # probe's (16.8M, 128) bf16 table) peaks at 2 x 8.6 GB of temps.
@@ -207,9 +218,20 @@ def exact_nn_pallas(
     q_tiles = fb.shape[0] // tq
     max_steps = max(1, _MAX_TILE_ELEMS // (tq * ta))
     chunk_tiles = max(1, min(q_tiles, max_steps // grid_a))
+    # Prefer the largest clean divisor within 2x of the budgeted chunk:
+    # an uneven split pads fb up to a chunk multiple, and at giant-N
+    # (the 4096^2 oracle: 16.8M rows) that pad is a 4.3 GB working
+    # copy next to the resident tables for nothing.  Divisor chunks are
+    # strictly smaller, so the per-execution budget still holds.
+    for ct in range(chunk_tiles, max(chunk_tiles // 2, 1) - 1, -1):
+        if q_tiles % ct == 0:
+            chunk_tiles = ct
+            break
     n_chunks = -(-q_tiles // chunk_tiles)
     chunk_rows = chunk_tiles * tq
-    fb = jnp.pad(fb, ((0, n_chunks * chunk_rows - fb.shape[0]), (0, 0)))
+    tail = n_chunks * chunk_rows - fb.shape[0]
+    if tail:
+        fb = jnp.pad(fb, ((0, tail), (0, 0)))
 
     if n_chunks == 1:
         idx = _nn_chunk_call(fb, fa, a_sq, tq, ta, interpret)[0]

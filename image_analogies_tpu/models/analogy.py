@@ -168,6 +168,109 @@ def lean_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
     return (py, px), dist, bp
 
 
+def _pad_lanes128(tab: jnp.ndarray) -> jnp.ndarray:
+    """Zero-pad a (N, D) table's trailing dim to a 128-lane multiple.
+
+    Physically free (the T(sublane, 128) HBM layout pads lanes anyway)
+    and metric-free (zero columns on both sides add zero to every
+    distance), but it lets `exact_nn_pallas` skip its own pad+cast
+    working copies — at 4096^2 those would co-host ~8.6 GB of dead
+    bf16 next to the resident tables."""
+    pad = (-tab.shape[-1]) % 128
+    if pad:
+        tab = jnp.pad(tab, ((0, 0), (0, pad)))
+    return tab
+
+
+def lean_brute_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
+                       src_b, flt_b, src_b_c, flt_b_c, f_a_tab, copy_a,
+                       nnf, key):
+    """One exact-NN EM step on lean bf16 tables (plane-pair field).
+
+    The brute matcher is the PSNR oracle (SURVEY.md §6), and round 3/4
+    capped its full-synthesis runs at 2048^2: the standard path's two
+    lane-padded f32 tables are 17.2 GB at 4096^2 against 16 GB of HBM.
+    This step is the scale-robust oracle: both tables assembled
+    chunk-wise into bf16 (4.3 GB each at 4096^2) and searched EXACTLY
+    with the streaming kernel — exact argmin over bf16-quantized
+    features with f32 accumulation and f32 winner re-rank, the same
+    metric the lean patchmatch path matches in at these sizes.  Driver
+    selection: `_feature_table_bytes > cfg.brute_lean_bytes`; such
+    levels also run unfused (`_SAFE_EXEC_DIST_ELEMS`), so each query
+    chunk of the search is its own device execution and no execution
+    outlives the worker's kill boundary (kernels/nn_brute.py
+    _MAX_TILE_ELEMS).
+
+    Giant-A tile choice: the kernel's A-side traffic is
+    (N_B/tq) * |A|, so calls against a >= 1M-row database use the
+    largest compiling query tile, (tq=2048, ta=256) — the measured
+    scoped-VMEM ceiling (see exact_nn_pallas; same tiles as the
+    recorded 2048^2 oracle, SCALE_r04).
+    """
+    from ..kernels import resolve_pallas
+    from ..kernels.nn_brute import exact_nn_pallas
+
+    h, w = src_b.shape[:2]
+    ha, wa = copy_a.shape[:2]
+    f_b_tab = _pad_lanes128(assemble_features_lean(
+        src_b,
+        flt_b,
+        cfg,
+        src_b_c if has_coarse else None,
+        flt_b_c if has_coarse else None,
+    ))
+    interpret = resolve_pallas(cfg)
+    if interpret is None:
+        from .brute import exact_nn
+
+        idx, dist = exact_nn(
+            f_b_tab,
+            f_a_tab,
+            chunk=min(cfg.brute_chunk, h * w),
+            match_dtype=_LEAN_TABLE_DTYPE,
+        )
+    else:
+        tiles = (
+            dict(tq=2048, ta=256)
+            if f_a_tab.shape[0] >= (1 << 20)
+            else {}
+        )
+        idx, dist = exact_nn_pallas(
+            f_b_tab,
+            f_a_tab,
+            match_dtype=_LEAN_TABLE_DTYPE,
+            interpret=interpret,
+            **tiles,
+        )
+    py = (idx // wa).reshape(h, w)
+    px = (idx % wa).reshape(h, w)
+    dist = dist.reshape(h, w)
+    if cfg.kappa > 0.0:
+        # The registered 'brute' matcher is CoherenceWrapper(brute)
+        # (models/coherence.py): kappa>0 runs Ashikhmin adoption
+        # sweeps after the exact search.  The lean oracle keeps the
+        # same semantics on the plane-pair field — same rule, same
+        # sweep count, distances in the lean bf16 metric the exact
+        # search itself re-ranked in (candidate_dist_lean: bf16 rows,
+        # f32 accumulation).
+        from .coherence import coherence_sweeps_lean
+        from .matcher import candidate_dist_lean
+        from .patchmatch import kappa_factor
+
+        f_b_tab_c = f_b_tab  # closure binding for the dist_fn
+        py, px, dist = coherence_sweeps_lean(
+            py, px, dist, ha=ha, wa=wa,
+            factor=kappa_factor(cfg.kappa, level),
+            sweeps=2,
+            dist_fn=lambda i: candidate_dist_lean(f_b_tab_c, f_a_tab, i),
+        )
+        idx = (py * wa + px).reshape(-1)
+    flat = copy_a.reshape(ha * wa, -1)
+    out = jnp.take(flat, idx, axis=0).reshape(h, w, -1)
+    bp = out[..., 0] if copy_a.ndim == 2 else out
+    return (py, px), dist, bp
+
+
 def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
                  lean: bool = False, polish_iters=None):
     """One EM step at one pyramid level: features -> match -> render.
@@ -192,6 +295,17 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
     matcher = get_matcher(cfg.matcher)
 
     if lean:
+        if cfg.matcher == "brute":
+            def em_step_lean_brute(src_b, flt_b, src_b_c, flt_b_c, f_a,
+                                   copy_a, nnf, key, proj=None,
+                                   a_planes=None):
+                return lean_brute_em_step(
+                    cfg, level, has_coarse,
+                    src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf, key,
+                )
+
+            return em_step_lean_brute
+
         from ..kernels import resolve_pallas
 
         def em_step_lean(src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf,
@@ -425,6 +539,11 @@ def _level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
             f_a = assemble_features_lean(
                 src_a_l, flt_a_l, cfg, src_a_c, flt_a_c
             )
+            if cfg.matcher == "brute":
+                # Rebind so the unpadded original dies before the EM
+                # steps run (this path executes eagerly at oracle
+                # sizes — fuse=False via _SAFE_EXEC_DIST_ELEMS).
+                f_a = _pad_lanes128(f_a)
             proj = None
         else:
             f_a = assemble_features(src_a_l, flt_a_l, cfg, src_a_c, flt_a_c)
@@ -705,19 +824,34 @@ def create_image_analogy(
 
         # Lean levels never materialize the (N, D) feature tables — the
         # decision must precede assembly (assembly is what OOMs).
-        lean = (
-            _kernel_eligible(
-                cfg, pyr_src_a[level], pyr_flt_a[level], has_coarse, h, w
+        # Brute keeps the exact f32 metric as long as the tables fit
+        # (it is the oracle: cfg.brute_lean_bytes, not the tighter
+        # kernel-path budget) and goes lean-brute past that —
+        # bf16-table exact search, lean_brute_em_step.
+        if cfg.matcher == "brute":
+            lean = (
+                _feature_table_bytes(h, w, ha, wa) > cfg.brute_lean_bytes
             )
-            and _feature_table_bytes(h, w, ha, wa) > cfg.feature_bytes_budget
-        )
+        else:
+            lean = (
+                _kernel_eligible(
+                    cfg, pyr_src_a[level], pyr_flt_a[level], has_coarse,
+                    h, w,
+                )
+                and _feature_table_bytes(h, w, ha, wa)
+                > cfg.feature_bytes_budget
+            )
         if lean and cfg.pca_dims:
             import logging
 
+            knob = (
+                "brute_lean_bytes" if cfg.matcher == "brute"
+                else "feature_bytes_budget"
+            )
             logging.getLogger("image_analogies_tpu").warning(
-                "level %d exceeds feature_bytes_budget: lean path "
-                "matches in full-D bf16 space, pca_dims=%s is not "
-                "applied at this level", level, cfg.pca_dims,
+                "level %d exceeds %s: lean path matches in full-D bf16 "
+                "space, pca_dims=%s is not applied at this level",
+                level, knob, cfg.pca_dims,
             )
 
         prev_kind = (

@@ -63,9 +63,23 @@ def exact_nn(
         return jnp.argmin(d, axis=-1)
 
     idx = jax.lax.map(one_chunk, fb_chunks).reshape(-1)[:n]
-    rows = jnp.take(f_a_flat, idx, axis=0)
-    diff = f_b_flat - rows
-    dist = jnp.sum(diff * diff, axis=-1)
+    # Winner re-rank in f32 regardless of table dtype: with bf16 lean
+    # tables (lean_brute_em_step) a same-dtype subtract/sum would
+    # accumulate the distance itself in bf16, while the Pallas twin
+    # (nn_brute.exact_nn_pallas) re-ranks in f32 — the two backends
+    # must stay interchangeable oracles.  Chunked like the Pallas
+    # twin's re-rank so the gathered-rows + upcast temps peak at
+    # ~512 MB instead of 2x a full-table f32 copy (the lean-brute
+    # fallback hands giant bf16 tables through here).
+    d_feat = f_b_flat.shape[1]
+    rerank_rows = max(1, (256 << 20) // max(1, d_feat * 4))
+    dists = []
+    for c in range(0, n, rerank_rows):
+        sl = idx[c : c + rerank_rows]
+        rows = jnp.take(f_a_flat, sl, axis=0).astype(jnp.float32)
+        diff = f_b_flat[c : c + rerank_rows].astype(jnp.float32) - rows
+        dists.append(jnp.sum(diff * diff, axis=-1))
+    dist = dists[0] if len(dists) == 1 else jnp.concatenate(dists, axis=0)
     return idx, dist
 
 

@@ -1,0 +1,147 @@
+"""Full-synthesis exact-NN oracle PSNR at sizes past the f32-table wall.
+
+Round 4 measured full-oracle PSNR up to 2048^2 (SCALE_r04) and bounded
+4096^2 by a calibrated probe: the standard brute path's two lane-padded
+f32 tables are 17.2 GB at 4096^2 against 16 GB of HBM.  The lean-brute
+path (models/analogy.lean_brute_em_step) removes that wall — exact
+search over chunk-assembled bf16 tables, eager chunked executions — so
+the 4096^2 row can carry a measured full-oracle PSNR like the smaller
+rows.
+
+Modes:
+  python tools/full_oracle.py validate   # 1024^2: lean-brute oracle vs
+                                         # the recorded f32 oracle —
+                                         # quantifies the bf16-table
+                                         # metric swap (~minutes)
+  python tools/full_oracle.py 4096       # the real run (~4 h): pm
+                                         # synthesis + lean-brute full
+                                         # oracle + PSNR; one JSON line
+
+State is checkpointed to tools/_oracle_out/ (pm output, oracle output)
+so a tunnel hiccup doesn't forfeit completed phases.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax.numpy as jnp
+
+from image_analogies_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+from image_analogies_tpu import SynthConfig, create_image_analogy, psnr
+from image_analogies_tpu.utils.examples import super_resolution
+from image_analogies_tpu.utils.kernelbench import sync as _sync
+from image_analogies_tpu.utils.progress import ProgressWriter
+
+_OUT = os.path.join(os.path.dirname(__file__), "_oracle_out")
+
+
+def _cfg(size: int, matcher: str, ckpt: str = None, **kw) -> SynthConfig:
+    # Same schedule as the SCALE_r04 rows.
+    return SynthConfig(
+        levels=6 if size > 1024 else 5, matcher=matcher, em_iters=2,
+        save_level_artifacts=ckpt,
+        **kw,
+    )
+
+
+def _cached_run(name: str, size: int, matcher: str, **kw):
+    os.makedirs(_OUT, exist_ok=True)
+    path = os.path.join(_OUT, f"{name}.npy")
+    meta = os.path.join(_OUT, f"{name}.json")
+    if os.path.exists(path) and os.path.exists(meta):
+        print(f"# {name}: cached", flush=True)
+        return np.load(path), json.load(open(meta))
+    a, ap, b = super_resolution(size)
+    a, ap, b = (jnp.asarray(x, jnp.float32) for x in (a, ap, b))
+    for x in (a, ap, b):
+        _sync(x)
+    prog = ProgressWriter(os.path.join(_OUT, f"{name}.progress.jsonl"))
+    # Per-level checkpoints: a tunnel/worker hiccup hours into the
+    # 4096^2 oracle resumes from the finest completed level instead of
+    # restarting (level 0 dominates, but levels 5..1 are ~20 min).
+    ckpt = os.path.join(_OUT, f"{name}.ckpt")
+    resume = ckpt if os.path.isdir(ckpt) else None
+    t0 = time.perf_counter()
+    if matcher == "brute" and size >= 2048:
+        # Giant-A exact searches want the largest compiling query tile
+        # (A-restream traffic is (N_B/tq) * |A|) — same override the
+        # recorded 2048^2 oracle used (tools/scale_bench.py _NN_TILES).
+        # The lean-brute levels already pass these tiles themselves;
+        # this covers the mid-pyramid standard-path brute levels.
+        from unittest import mock
+
+        import image_analogies_tpu.kernels.nn_brute as nb
+
+        orig = nb.exact_nn_pallas
+
+        def big_tiles(fb, fa, **kw2):
+            kw2.setdefault("tq", 2048)
+            kw2.setdefault("ta", 256)
+            return orig(fb, fa, **kw2)
+
+        with mock.patch.object(nb, "exact_nn_pallas", big_tiles):
+            out = create_image_analogy(
+                a, ap, b, _cfg(size, matcher, ckpt, **kw),
+                progress=prog, resume_from=resume,
+            )
+            _sync(out)
+    else:
+        out = create_image_analogy(
+            a, ap, b, _cfg(size, matcher, ckpt, **kw),
+            progress=prog, resume_from=resume,
+        )
+        _sync(out)
+    wall = round(time.perf_counter() - t0, 2)
+    out = np.asarray(out)
+    np.save(path, out)
+    info = {"wall_s": wall, "matcher": matcher, "size": size, **kw}
+    json.dump(info, open(meta, "w"))
+    print(f"# {name}: wall {wall}s", flush=True)
+    return out, info
+
+
+def validate():
+    """1024^2: how much does the bf16-table oracle move the metric?"""
+    pm, _ = _cached_run("pm_1024", 1024, "patchmatch", pm_iters=6)
+    oracle_f32, inf_f32 = _cached_run("oracle_f32_1024", 1024, "brute")
+    oracle_lean, inf_lean = _cached_run(
+        "oracle_lean_1024", 1024, "brute", brute_lean_bytes=1,
+    )
+    print(json.dumps({
+        "mode": "validate-1024",
+        "psnr_pm_vs_f32_oracle_db": round(psnr(pm, oracle_f32), 2),
+        "psnr_pm_vs_lean_oracle_db": round(psnr(pm, oracle_lean), 2),
+        "psnr_lean_vs_f32_oracle_db": round(
+            psnr(oracle_lean, oracle_f32), 2
+        ),
+        "oracle_f32_wall_s": inf_f32["wall_s"],
+        "oracle_lean_wall_s": inf_lean["wall_s"],
+    }), flush=True)
+
+
+def full(size: int):
+    pm, pm_info = _cached_run(f"pm_{size}", size, "patchmatch", pm_iters=6)
+    oracle, o_info = _cached_run(f"oracle_lean_{size}", size, "brute")
+    print(json.dumps({
+        "size": size,
+        "oracle": "lean-brute (exact NN over bf16 lean tables)",
+        "psnr_vs_full_oracle_db": round(psnr(pm, oracle), 2),
+        "oracle_wall_s": o_info["wall_s"],
+        "pm_wall_s": pm_info["wall_s"],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    arg = sys.argv[1] if len(sys.argv) > 1 else "validate"
+    if arg == "validate":
+        validate()
+    else:
+        full(int(arg))

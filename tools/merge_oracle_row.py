@@ -1,0 +1,63 @@
+"""Merge the 4096^2 full-oracle result (tools/full_oracle.py) into the
+SCALE_r{N}.json artifact and refresh its comment.
+
+Usage: python tools/merge_oracle_row.py <full_oracle_json_line> <scale.json>
+where <full_oracle_json_line> is a file holding the one-line JSON that
+`python tools/full_oracle.py 4096` printed.
+"""
+
+import json
+import sys
+
+COMMENT = (
+    "Large-image scaling rows, tools/scale_bench.py, TPU v5e-1, "
+    "2026-07-31, round-4 HBM-streaming kernel (no banding at any size, "
+    "full channel set everywhere).  Quality: EVERY row carries PSNR vs "
+    "a FULL-SYNTHESIS exact-NN oracle (brute synthesis at every "
+    "level/EM step), plus a stratified-jittered exact probe (1M pixels "
+    "or half the image, bootstrap 95% CI on the achieved/exact "
+    "mean-distance ratio, exact-match fraction) in the lean bf16 "
+    "metric at the EM fixed point.  <=2048^2 oracles run the standard "
+    "f32-table brute path (crash-safety: kernels/nn_brute.py "
+    "_MAX_TILE_ELEMS + models/analogy.py _SAFE_EXEC_DIST_ELEMS).  The "
+    "4096^2 oracle (tools/full_oracle.py) runs the round-4 LEAN-BRUTE "
+    "path (models/analogy.lean_brute_em_step, cfg.brute_lean_bytes): "
+    "exact search over the same chunk-assembled bf16 tables the "
+    "production path matches in — the f32-table oracle cannot exist "
+    "at 4096^2 (two lane-padded tables = 17.2 GB vs 16 GB HBM).  "
+    "Cross-validation at 1024^2 (both oracles on one run): PSNR vs "
+    "f32 oracle 35.69 dB, vs bf16-table oracle 37.81 dB, oracles "
+    "agreeing at 36.71 dB — the bf16-table oracle is the "
+    "matched-metric one at lean sizes and its PSNR reads ~2 dB "
+    "higher; the 4096^2 row reports it with the oracle named in the "
+    "row.  Probe calibration anchors: 1.496 ~ 35.69 dB, "
+    "1.597 ~ 35.24 dB (f32-oracle rows)."
+)
+
+
+def main():
+    line_file, scale_file = sys.argv[1], sys.argv[2]
+    result = None
+    for line in open(line_file):
+        line = line.strip()
+        if line.startswith("{"):
+            result = json.loads(line)
+    assert result and "psnr_vs_full_oracle_db" in result, result
+    art = json.load(open(scale_file))
+    for row in art["rows"]:
+        if row["size"] == result["size"]:
+            row["psnr_vs_full_oracle_db"] = result["psnr_vs_full_oracle_db"]
+            row["oracle_wall_s"] = result["oracle_wall_s"]
+            row["oracle_kind"] = result["oracle"]
+            break
+    else:
+        raise SystemExit(f"no row for size {result['size']}")
+    art["comment"] = COMMENT
+    with open(scale_file, "w") as f:
+        json.dump(art, f, indent=1)
+        f.write("\n")
+    print(f"merged {result['size']} oracle row into {scale_file}")
+
+
+if __name__ == "__main__":
+    main()
