@@ -49,6 +49,12 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
         "above it take the lean path (bf16 chunked tables, plane-pair "
         "field).  Default: config default (2 GiB)",
     )
+    p.add_argument(
+        "--brute-lean-bytes", type=int, default=None,
+        help="f32 feature-table bytes above which BRUTE levels run the "
+        "lean-brute exact oracle (bf16 tables, chunked eager "
+        "executions).  Default: config default (10 GiB)",
+    )
     p.add_argument("--device", default=None, choices=["cpu", "tpu"])
     p.add_argument(
         "--pallas-mode",
@@ -78,6 +84,8 @@ def _config_from(args) -> "SynthConfig":
         if args.feature_bytes_budget is None
         else {"feature_bytes_budget": args.feature_bytes_budget}
     )
+    if args.brute_lean_bytes is not None:
+        budget["brute_lean_bytes"] = args.brute_lean_bytes
     return SynthConfig(
         **budget,
         levels=args.levels,
@@ -127,13 +135,34 @@ def cmd_synth(args) -> int:
     # Per-level progress costs one host sync per level; only pay it when
     # the user asked for a progress file (north-star: minimal host syncs).
     level_progress = progress if args.progress else None
+    if getattr(args, "bands", 1) > 1 and not args.spatial:
+        raise SystemExit(
+            "--bands requires --spatial (it names the A-band axis of "
+            "the 2-D bands x slabs mesh); for A-side banding alone use "
+            "--sharded-a"
+        )
     with device_trace(args.profile):
         if args.spatial:
+            import jax
+
             from .parallel.mesh import make_mesh
             from .parallel.spatial import synthesize_spatial
 
+            if args.bands > 1:
+                n_dev = args.n_devices or len(jax.devices())
+                if n_dev % args.bands:
+                    raise SystemExit(
+                        f"--bands {args.bands} must divide the device "
+                        f"count ({n_dev})"
+                    )
+                mesh = make_mesh(
+                    n_dev, axis_names=("bands", "slabs"),
+                    shape=(args.bands, n_dev // args.bands),
+                )
+            else:
+                mesh = make_mesh(args.n_devices)
             bp = synthesize_spatial(
-                a, ap, b, cfg, make_mesh(args.n_devices),
+                a, ap, b, cfg, mesh,
                 progress=level_progress,
                 resume_from=args.resume_from,
             )
@@ -261,6 +290,12 @@ def main(argv=None) -> int:
         "to single-device synthesis at lean levels",
     )
     p.add_argument("--n-devices", type=int, default=None)
+    p.add_argument(
+        "--bands", type=int, default=1,
+        help="with --spatial: additionally band-shard the A side over "
+        "this many mesh rows (2-D bands x slabs mesh — style pair AND "
+        "target beyond one chip).  Must divide the device count",
+    )
     _add_synth_flags(p)
     p.set_defaults(fn=cmd_synth)
 

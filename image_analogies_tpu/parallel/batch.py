@@ -40,20 +40,20 @@ from .mesh import BATCH_AXIS, batch_sharding, make_mesh, replicated
 
 
 def _batch_step_fn(cfg: SynthConfig, level: int, has_coarse: bool, mesh_key,
-                   polish_iters=None):
+                   polish_iters=None, axis: str = BATCH_AXIS):
     # save_level_artifacts is not step-shaping (it only names a host-side
     # checkpoint dir); stripping it keeps one compiled step per
     # (cfg, level) even when chunked runs vary the per-chunk subdir.
     cfg = dataclasses.replace(cfg, save_level_artifacts=None)
     return _batch_step_fn_cached(
-        cfg, level, has_coarse, mesh_key, polish_iters
+        cfg, level, has_coarse, mesh_key, polish_iters, axis
     )
 
 
 @functools.lru_cache(maxsize=64)
 def _batch_step_fn_cached(
     cfg: SynthConfig, level: int, has_coarse: bool, mesh_key,
-    polish_iters=None,
+    polish_iters=None, axis: str = BATCH_AXIS,
 ):
     mesh = _MESHES[mesh_key]
     step = make_em_step(cfg, level, has_coarse, polish_iters=polish_iters)
@@ -61,8 +61,10 @@ def _batch_step_fn_cached(
     # basis, and the kernel's A planes are shared across frames.  The
     # Pallas tile kernel batches under vmap (the frame axis becomes a
     # leading grid dimension), so the kernel path works per shard.
+    # `axis` names the mesh axis the frame/slab stack shards over
+    # ('slabs' on the 2-D bands x slabs spatial runner).
     in_axes = (0, 0, 0, 0, None, None, 0, 0, None, None)
-    shard = batch_sharding(mesh)
+    shard = batch_sharding(mesh, axis)
     repl = replicated(mesh)
     shardings = (
         shard, shard, shard, shard, repl, repl, shard, shard, repl, repl,
@@ -76,27 +78,27 @@ def _batch_step_fn_cached(
 
 
 def _lean_step_fn(cfg: SynthConfig, level: int, has_coarse: bool, mesh_key,
-                  polish_iters=None):
+                  polish_iters=None, axis: str = BATCH_AXIS):
     """Vmapped LEAN em step (plane-pair NN field, bf16 chunked tables)
     for the sharded runners — same sharding layout as `_batch_step_fn`
     but with the field carried as a (py, px) tuple per slab/frame."""
     cfg = dataclasses.replace(cfg, save_level_artifacts=None)
     return _lean_step_fn_cached(
-        cfg, level, has_coarse, mesh_key, polish_iters
+        cfg, level, has_coarse, mesh_key, polish_iters, axis
     )
 
 
 @functools.lru_cache(maxsize=64)
 def _lean_step_fn_cached(
     cfg: SynthConfig, level: int, has_coarse: bool, mesh_key,
-    polish_iters=None,
+    polish_iters=None, axis: str = BATCH_AXIS,
 ):
     mesh = _MESHES[mesh_key]
     step = make_em_step(
         cfg, level, has_coarse, lean=True, polish_iters=polish_iters
     )
     in_axes = (0, 0, 0, 0, None, None, (0, 0), 0, None, None)
-    shard = batch_sharding(mesh)
+    shard = batch_sharding(mesh, axis)
     repl = replicated(mesh)
     shardings = (
         shard, shard, shard, shard, repl, repl, (shard, shard), shard,

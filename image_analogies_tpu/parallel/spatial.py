@@ -69,6 +69,7 @@ from ..models.analogy import (
     _prologue_fn,
     _save_level,
     assemble_features_lean,
+    lean_em_step,
     random_init_planes,
     resume_prologue,
     upsample_nnf,
@@ -118,7 +119,8 @@ def _merge_cores(slabs: jnp.ndarray, halo: int) -> jnp.ndarray:
 
 
 @functools.lru_cache(maxsize=32)
-def _reslab_fn(halo: int, n_slabs: int, n_arrays: int, mesh_key):
+def _reslab_fn(halo: int, n_slabs: int, n_arrays: int, mesh_key,
+               axis: str = "batch"):
     """Jitted stitch-cores + re-split-with-fresh-halos over `n_arrays`
     slab-stacked arrays, slab-sharded in and out.
 
@@ -128,10 +130,12 @@ def _reslab_fn(halo: int, n_slabs: int, n_arrays: int, mesh_key):
     instead of re-materializing the global arrays on the host every
     iteration (the module docstring's halo-exchange claim is made true
     here).  Array count is generic: the standard path re-halos
-    (stacked-nnf, bp), the lean path (py, px, bp)."""
+    (stacked-nnf, bp), the lean path (py, px, bp).  `axis` names the
+    mesh axis the slab stack shards over ('slabs' on the 2-D
+    bands x slabs runner, parallel/sharded_2d.py)."""
     from .batch import _MESHES
 
-    shard = batch_sharding(_MESHES[mesh_key])
+    shard = batch_sharding(_MESHES[mesh_key], axis)
 
     def reslab(*slabs):
         return tuple(
@@ -146,6 +150,69 @@ def _reslab_fn(halo: int, n_slabs: int, n_arrays: int, mesh_key):
     )
 
 
+_BANDS_AXIS = "bands"
+_SLABS_AXIS = "slabs"
+
+
+@functools.lru_cache(maxsize=32)
+def _banded_lean_step_fn(cfg: SynthConfig, level: int, has_coarse: bool,
+                         mesh_key, interpret: bool, polish_iters=None):
+    """One lean EM iteration on the 2-D bands x slabs mesh: each device
+    owns (A band, B' slab) and runs `lean_em_step` — the SAME body the
+    single-device lean path, the 1-D spatial runner, and the sharded-A
+    runner execute — with the three band hooks from
+    parallel/sharded_a.py: its own band's kernel planes/bounds, the
+    masked local-shard gather merged by `pmin` over the bands axis, and
+    the cross-band argmin merge after every pm iteration.  The slabs
+    axis stays independent (each slab column synthesizes its rows); the
+    bands axis carries the A-side collectives.  Post-merge state is
+    replicated across bands by construction (every band sees identical
+    merged distances and the same slab key), so the slab-sharded
+    out_specs are exact.
+    """
+    from .batch import _MESHES
+    from .sharded_a import _band_merge, _sharded_dist
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _MESHES[mesh_key]
+
+    def call(f_a_tab, a_stacked, bounds_stacked, src_b_s, flt_s,
+             src_b_c_s, flt_c_s, copy_a, py_s, px_s, keys):
+        def body(f_a_shard, a_band, band, src_b, flt_b, src_b_c, flt_b_c,
+                 copy_a, py, px, key):
+            a_band, band = a_band[0], band[0]
+            src_b, flt_b = src_b[0], flt_b[0]
+            src_b_c, flt_b_c = src_b_c[0], flt_b_c[0]
+            py, px, key = py[0], px[0], key[0]
+            wa = copy_a.shape[1]
+            row_lo_flat = band[0] * wa
+            (py, px), dist, bp = lean_em_step(
+                cfg, level, has_coarse, polish_iters,
+                src_b, flt_b, src_b_c, flt_b_c,
+                f_a_shard, copy_a, (py, px), key,
+                (a_band,), interpret=interpret,
+                dist_fn=lambda f_b_tab: functools.partial(
+                    _sharded_dist, f_b_tab, f_a_shard, row_lo_flat
+                ),
+                bounds=(band,),
+                sweep_merge=_band_merge,
+            )
+            return py[None], px[None], dist[None], bp[None]
+
+        B, S = P(_BANDS_AXIS), P(_SLABS_AXIS)
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(B, B, B, S, S, S, S, P(), S, S, S),
+            out_specs=(S, S, S, S),
+            # pallas_call outputs carry no varying-mesh-axes info.
+            check_vma=False,
+        )(f_a_tab, a_stacked, bounds_stacked, src_b_s, flt_s,
+          src_b_c_s, flt_c_s, copy_a, py_s, px_s, keys)
+
+    return jax.jit(call)
+
+
 def synthesize_spatial(
     a,
     ap,
@@ -157,9 +224,22 @@ def synthesize_spatial(
 ):
     """B' for one (large) `b`, rows sharded over the mesh's batch axis.
 
-    `b`'s height is padded (edge rows) to n_devices * 2^(levels-1)
+    `b`'s height is padded (edge rows) to n_slabs * 2^(levels-1)
     granularity so every level splits into equal, parity-aligned slabs;
     the pad is cropped from the result.
+
+    **2-D bands x slabs meshes** (axis names ("bands", "slabs"), e.g.
+    `make_mesh(axis_names=("bands", "slabs"), shape=(2, 4))`): B' rows
+    shard over the slabs axis as usual, and on lean levels the A-side
+    lean table + kernel planes additionally shard into ownership bands
+    over the bands axis (parallel/sharded_a.py's data path) — for style
+    pairs AND targets that both outgrow one chip.  Per-device residency
+    is then slab-share-of-B' + band-share-of-A.  With one band the 2-D
+    path is bit-identical to the 1-D spatial runner; with several it
+    keeps bit-identity at kappa=0 by the band-ownership contract
+    (kappa>0: same accept family, marginally weaker cross-band
+    coherence bias — sharded_a.py 'Equivalence').  Sub-lean levels keep
+    the A side replicated (their tables are 4^-l of the finest's).
 
     `resume_from`: per-level checkpoint dir (cfg.save_level_artifacts of
     a prior run) — restarts from the finest completed level like
@@ -169,7 +249,19 @@ def synthesize_spatial(
     cfg = cfg or SynthConfig()
     mesh = mesh or make_mesh()
     token = _mesh_token(mesh)
-    n_slabs = int(mesh.devices.size)
+    if _BANDS_AXIS in mesh.axis_names:
+        if mesh.axis_names != (_BANDS_AXIS, _SLABS_AXIS):
+            raise ValueError(
+                "2-D spatial mesh must have axis names "
+                f"('{_BANDS_AXIS}', '{_SLABS_AXIS}'), got {mesh.axis_names}"
+            )
+        n_bands = int(mesh.shape[_BANDS_AXIS])
+        slab_axis = _SLABS_AXIS
+        n_slabs = int(mesh.shape[_SLABS_AXIS])
+    else:
+        n_bands = 1
+        slab_axis = mesh.axis_names[0]
+        n_slabs = int(mesh.devices.size)
     halo = slab_halo(cfg)
 
     a = jnp.asarray(a, jnp.float32)
@@ -239,6 +331,13 @@ def synthesize_spatial(
             and _feature_table_bytes(h, w, ha, wa) > cfg.feature_bytes_budget
         )
 
+        banded = lean and n_bands > 1
+        a_stacked = bounds_stacked = None
+        if banded and ha % n_bands:
+            raise ValueError(
+                f"2-D spatial level {level}: A rows ({ha}) must split "
+                f"evenly over {n_bands} bands"
+            )
         if lean:
             f_a = assemble_features_lean(
                 f_a_src,
@@ -248,6 +347,39 @@ def synthesize_spatial(
                 pyr_flt_a[level + 1] if has_coarse else None,
             )
             proj = None
+            if banded:
+                # Band-sharded A side (parallel/sharded_a.py data
+                # path): the lean table's rows and the kernel planes
+                # split into per-device ownership bands over the bands
+                # axis; from here on each device touches only its
+                # shard.  (Assembly itself is unsharded — the same v1
+                # scope note as sharded_a.py.)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                from ..kernels.patchmatch_tile import (
+                    band_bounds,
+                    prepare_a_planes,
+                )
+                from ..models.analogy import _level_plan
+
+                band_shard = NamedSharding(mesh, P(_BANDS_AXIS))
+                f_a = jax.device_put(f_a, band_shard)
+                plan = _level_plan(
+                    cfg, f_a_src, pyr_flt_a[level], has_coarse,
+                    *slab_shape,
+                )
+                specs, use_coarse, _ = plan
+                bands_p = prepare_a_planes(
+                    f_a_src,
+                    pyr_flt_a[level],
+                    pyr_src_a[level + 1] if use_coarse else None,
+                    pyr_flt_a[level + 1] if use_coarse else None,
+                    specs,
+                    n_bands=n_bands,
+                )
+                a_stacked = jax.device_put(jnp.stack(bands_p), band_shard)
+                bounds_stacked = jax.device_put(
+                    jnp.stack(band_bounds(ha, n_bands)), band_shard
+                )
         else:
             f_a = assemble_features(
                 f_a_src,
@@ -260,7 +392,10 @@ def synthesize_spatial(
 
             f_a, proj = fit_and_project(f_a, cfg.pca_dims)
 
-        a_planes = _maybe_a_planes(
+        # Banded levels build their per-band planes above (a_stacked) —
+        # the full single-band plane set would re-materialize exactly
+        # the multi-GB A-side resident that banding splits.
+        a_planes = None if banded else _maybe_a_planes(
             cfg, pyr_src_a, pyr_flt_a, level, has_coarse, slab_shape
         )
 
@@ -293,7 +428,7 @@ def synthesize_spatial(
         # Level-invariant slab views of the match-side images (the
         # coarse B' estimate is frozen for the whole level, so its slab
         # split is hoisted with them), placed on the mesh once per level.
-        shard = batch_sharding(mesh)
+        shard = batch_sharding(mesh, slab_axis)
         slab_src_b = jax.device_put(
             _split_slabs(pyr_src_b[level], n_slabs, halo), shard
         )
@@ -313,13 +448,39 @@ def synthesize_spatial(
             else None
         )
 
-        mk_step = (  # noqa: E731
-            (lambda p: _spatial_lean_step_fn(cfg, level, has_coarse, token,
-                                             polish_iters=p))
-            if lean
-            else (lambda p: _spatial_step_fn(cfg, level, has_coarse, token,
-                                             polish_iters=p))
-        )
+        if banded:
+            from ..kernels import resolve_pallas
+            from ..models.analogy import _strip_noncompute
+
+            interpret = bool(resolve_pallas(cfg))
+
+            def mk_step(p, _as=a_stacked, _bs=bounds_stacked):
+                fn = _banded_lean_step_fn(
+                    _strip_noncompute(cfg), level, has_coarse, token,
+                    interpret, p,
+                )
+
+                def step(slab_src_b, slab_flt, slab_src_b_c, slab_flt_c,
+                         f_a_, copy_a, slab_nnf, slab_keys, proj_,
+                         a_planes_):
+                    py_s, px_s, dist_s, bp_s = fn(
+                        f_a_, _as, _bs, slab_src_b, slab_flt,
+                        slab_src_b_c, slab_flt_c, copy_a,
+                        slab_nnf[0], slab_nnf[1], slab_keys,
+                    )
+                    return (py_s, px_s), dist_s, bp_s
+
+                return step
+        else:
+            mk_step = (  # noqa: E731
+                (lambda p: _spatial_lean_step_fn(
+                    cfg, level, has_coarse, token, polish_iters=p,
+                    axis=slab_axis))
+                if lean
+                else (lambda p: _spatial_step_fn(
+                    cfg, level, has_coarse, token, polish_iters=p,
+                    axis=slab_axis))
+            )
         step_final = mk_step(None)
         # Non-final EM iterations skip the gather-bound per-pixel polish
         # (config.py pm_polish_final_only), mirroring the single-image
@@ -365,12 +526,12 @@ def synthesize_spatial(
             if em < cfg.em_iters - 1:
                 if lean:
                     py_s, px_s, slab_flt = _reslab_fn(
-                        halo, n_slabs, 3, token
+                        halo, n_slabs, 3, token, slab_axis
                     )(nnf_s[0], nnf_s[1], bp_s)
                     slab_nnf = (py_s, px_s)
                 else:
                     slab_nnf, slab_flt = _reslab_fn(
-                        halo, n_slabs, 2, token
+                        halo, n_slabs, 2, token, slab_axis
                     )(nnf_s, bp_s)
         if lean:
             nnf = (

@@ -492,3 +492,86 @@ def test_sharded_a_band_search_matches_sequential(rng):
     np.testing.assert_array_equal(np.asarray(oy_m), np.asarray(oy_s))
     np.testing.assert_array_equal(np.asarray(ox_m), np.asarray(ox_s))
     np.testing.assert_array_equal(np.asarray(d_m), np.asarray(d_s))
+
+
+def test_spatial_2d_bands_bit_identical_to_1d(rng):
+    """2-D bands x slabs composition (round-4: the 'remaining step' of
+    spatial.py / sharded_a.py): on a ("bands", "slabs") mesh the lean
+    levels shard B' rows over slabs AND the A-side lean table + kernel
+    planes over bands.  At kappa=0 the output must be BIT-IDENTICAL to
+    the 1-D spatial runner on the same slab count — banded kernel ==
+    single-band kernel by the ownership contract, pmin-merged masked
+    gathers == single-table gathers, same per-slab PRNG streams.  The
+    A table handed to the banded step must be genuinely ROW-SHARDED
+    (a replicated table would still produce correct output)."""
+    from unittest import mock
+
+    import image_analogies_tpu.parallel.spatial as sp
+
+    a = rng.random((128, 128)).astype(np.float32)
+    ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
+    b = np.concatenate([a, a[:, ::-1]], axis=0).astype(np.float32)
+    cfg = SynthConfig(
+        levels=1, matcher="patchmatch", pallas_mode="interpret",
+        em_iters=2, pm_iters=2, feature_bytes_budget=1,
+    )
+    out_1d = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(2)))
+
+    mesh2d = make_mesh(4, axis_names=("bands", "slabs"), shape=(2, 2))
+    real_fn = sp._banded_lean_step_fn
+    shard_rows = []
+
+    def spying(*fargs, **fkw):
+        fn = real_fn(*fargs, **fkw)
+
+        def wrapper(f_a_tab, *rest):
+            shard_rows.append(
+                (f_a_tab.shape[0],
+                 [s.data.shape[0] for s in f_a_tab.addressable_shards])
+            )
+            return fn(f_a_tab, *rest)
+
+        return wrapper
+
+    with mock.patch.object(sp, "_banded_lean_step_fn", spying):
+        out_2d = np.asarray(
+            synthesize_spatial(a, ap, b, cfg, mesh2d)
+        )
+    np.testing.assert_array_equal(out_2d, out_1d)
+    assert shard_rows, "no level ran the banded 2-D step"
+    for total, per_dev in shard_rows:
+        assert len(per_dev) == 4  # one addressable shard per device
+        assert all(r == total // 2 for r in per_dev)
+
+
+def test_spatial_2d_kappa_same_accept_family(rng):
+    """kappa>0 on the 2-D mesh: not bit-identical to 1-D (cross-band
+    coherence bias is marginally weaker — sharded_a.py 'Equivalence'),
+    but a valid field of the same accept family: finite, right shape,
+    and close to the 1-D spatial output."""
+    from image_analogies_tpu import psnr as _psnr
+
+    a = rng.random((128, 128)).astype(np.float32)
+    ap = np.clip(a * 0.5 + 0.25, 0, 1).astype(np.float32)
+    b = np.concatenate([np.flipud(a), a], axis=0).astype(np.float32)
+    cfg = SynthConfig(
+        levels=1, matcher="patchmatch", pallas_mode="interpret",
+        em_iters=1, pm_iters=2, feature_bytes_budget=1, kappa=5.0,
+    )
+    out_1d = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(2)))
+    mesh2d = make_mesh(4, axis_names=("bands", "slabs"), shape=(2, 2))
+    out_2d = np.asarray(synthesize_spatial(a, ap, b, cfg, mesh2d))
+    assert out_2d.shape == b.shape
+    assert np.isfinite(out_2d).all()
+    assert _psnr(out_2d, out_1d) > 20.0
+
+
+def test_spatial_2d_mesh_validation():
+    """Wrong 2-D axis order / names must fail loudly, not mis-shard."""
+    import pytest as _pytest
+
+    a = np.zeros((64, 64), np.float32)
+    b = np.zeros((64, 64), np.float32)
+    bad = make_mesh(4, axis_names=("slabs", "bands"), shape=(2, 2))
+    with _pytest.raises(ValueError, match="bands"):
+        synthesize_spatial(a, a, b, SynthConfig(levels=1), bad)

@@ -86,6 +86,43 @@ def main():
 
     res["seq_diff_f32_ms"] = timeit(seq_read, f_a, f_b)
 
+    # --- Is the ~16-19 GB/s gather rate a locality effect or a per-row
+    # floor?  Three index distributions bound it: uniform-random (the
+    # baseline above), SORTED (maximum spatial locality a re-ordering
+    # could ever buy), and IOTA (perfectly sequential — the degenerate
+    # gather that a streaming copy could replace).  If sorted ~= random,
+    # no sort/cluster pipeline can beat the floor; if iota is also at
+    # the floor, the cost is per-row issue overhead in XLA's gather
+    # lowering, not HBM physics.
+    idx_sorted = jnp.sort(idx)
+    res["gather_sorted_bf16_ms"] = timeit(gather_only, f_a16, idx_sorted)
+    idx_iota = jnp.arange(n, dtype=jnp.int32)
+    res["gather_iota_bf16_ms"] = timeit(gather_only, f_a16, idx_iota)
+
+    # Coherent-field gather: indices from a piecewise-smooth NN field
+    # (the polish's real distribution after convergence) — neighboring
+    # queries fetch neighboring rows.
+    blk = rng.integers(0, n, n // 256, dtype=np.int32)
+    idx_coh = jnp.asarray(
+        (np.repeat(blk, 256) + np.tile(np.arange(256), n // 256))
+        .clip(0, n - 1)
+        .astype(np.int32)
+    )
+    res["gather_coherent_bf16_ms"] = timeit(gather_only, f_a16, idx_coh)
+
+    # Sort -> gather -> unsort pipeline: total cost if the polish
+    # re-ordered its candidate evaluations for locality.
+    @jax.jit
+    def gather_via_sort(fa, ix):
+        order = jnp.argsort(ix)
+        rows = jnp.take(fa, ix[order], axis=0)
+        inv = jnp.zeros_like(order).at[order].set(
+            jnp.arange(ix.shape[0], dtype=order.dtype)
+        )
+        return jnp.take(rows, inv, axis=0)
+
+    res["gather_via_sort_bf16_ms"] = timeit(gather_via_sort, f_a16, idx)
+
     for k, v in res.items():
         res[k] = round(v, 3)
     res["note"] = "n=1M rows, D=68 (pads to 128 lanes)"
