@@ -135,7 +135,7 @@ def cmd_synth(args) -> int:
     # Per-level progress costs one host sync per level; only pay it when
     # the user asked for a progress file (north-star: minimal host syncs).
     level_progress = progress if args.progress else None
-    if getattr(args, "bands", 1) > 1 and not args.spatial:
+    if args.bands > 1 and not args.spatial:
         raise SystemExit(
             "--bands requires --spatial (it names the A-band axis of "
             "the 2-D bands x slabs mesh); for A-side banding alone use "
@@ -170,17 +170,11 @@ def cmd_synth(args) -> int:
             from .parallel.mesh import make_mesh
             from .parallel.sharded_a import synthesize_sharded_a
 
-            if args.resume_from or args.save_level_artifacts:
-                raise SystemExit(
-                    "--sharded-a does not support checkpointing "
-                    "(--resume-from / --save-level-artifacts) yet; "
-                    "checkpointed runs use the single-device or "
-                    "--spatial runner"
-                )
             bp = synthesize_sharded_a(
                 a, ap, b, cfg,
                 make_mesh(args.n_devices, axis_names=("bands",)),
                 progress=level_progress,
+                resume_from=args.resume_from,
             )
         else:
             bp = create_image_analogy(

@@ -168,18 +168,12 @@ def lean_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
     return (py, px), dist, bp
 
 
-def _pad_lanes128(tab: jnp.ndarray) -> jnp.ndarray:
-    """Zero-pad a (N, D) table's trailing dim to a 128-lane multiple.
-
-    Physically free (the T(sublane, 128) HBM layout pads lanes anyway)
-    and metric-free (zero columns on both sides add zero to every
-    distance), but it lets `exact_nn_pallas` skip its own pad+cast
-    working copies — at 4096^2 those would co-host ~8.6 GB of dead
-    bf16 next to the resident tables."""
-    pad = (-tab.shape[-1]) % 128
-    if pad:
-        tab = jnp.pad(tab, ((0, 0), (0, pad)))
-    return tab
+# Max lane-padded bf16 B-band table co-resident with the A table in the
+# lean-brute oracle (see lean_brute_em_step "B-side row banding"): 2 GiB
+# puts the 4096^2 oracle at 4 bands of ~1.07 GB next to the 4.3 GB A
+# table — the measured-survivable regime; <= 2048^2 single-bands (their
+# oracles use the standard f32 path anyway).
+_B_BAND_TABLE_BYTES = 2 * 1024**3
 
 
 def lean_brute_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
@@ -206,42 +200,103 @@ def lean_brute_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
     largest compiling query tile, (tq=2048, ta=256) — the measured
     scoped-VMEM ceiling (see exact_nn_pallas; same tiles as the
     recorded 2048^2 oracle, SCALE_r04).
+
+    B-side row banding (`_B_BAND_TABLE_BYTES`): co-hosting BOTH full
+    lane-padded tables (2 x 4.3 GB at 4096^2) next to the pipeline's
+    other residents exceeded what the worker actually grants — the
+    round-4 oracle died of RESOURCE_EXHAUSTED twice, once at a 268 MB
+    a_sq chunk, i.e. the pool was already spent.  The B table is
+    therefore assembled and searched in row bands: only the A table
+    stays resident; each band's table is assembled from a generously
+    row-sliced input (window reach covered by `slab_halo` rows, edge
+    clamping identical to full assembly because slices at the image
+    boundary ARE the boundary), core rows trimmed, searched, freed.
+    Bit-identical to the unbanded search (exact NN is per-query;
+    banding cannot change any row's features or argmin — tested with
+    a forced-tiny band budget).
     """
     from ..kernels import resolve_pallas
     from ..kernels.nn_brute import exact_nn_pallas
+    from ..parallel.spatial import slab_halo
 
     h, w = src_b.shape[:2]
     ha, wa = copy_a.shape[:2]
-    f_b_tab = _pad_lanes128(assemble_features_lean(
-        src_b,
-        flt_b,
-        cfg,
-        src_b_c if has_coarse else None,
-        flt_b_c if has_coarse else None,
-    ))
     interpret = resolve_pallas(cfg)
-    if interpret is None:
-        from .brute import exact_nn
 
-        idx, dist = exact_nn(
-            f_b_tab,
-            f_a_tab,
-            chunk=min(cfg.brute_chunk, h * w),
-            match_dtype=_LEAN_TABLE_DTYPE,
+    n_src = 1 if src_b.ndim == 2 else src_b.shape[-1]
+    n_flt = 1 if flt_b.ndim == 2 else flt_b.shape[-1]
+    d_feat = (n_src + n_flt) * cfg.patch_size**2
+    if has_coarse:
+        d_feat += (n_src + n_flt) * cfg.coarse_patch_size**2
+    row_bytes = (-(-d_feat // 128)) * 128 * 2  # padded bf16 row
+    n_b = 1
+    while (
+        # '>=': at exactly 4096^2 defaults the estimate is exactly
+        # 4 GiB, and a strict '>' would stop at 2 GiB bands — whose
+        # trim transient co-hosts ~2x that next to the A table, the
+        # unmeasured regime this loop exists to avoid.
+        h * w * row_bytes // n_b >= _B_BAND_TABLE_BYTES
+        and h % (n_b * 2) == 0
+        and (h // (n_b * 2)) % 2 == 0
+    ):
+        n_b *= 2
+    band_rows = h // n_b
+    halo = slab_halo(cfg)
+
+    def band_table(r0, r1):
+        """bf16 lane-padded feature rows for B rows [r0, r1)."""
+        lo = max(r0 - halo, 0)
+        hi = min(r1 + halo, h)
+        tab = assemble_features_lean(
+            src_b[lo:hi],
+            flt_b[lo:hi],
+            cfg,
+            src_b_c[lo // 2 : -(-hi // 2)] if has_coarse else None,
+            flt_b_c[lo // 2 : -(-hi // 2)] if has_coarse else None,
+            pad_lanes=True,
         )
-    else:
+        if lo == 0 and hi == h:
+            return tab
+        start = (r0 - lo) * w
+        return jax.lax.slice(
+            tab, (start, 0), (start + (r1 - r0) * w, tab.shape[1])
+        )
+
+    def search(tab):
+        if interpret is None:
+            from .brute import exact_nn
+
+            return exact_nn(
+                tab,
+                f_a_tab,
+                chunk=min(cfg.brute_chunk, tab.shape[0]),
+                match_dtype=_LEAN_TABLE_DTYPE,
+            )
         tiles = (
             dict(tq=2048, ta=256)
             if f_a_tab.shape[0] >= (1 << 20)
             else {}
         )
-        idx, dist = exact_nn_pallas(
-            f_b_tab,
+        return exact_nn_pallas(
+            tab,
             f_a_tab,
             match_dtype=_LEAN_TABLE_DTYPE,
             interpret=interpret,
             **tiles,
         )
+
+    if n_b == 1:
+        idx, dist = search(band_table(0, h))
+    else:
+        idx_parts, dist_parts = [], []
+        for i in range(n_b):
+            idx_i, dist_i = search(
+                band_table(i * band_rows, (i + 1) * band_rows)
+            )
+            idx_parts.append(idx_i)
+            dist_parts.append(dist_i)
+        idx = jnp.concatenate(idx_parts, axis=0)
+        dist = jnp.concatenate(dist_parts, axis=0)
     py = (idx // wa).reshape(h, w)
     px = (idx % wa).reshape(h, w)
     dist = dist.reshape(h, w)
@@ -252,17 +307,26 @@ def lean_brute_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
         # same semantics on the plane-pair field — same rule, same
         # sweep count, distances in the lean bf16 metric the exact
         # search itself re-ranked in (candidate_dist_lean: bf16 rows,
-        # f32 accumulation).
+        # f32 accumulation).  The adoption pass gathers B rows for
+        # every query, so it needs one full-height B table: assembled
+        # NARROW (no lane pad — physically ~half the padded table) to
+        # stay within the banded path's memory ceiling.
         from .coherence import coherence_sweeps_lean
         from .matcher import candidate_dist_lean
         from .patchmatch import kappa_factor
 
-        f_b_tab_c = f_b_tab  # closure binding for the dist_fn
+        f_b_coh = assemble_features_lean(
+            src_b,
+            flt_b,
+            cfg,
+            src_b_c if has_coarse else None,
+            flt_b_c if has_coarse else None,
+        )
         py, px, dist = coherence_sweeps_lean(
             py, px, dist, ha=ha, wa=wa,
             factor=kappa_factor(cfg.kappa, level),
             sweeps=2,
-            dist_fn=lambda i: candidate_dist_lean(f_b_tab_c, f_a_tab, i),
+            dist_fn=lambda i: candidate_dist_lean(f_b_coh, f_a_tab, i),
         )
         idx = (py * wa + px).reshape(-1)
     flat = copy_a.reshape(ha * wa, -1)
@@ -536,14 +600,13 @@ def _level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
         if fa_external:
             f_a, proj = f_a_ext, proj_ext
         elif lean:
+            # Lean-brute oracle tables assemble straight into a
+            # 128-lane buffer (see assemble_features_lean: padding
+            # after the fact transiently doubles the table).
             f_a = assemble_features_lean(
-                src_a_l, flt_a_l, cfg, src_a_c, flt_a_c
+                src_a_l, flt_a_l, cfg, src_a_c, flt_a_c,
+                pad_lanes=cfg.matcher == "brute",
             )
-            if cfg.matcher == "brute":
-                # Rebind so the unpadded original dies before the EM
-                # steps run (this path executes eagerly at oracle
-                # sizes — fuse=False via _SAFE_EXEC_DIST_ELEMS).
-                f_a = _pad_lanes128(f_a)
             proj = None
         else:
             f_a = assemble_features(src_a_l, flt_a_l, cfg, src_a_c, flt_a_c)
@@ -638,8 +701,17 @@ _LEAN_CHUNK_ROWS = 256
 _LEAN_TABLE_DTYPE = jnp.bfloat16
 
 
-def assemble_features_lean(src, flt, cfg: SynthConfig, src_c, flt_c):
+def assemble_features_lean(src, flt, cfg: SynthConfig, src_c, flt_c,
+                           pad_lanes: bool = False):
     """Feature table assembled slab-by-slab into one (N, D) bf16 buffer.
+
+    `pad_lanes=True` (the lean-brute oracle) allocates the buffer at
+    the next 128-lane multiple and writes each slab's rows into its
+    left columns — zero columns add zero to every distance, and
+    `exact_nn_pallas` then skips its pad/cast working copies.  Padding
+    AFTER assembly instead costs a transient second full-table copy
+    next to the original (2 x 4.3 GB at 4096^2 — the round-4 oracle's
+    first attempt died of exactly that, RESOURCE_EXHAUSTED at level 0).
 
     A whole-image f32 assembly is unaffordable at 4096^2 twice over:
     the T(8, 128) layout pads D to 128 lanes (8.5 GB per table) and the
@@ -691,6 +763,8 @@ def assemble_features_lean(src, flt, cfg: SynthConfig, src_c, flt_c):
     rows_core = slab_stacks[0].shape[1] - 2 * halo
     rw = rows_core * w
 
+    d_buf = (-(-d_feat // 128)) * 128 if pad_lanes else d_feat
+
     def body(i, f_tab):
         slab = tuple(
             jax.lax.dynamic_index_in_dim(s, i, keepdims=False)
@@ -702,7 +776,7 @@ def assemble_features_lean(src, flt, cfg: SynthConfig, src_c, flt_c):
         0,
         n_chunks,
         body,
-        jnp.zeros((n_chunks * rw, d_feat), _LEAN_TABLE_DTYPE),
+        jnp.zeros((n_chunks * rw, d_buf), _LEAN_TABLE_DTYPE),
     )
     return f_tab[: h * w]
 

@@ -113,7 +113,13 @@ def candidate_dist_lean(
     slice, not a gather — only the A side pays gather cost.  Distances
     accumulate in f32 regardless of table dtype."""
     n = idx.shape[0]
-    d_feat = f_a_tab.shape[1]
+    # Width comes from the B side: the lean-brute oracle pairs a NARROW
+    # B table with the 128-lane-padded A table (models/analogy.py —
+    # the pad columns are zeros, so truncating gathered A rows to the
+    # B width leaves every distance exactly unchanged).  Equal-width
+    # callers see a no-op slice.
+    d_feat = f_b_tab.shape[1]
+    assert f_a_tab.shape[1] >= d_feat, (f_a_tab.shape, f_b_tab.shape)
     # The chunk loop unrolls in Python (n_chunks is static and small),
     # so every slice is a STATIC lax.slice: the B side is sliced from
     # the resident table without ever copying/padding the whole table
@@ -134,9 +140,12 @@ def candidate_dist_lean(
             ix = jnp.pad(ix, (0, m_pad - m))
             rows_b = jnp.pad(rows_b, ((0, m_pad - m), (0, 0)))
         rows2 = m_pad // LANES
-        a3 = jnp.take(f_a_tab, ix, axis=0).astype(jnp.float32).reshape(
-            rows2, LANES, d_feat
-        )
+        a_rows = jnp.take(f_a_tab, ix, axis=0)
+        if a_rows.shape[1] != d_feat:
+            a_rows = jax.lax.slice(
+                a_rows, (0, 0), (a_rows.shape[0], d_feat)
+            )
+        a3 = a_rows.astype(jnp.float32).reshape(rows2, LANES, d_feat)
         b3 = rows_b.astype(jnp.float32).reshape(rows2, LANES, d_feat)
         outs.append(jnp.sum((b3 - a3) ** 2, axis=-1))  # (rows2, LANES)
     d = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)

@@ -68,6 +68,7 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -206,6 +207,7 @@ def synthesize_sharded_a(
     cfg: Optional[SynthConfig] = None,
     mesh=None,
     progress=None,
+    resume_from: Optional[str] = None,
 ):
     """B' for one (b) against a style pair whose A-side lean tables are
     BAND-SHARDED across the mesh — per-device A residency is 1/n of the
@@ -224,24 +226,25 @@ def synthesize_sharded_a(
     `progress` is an optional utils.progress.ProgressWriter (one timed
     `level_done` event per level, like the single driver).
 
-    Checkpoint/resume is NOT supported on this runner yet (v1 scope):
-    `cfg.save_level_artifacts` raises rather than silently writing
-    nothing.
+    Checkpoint/resume: `cfg.save_level_artifacts` writes the standard
+    per-level artifacts (lean plane pairs stacked host-side to the
+    (H, W, 2) schema, like the other runners) and `resume_from`
+    restarts from the finest completed level via the shared
+    `resume_prologue`.
     """
     import time
 
     from ..kernels import resolve_pallas
     from ..kernels.patchmatch_tile import band_bounds, prepare_a_planes
-    from ..models.analogy import _level_plan, _strip_noncompute
+    from ..models.analogy import (
+        _level_plan,
+        _save_level,
+        _strip_noncompute,
+        resume_prologue,
+    )
     from .batch import _mesh_token
 
     cfg = cfg or SynthConfig()
-    if cfg.save_level_artifacts:
-        raise NotImplementedError(
-            "save_level_artifacts/resume is not supported on the "
-            "sharded-A runner yet; use the single-device or spatial "
-            "runner for checkpointed runs"
-        )
     mesh = mesh or make_mesh(axis_names=(_AXIS,))
     if mesh.axis_names != (_AXIS,):
         raise ValueError(
@@ -269,7 +272,17 @@ def synthesize_sharded_a(
     bp = None
     nnf = None  # stacked array (replicated levels) or (py, px) planes
     n_sharded_levels = 0
-    for level in range(levels - 1, -1, -1):
+    start_level = levels - 1
+    resumed = resume_prologue(resume_from, levels, cfg, b.shape, progress)
+    if resumed is not None:
+        start_level, nnf, bp, _aux = resumed
+        if start_level < 0:
+            return _finalize(bp, yiq_b, b, cfg)
+        # Resumed levels count as sharded coverage for the no-op warning
+        # below only if they WOULD have sharded; simplest honest rule:
+        # suppress the warning on resumed runs (the prior run warned).
+        n_sharded_levels = levels - 1 - start_level
+    for level in range(start_level, -1, -1):
         level_t0 = time.perf_counter()
         h, w = pyr_src_b[level].shape[:2]
         ha, wa = pyr_src_a[level].shape[:2]
@@ -404,6 +417,20 @@ def synthesize_sharded_a(
                 shape=[int(h), int(w)],
                 wall_ms=round((time.perf_counter() - level_t0) * 1000, 3),
                 nnf_energy=nnf_energy,
+            )
+        if cfg.save_level_artifacts:
+            nnf_save = nnf
+            if isinstance(nnf, tuple):
+                # Stack the plane pair on the HOST: checkpoints keep the
+                # standard (H, W, 2) schema without materializing the
+                # lane-padded stack on device (models/analogy.py does
+                # the same).
+                nnf_save = np.stack(
+                    [np.asarray(nnf[0]), np.asarray(nnf[1])], axis=-1
+                )
+            _save_level(
+                cfg.save_level_artifacts, level, nnf_save, dist, bp, cfg,
+                b.shape,
             )
 
     if not n_sharded_levels:

@@ -48,8 +48,12 @@ test_sharded_a_runner_bit_identical_to_single_device; kappa>0 trades
 bit-identity for a marginally weaker cross-band coherence bias — see
 sharded_a.py 'Equivalence'; the kernel-level band contract is pinned
 separately by test_sharded_a_band_search_matches_sequential).  Composing it with
-THIS runner's B' slabs (a 2-D bands x slabs mesh) is the remaining
-step for pairs where both sides outgrow a chip.
+THIS runner's B' slabs — a ("bands", "slabs") 2-D mesh, for pairs
+where both sides outgrow a chip — is implemented HERE (round-4):
+`synthesize_spatial` detects the 2-D mesh and routes lean levels
+through `_banded_lean_step_fn`, which runs the shared `lean_em_step`
+under a shard_map with sharded_a's three band hooks while the slabs
+axis keeps this runner's halo re-stitch.
 """
 
 from __future__ import annotations
@@ -131,8 +135,8 @@ def _reslab_fn(halo: int, n_slabs: int, n_arrays: int, mesh_key,
     iteration (the module docstring's halo-exchange claim is made true
     here).  Array count is generic: the standard path re-halos
     (stacked-nnf, bp), the lean path (py, px, bp).  `axis` names the
-    mesh axis the slab stack shards over ('slabs' on the 2-D
-    bands x slabs runner, parallel/sharded_2d.py)."""
+    mesh axis the slab stack shards over ('slabs' when
+    `synthesize_spatial` runs on the 2-D bands x slabs mesh)."""
     from .batch import _MESHES
 
     shard = batch_sharding(_MESHES[mesh_key], axis)

@@ -6,6 +6,8 @@ sharded run must be BIT-IDENTICAL to the single-device run — the
 strongest possible check that the halo geometry is right.
 """
 
+import os
+
 import numpy as np
 import jax
 
@@ -575,3 +577,41 @@ def test_spatial_2d_mesh_validation():
     bad = make_mesh(4, axis_names=("slabs", "bands"), shape=(2, 2))
     with _pytest.raises(ValueError, match="bands"):
         synthesize_spatial(a, a, b, SynthConfig(levels=1), bad)
+
+
+def test_sharded_a_checkpoint_roundtrip(rng, tmp_path):
+    """Sharded-A checkpoint/resume (round-4: removed the v1
+    NotImplementedError): per-level artifacts use the standard stacked
+    schema and a resumed run reproduces the uninterrupted one."""
+    from image_analogies_tpu.parallel.sharded_a import synthesize_sharded_a
+
+    a = rng.random((128, 128)).astype(np.float32)
+    ap = np.clip(a * 0.6 + 0.3, 0, 1).astype(np.float32)
+    b = np.roll(a, 17, axis=0)
+    mesh = make_mesh(2, axis_names=("bands",))
+    cfg = SynthConfig(
+        levels=2, matcher="patchmatch", em_iters=1, pm_iters=2,
+        feature_bytes_budget=1, pallas_mode="interpret",
+        save_level_artifacts=str(tmp_path / "ck"),
+    )
+    full = np.asarray(synthesize_sharded_a(a, ap, b, cfg, mesh))
+    # Mid-pyramid restart — the crash-resume path the feature exists
+    # for: drop the finest level's artifact so the resumed run loads
+    # the stacked level-1 field and re-synthesizes level 0 through the
+    # sharded step (an all-levels-complete resume would just finalize
+    # without entering the loop).
+    os.unlink(tmp_path / "ck" / "level_0.npz")
+    resumed = np.asarray(
+        synthesize_sharded_a(
+            a, ap, b, cfg, mesh, resume_from=str(tmp_path / "ck"),
+        )
+    )
+    np.testing.assert_array_equal(resumed, full)
+    # And the degenerate all-complete resume (level_0.npz re-written by
+    # the resumed run) finalizes directly.
+    again = np.asarray(
+        synthesize_sharded_a(
+            a, ap, b, cfg, mesh, resume_from=str(tmp_path / "ck"),
+        )
+    )
+    np.testing.assert_array_equal(again, full)

@@ -269,7 +269,6 @@ class TestLeanBrute:
         import jax.numpy as jnp
 
         from image_analogies_tpu.models.analogy import (
-            _pad_lanes128,
             _prologue_fn,
             assemble_features_lean,
             upsample,
@@ -308,12 +307,13 @@ class TestLeanBrute:
         flt1 = estimate(1)
         h, w = pyr_src_b[0].shape[:2]
         flt0 = upsample(flt1, (h, w))
-        f_b_tab = _pad_lanes128(assemble_features_lean(
-            pyr_src_b[0], flt0, cfg, pyr_src_b[1], flt1
-        ))
-        f_a_tab = _pad_lanes128(assemble_features_lean(
-            pyr_src_a[0], pyr_flt_a[0], cfg, pyr_src_a[1], pyr_flt_a[1]
-        ))
+        f_b_tab = assemble_features_lean(
+            pyr_src_b[0], flt0, cfg, pyr_src_b[1], flt1, pad_lanes=True
+        )
+        f_a_tab = assemble_features_lean(
+            pyr_src_a[0], pyr_flt_a[0], cfg, pyr_src_a[1], pyr_flt_a[1],
+            pad_lanes=True,
+        )
         idx, _ = exact_nn(
             f_b_tab, f_a_tab, chunk=min(cfg.brute_chunk, h * w),
             match_dtype=jnp.bfloat16,
@@ -353,3 +353,24 @@ class TestLeanBrute:
         bp_std_k5 = _run(a, ap, b, levels=2, em_iters=2, matcher="brute",
                          kappa=5.0)
         assert psnr(bp_k5, bp_std_k5) >= 33.0
+
+    def test_b_band_search_bit_identical(self):
+        """B-side row banding (memory fix after the 4096^2 oracle's
+        RESOURCE_EXHAUSTED: only the A table stays resident; B bands
+        assemble/search/free) cannot change any query's features or
+        argmin — forced-tiny band budget must reproduce the unbanded
+        run bit-for-bit, kappa=0 and kappa>0."""
+        from unittest import mock
+
+        import image_analogies_tpu.models.analogy as an
+
+        a, ap, b = super_resolution(64)
+        for kappa in (0.0, 5.0):
+            kw = dict(
+                levels=2, matcher="brute", em_iters=2,
+                brute_lean_bytes=1, kappa=kappa,
+            )
+            whole = _run(a, ap, b, **kw)
+            with mock.patch.object(an, "_B_BAND_TABLE_BYTES", 1):
+                banded = _run(a, ap, b, **kw)
+            np.testing.assert_array_equal(banded, whole)
