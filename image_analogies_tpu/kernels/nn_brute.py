@@ -56,6 +56,15 @@ _TA = 512
 # round-4 full-synthesis 2048^2 oracle run (SCALE_r04).
 _MAX_TILE_ELEMS = 1_200_000_000_000
 
+# Grid dimensions must stay CLEARLY below 2^16 steps: a pallas_call
+# whose A-axis grid hit exactly 65536 steps wedged the worker session
+# indefinitely — no error, no progress, client asleep on a futex —
+# while 16384/32768/49152-step grids ran normally (measured
+# 2026-07-31, tools/_oracle_out probes; the 4096^2 oracle's
+# N_A=16.8M / ta=256 landed exactly on the boundary).  `exact_nn_pallas`
+# rescales (tq, ta) to keep every grid dim under this cap.
+_MAX_GRID_DIM = 49152
+
 
 def _make_nn_kernel(ta: int):
     """Kernel closure over the A-tile row count (needed for the global
@@ -173,6 +182,14 @@ def exact_nn_pallas(
     n_a = f_a_flat.shape[0]
     match_dtype = jnp.dtype(match_dtype)
 
+    # Keep the A-axis grid under _MAX_GRID_DIM (65536-step grids wedge
+    # the worker — see the constant).  Doubling ta while halving tq
+    # keeps the per-step tile elements and the scoped-VMEM footprint
+    # constant, so any compiling (tq, ta) pair stays compiling.
+    while n_a // ta > _MAX_GRID_DIM and tq >= 16:
+        ta *= 2
+        tq = max(tq // 2, 8)
+
     # Pad D to lanes, N_B/N_A to tile multiples.  Pads and casts are
     # conditional: when the caller's tables are already tile-shaped and
     # in the match dtype (the lean-brute oracle pre-shapes its bf16
@@ -217,7 +234,12 @@ def exact_nn_pallas(
     # compiled kernel serves every chunk.
     q_tiles = fb.shape[0] // tq
     max_steps = max(1, _MAX_TILE_ELEMS // (tq * ta))
-    chunk_tiles = max(1, min(q_tiles, max_steps // grid_a))
+    # The query-axis grid dim must ALSO stay under _MAX_GRID_DIM (a
+    # small-A / giant-B call could otherwise budget a 65536-tile query
+    # chunk and land the OTHER grid dim on the wedge boundary).
+    chunk_tiles = max(
+        1, min(q_tiles, max_steps // grid_a, _MAX_GRID_DIM)
+    )
     # Prefer the largest clean divisor within 2x of the budgeted chunk:
     # an uneven split pads fb up to a chunk multiple, and at giant-N
     # (the 4096^2 oracle: 16.8M rows) that pad is a 4.3 GB working

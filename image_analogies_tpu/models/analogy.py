@@ -869,9 +869,12 @@ def create_image_analogy(
     resumed = resume_prologue(resume_from, levels, cfg, b.shape, progress)
     if resumed is not None:
         start_level, nnf, bp, aux_fill = resumed
-        for lvl, (n, d) in aux_fill.items():
-            aux["nnf"][lvl] = n
-            aux["dist"][lvl] = d
+        if return_aux:
+            # Same gate as the level loop: checkpointed levels' arrays
+            # are only worth holding when the caller asked for aux.
+            for lvl, (n, d) in aux_fill.items():
+                aux["nnf"][lvl] = n
+                aux["dist"][lvl] = d
         if start_level < 0:
             out = _finalize(bp, yiq_b, b, cfg)
             if return_aux:
@@ -967,8 +970,12 @@ def create_image_analogy(
             proj_ext,
         )
 
-        aux["nnf"][level] = nnf
-        aux["dist"][level] = dist
+        if return_aux:
+            # Only keep per-level device state alive when the caller
+            # asked for it: at oracle sizes the accumulated fields are
+            # hundreds of MB held until function exit for nothing.
+            aux["nnf"][level] = nnf
+            aux["dist"][level] = dist
         if progress is not None:
             # One device sync per level — the only host sync in the loop
             # (north-star: minimize host round trips).  The sync is the

@@ -87,7 +87,26 @@ def _cached_run(name: str, size: int, matcher: str, **kw):
             kw2.setdefault("ta", 256)
             return orig(fb, fa, **kw2)
 
-        with mock.patch.object(nb, "exact_nn_pallas", big_tiles):
+        # Heartbeat per query-chunk execution (~25 s apart during the
+        # search): the axon tunnel can wedge a client session
+        # indefinitely (observed 2026-07-31: 50 min asleep on a futex,
+        # socket idle, worker healthy once the client was killed), and
+        # a hung client neither crashes nor progresses — the wrapper
+        # script watches this file's mtime and kills/retries on
+        # staleness.
+        hb = os.path.join(_OUT, "heartbeat")
+        real_chunk = nb._nn_chunk_call
+
+        def beat_chunk(*a2, **k2):
+            try:
+                with open(hb, "w") as f:
+                    f.write(str(time.time()))
+            except OSError:
+                pass
+            return real_chunk(*a2, **k2)
+
+        with mock.patch.object(nb, "exact_nn_pallas", big_tiles), \
+                mock.patch.object(nb, "_nn_chunk_call", beat_chunk):
             out = create_image_analogy(
                 a, ap, b, _cfg(size, matcher, ckpt, **kw),
                 progress=prog, resume_from=resume,
@@ -129,10 +148,26 @@ def validate():
 
 def full(size: int):
     pm, pm_info = _cached_run(f"pm_{size}", size, "patchmatch", pm_iters=6)
-    oracle, o_info = _cached_run(f"oracle_lean_{size}", size, "brute")
+    # >= 3072: force the lean-brute oracle at EVERY level.  Not only is
+    # the f32 path's table pair (2 x 4.8 GB at 3072^2) past what the
+    # worker reliably grants — executions whose footprint approaches
+    # the pool don't fail, they WAIT forever (the wedge the heartbeat
+    # watchdog exists for), so the oracle runs at the smallest
+    # footprint that preserves exactness: bf16 lean tables (the metric
+    # the production path matches in at these sizes; cross-validated
+    # at 1024^2, `validate`).
+    kw = {"brute_lean_bytes": 1} if size >= 3072 else {}
+    # Distinct cache names per oracle mode: a default-config run at a
+    # sub-3072 size runs the f32 path and must not collide with (or
+    # mislabel itself as) a forced-lean run.
+    name = f"oracle_lean_{size}" if kw else f"oracle_f32_{size}"
+    oracle, o_info = _cached_run(name, size, "brute", **kw)
     print(json.dumps({
         "size": size,
-        "oracle": "lean-brute (exact NN over bf16 lean tables)",
+        "oracle": (
+            "lean-brute (exact NN over bf16 lean tables)" if kw
+            else "brute (exact NN, f32 tables)"
+        ),
         "psnr_vs_full_oracle_db": round(psnr(pm, oracle), 2),
         "oracle_wall_s": o_info["wall_s"],
         "pm_wall_s": pm_info["wall_s"],
