@@ -97,6 +97,21 @@ def _cached_run(name: str, size: int, matcher: str, **kw):
         hb = os.path.join(_OUT, "heartbeat")
         real_chunk = nb._nn_chunk_call
 
+        # Optional per-execution budget override (element count of
+        # distance-tile work per chunk): the degraded-tunnel hunt
+        # suggested long back-to-back executions wedge where shorter
+        # ones may survive; ORACLE_MAX_TILE_ELEMS=3e11 quarters the
+        # ~22 s level-0 executions to ~6 s.
+        budget = os.environ.get("ORACLE_MAX_TILE_ELEMS")
+        if budget:
+            try:
+                nb._MAX_TILE_ELEMS = int(float(budget))
+            except ValueError:
+                raise SystemExit(
+                    f"ORACLE_MAX_TILE_ELEMS={budget!r} is not a number "
+                    "(e.g. 3e11)"
+                )
+
         def beat_chunk(*a2, **k2):
             try:
                 with open(hb, "w") as f:
