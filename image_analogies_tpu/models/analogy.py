@@ -23,6 +23,7 @@ responses of Y); chroma is copied from B at the end (Hertzmann §3.4).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
 import time
@@ -109,6 +110,49 @@ def random_init_planes(key: jax.Array, h: int, w: int, ha: int, wa: int):
         jax.random.randint(ky, (h, w), 0, ha),
         jax.random.randint(kx, (h, w), 0, wa),
     )
+
+
+def _level_state_glue(lean: bool, prev_kind: str, prev_nnf, prev_bp,
+                      raw_b_l, h: int, w: int, ha: int, wa: int, init_key,
+                      *, batched: bool = False):
+    """Incoming-state glue for one level: upsample the coarser level's
+    (nnf, B') into this level's frame, or draw the coarsest level's
+    random-init field.  Shared verbatim by the single-image level body
+    (`_level_fn_cached`) and the batch level body
+    (`parallel/batch._batch_level_fn_cached`): `batched=True` lifts
+    every per-frame op with jax.vmap and `init_key` is then the
+    per-frame key stack.  Returns (nnf, flt_bp, flt_bp_coarse).
+
+    ADVICE r2: at a lean coarsest level the stacked (H, W, 2) init
+    would materialize the exact lane-padded allocation the lean
+    representation avoids — draw the planes directly (bit-identical
+    streams: same key split, same shapes)."""
+    vm = jax.vmap if batched else (lambda f: f)
+    if prev_kind != "none":
+        if lean:
+            p_py, p_px = (
+                prev_nnf if prev_kind == "planes"
+                else (prev_nnf[..., 0], prev_nnf[..., 1])
+            )
+            nnf = vm(
+                lambda py, px: upsample_nnf_planes(py, px, (h, w), ha, wa)
+            )(p_py, p_px)
+        elif prev_kind == "planes":
+            def stack_up(py, px):
+                uy, ux = upsample_nnf_planes(py, px, (h, w), ha, wa)
+                return jnp.stack([uy, ux], axis=-1)
+
+            nnf = vm(stack_up)(prev_nnf[0], prev_nnf[1])
+        else:
+            nnf = vm(lambda n: upsample_nnf(n, (h, w), ha, wa))(prev_nnf)
+        flt_bp_coarse = prev_bp
+        flt_bp = vm(lambda x: upsample(x, (h, w)))(prev_bp)
+    else:
+        init = random_init_planes if lean else random_init
+        nnf = vm(lambda k: init(k, h, w, ha, wa))(init_key)
+        flt_bp = raw_b_l
+        flt_bp_coarse = flt_bp
+    return nnf, flt_bp, flt_bp_coarse
 
 
 def lean_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
@@ -558,6 +602,99 @@ def _assemble_fa_fn_cached(cfg: SynthConfig, has_coarse: bool):
 _SAFE_EXEC_DIST_ELEMS = 2_400_000_000_000
 
 
+@dataclasses.dataclass(frozen=True)
+class LevelPlan:
+    """Per-level dispatch plan — the ONE place the level-loop glue
+    decisions live (round-5; previously hand-mirrored across the four
+    runners with 'must be mirrored' maintenance notes).
+
+    lean:        assemble bf16 chunked tables / plane-pair field instead
+                 of the standard f32 tables (the decision must precede
+                 assembly — assembly is what OOMs).
+    prev_kind:   static layout of the incoming coarser-level NN field
+                 ('none' | 'stacked' | 'planes').
+    fa_external: A-side features assembled by the standalone
+                 `_assemble_fa_fn` jit instead of fused into the level
+                 graph (`_SPLIT_ASSEMBLY_BYTES`).
+    fuse:        level runs as one jitted call; False = oversized brute
+                 levels dispatch eagerly so no single execution outlives
+                 the TPU worker's kill boundary (`_SAFE_EXEC_DIST_ELEMS`).
+    """
+
+    lean: bool
+    prev_kind: str
+    fa_external: bool
+    fuse: bool
+
+
+def plan_level(cfg: SynthConfig, level: int, src_a_l, flt_a_l,
+               has_coarse: bool, h: int, w: int, *, prev_nnf=None,
+               eligible_shape=None, table_bytes=None, work_scale: int = 1,
+               brute_lean: bool = True) -> LevelPlan:
+    """Compute the `LevelPlan` for one pyramid level.
+
+    Shared by all four runners (single `create_image_analogy`, batch
+    `synthesize_batch`, `synthesize_sharded_a`, `synthesize_spatial`) so
+    the dispatch rules cannot drift between them.  Runner-specific
+    inputs parameterize the differences instead of forking the logic:
+
+    `eligible_shape`: the (h, w) the kernel-eligibility probe should
+        plan against when it differs from the level's B shape — the
+        spatial runner plans against the SLAB the vmapped step will see
+        (core + halos), not the global B'.
+    `table_bytes`: override for the resident-feature-table estimate —
+        the batch runner counts one B table per resident frame
+        (`_batch_feature_table_bytes`).
+    `work_scale`: per-execution work multiplier for the brute unfuse
+        rule — the batch runner's resident frame count scales every
+        chunk execution's work.
+    `brute_lean`: whether the brute matcher may take the lean-brute
+        oracle path past `cfg.brute_lean_bytes` (single-image runner
+        only; the batch/sharded runners keep brute on the standard
+        path, where the oversized-work rule unfuses it).
+    """
+    ha, wa = src_a_l.shape[:2]
+    if table_bytes is None:
+        table_bytes = _feature_table_bytes(h, w, ha, wa)
+    eh, ew = eligible_shape if eligible_shape is not None else (h, w)
+    if cfg.matcher == "brute":
+        # Brute keeps the exact f32 metric as long as the tables fit
+        # (it is the oracle: cfg.brute_lean_bytes, not the tighter
+        # kernel-path budget) and goes lean-brute past that —
+        # bf16-table exact search, lean_brute_em_step.
+        lean = brute_lean and table_bytes > cfg.brute_lean_bytes
+    else:
+        lean = (
+            _kernel_eligible(cfg, src_a_l, flt_a_l, has_coarse, eh, ew)
+            and table_bytes > cfg.feature_bytes_budget
+        )
+    if lean and cfg.pca_dims:
+        import logging
+
+        knob = (
+            "brute_lean_bytes" if cfg.matcher == "brute"
+            else "feature_bytes_budget"
+        )
+        logging.getLogger("image_analogies_tpu").warning(
+            "level %d exceeds %s: lean path matches in full-D bf16 "
+            "space, pca_dims=%s is not applied at this level",
+            level, knob, cfg.pca_dims,
+        )
+    prev_kind = (
+        "none" if not has_coarse
+        else ("planes" if isinstance(prev_nnf, tuple) else "stacked")
+    )
+    # Oversized brute levels run unfused (_SAFE_EXEC_DIST_ELEMS): one
+    # fused execution of their exact search would outlive the TPU
+    # worker's per-execution tolerance.
+    fuse = (
+        cfg.matcher != "brute"
+        or work_scale * cfg.em_iters * (h * w) * (ha * wa)
+        <= _SAFE_EXEC_DIST_ELEMS
+    )
+    return LevelPlan(lean, prev_kind, _fa_external(ha, wa, lean), fuse)
+
+
 def _level_fn(cfg: SynthConfig, level: int, has_coarse: bool, lean: bool,
               prev_kind: str, fa_external: bool = False, fuse: bool = True):
     return _level_fn_cached(
@@ -627,34 +764,10 @@ def _level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
                 n_bands=n_bands,
             )
 
-        if has_coarse:
-            if lean:
-                p_py, p_px = (
-                    prev_nnf if prev_kind == "planes"
-                    else (prev_nnf[..., 0], prev_nnf[..., 1])
-                )
-                nnf = upsample_nnf_planes(p_py, p_px, (h, w), ha, wa)
-            elif prev_kind == "planes":
-                uy, ux = upsample_nnf_planes(
-                    prev_nnf[0], prev_nnf[1], (h, w), ha, wa
-                )
-                nnf = jnp.stack([uy, ux], axis=-1)
-            else:
-                nnf = upsample_nnf(prev_nnf, (h, w), ha, wa)
-            flt_bp_coarse = prev_bp
-            flt_bp = upsample(prev_bp, (h, w))
-        else:
-            # ADVICE r2: at a lean coarsest level the stacked (H, W, 2)
-            # init would materialize the exact lane-padded allocation
-            # the lean representation avoids — draw the planes directly
-            # (bit-identical streams: same key split, same shapes).
-            nnf = (
-                random_init_planes(level_key, h, w, ha, wa)
-                if lean
-                else random_init(level_key, h, w, ha, wa)
-            )
-            flt_bp = raw_b_l
-            flt_bp_coarse = flt_bp
+        nnf, flt_bp, flt_bp_coarse = _level_state_glue(
+            lean, prev_kind, prev_nnf, prev_bp, raw_b_l, h, w, ha, wa,
+            level_key,
+        )
 
         dist = bp = None
         for em in range(cfg.em_iters):
@@ -899,60 +1012,24 @@ def create_image_analogy(
         ha, wa = pyr_src_a[level].shape[:2]
         has_coarse = level < levels - 1
 
-        # Lean levels never materialize the (N, D) feature tables — the
-        # decision must precede assembly (assembly is what OOMs).
-        # Brute keeps the exact f32 metric as long as the tables fit
-        # (it is the oracle: cfg.brute_lean_bytes, not the tighter
-        # kernel-path budget) and goes lean-brute past that —
-        # bf16-table exact search, lean_brute_em_step.
-        if cfg.matcher == "brute":
-            lean = (
-                _feature_table_bytes(h, w, ha, wa) > cfg.brute_lean_bytes
-            )
-        else:
-            lean = (
-                _kernel_eligible(
-                    cfg, pyr_src_a[level], pyr_flt_a[level], has_coarse,
-                    h, w,
-                )
-                and _feature_table_bytes(h, w, ha, wa)
-                > cfg.feature_bytes_budget
-            )
-        if lean and cfg.pca_dims:
-            import logging
-
-            knob = (
-                "brute_lean_bytes" if cfg.matcher == "brute"
-                else "feature_bytes_budget"
-            )
-            logging.getLogger("image_analogies_tpu").warning(
-                "level %d exceeds %s: lean path matches in full-D bf16 "
-                "space, pca_dims=%s is not applied at this level",
-                level, knob, cfg.pca_dims,
-            )
-
-        prev_kind = (
-            "none" if not has_coarse
-            else ("planes" if isinstance(nnf, tuple) else "stacked")
+        # All dispatch decisions for the level come from the shared
+        # planner (the lean decision must precede assembly — assembly
+        # is what OOMs).
+        plan = plan_level(
+            cfg, level, pyr_src_a[level], pyr_flt_a[level], has_coarse,
+            h, w, prev_nnf=nnf,
         )
-        fa_ext = _fa_external(ha, wa, lean)
         f_a_ext = proj_ext = None
-        if fa_ext:
+        if plan.fa_external:
             f_a_ext, proj_ext = _assemble_fa_fn(cfg, has_coarse)(
                 pyr_src_a[level],
                 pyr_flt_a[level],
                 pyr_src_a[level + 1] if has_coarse else None,
                 pyr_flt_a[level + 1] if has_coarse else None,
             )
-        # Oversized brute levels run unfused (see _SAFE_EXEC_DIST_ELEMS):
-        # one fused execution of their exact search would outlive the
-        # TPU worker's per-execution tolerance.
-        fuse = (
-            cfg.matcher != "brute"
-            or cfg.em_iters * (h * w) * (ha * wa) <= _SAFE_EXEC_DIST_ELEMS
-        )
         run = _level_fn(
-            cfg, level, has_coarse, lean, prev_kind, fa_ext, fuse
+            cfg, level, has_coarse, plan.lean, plan.prev_kind,
+            plan.fa_external, plan.fuse,
         )
         nnf, dist, bp = run(
             pyr_src_a[level],

@@ -29,12 +29,10 @@ from ..models.analogy import (
     _with_steerable,
     make_em_step,
     resume_prologue,
-    upsample_nnf,
 )
-from ..models.patchmatch import random_init
 from ..ops.color import rgb_to_yiq
 from ..ops.features import assemble_features
-from ..ops.pyramid import build_pyramid, upsample
+from ..ops.pyramid import build_pyramid
 from ..ops.remap import luminance_stats
 from .mesh import BATCH_AXIS, batch_sharding, make_mesh, replicated
 
@@ -194,16 +192,16 @@ def _batch_level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
     `prev_kind` ('stacked' | 'planes') is the static layout of the
     incoming coarser level's field, exactly as in the single driver.
 
-    MAINTENANCE NOTE: this mirrors models/analogy._level_fn_cached (the
-    per-frame PRNG streams are bit-identical to the unfused runner's
-    `frame_keys` derivation) — a change to the level body there (state
-    kinds, lean init, plan dispatch, fa_external policy) must be
-    mirrored here; the bodies differ only by jax.vmap wrapping,
-    shardings, and per-frame key derivation.  `fa_external=True` takes
-    the A-side features as arguments, assembled by the same standalone
-    `_assemble_fa_fn` jit the single driver uses for big style pairs
-    (fusing assembly with the EM steps measured 20 GB of HLO temp at
-    2048^2 — models/analogy._SPLIT_ASSEMBLY_BYTES)."""
+    The batch body IS models/analogy's level body: the dispatch
+    decisions come from the shared `plan_level` and the state glue from
+    the shared `_level_state_glue(batched=True)` (per-frame PRNG
+    streams bit-identical to the unfused runner's `frame_keys`
+    derivation); only the vmap wrapping, shardings, and per-frame key
+    derivation live here.  `fa_external=True` takes the A-side features
+    as arguments, assembled by the same standalone `_assemble_fa_fn`
+    jit the single driver uses for big style pairs (fusing assembly
+    with the EM steps measured 20 GB of HLO temp at 2048^2 —
+    models/analogy._SPLIT_ASSEMBLY_BYTES)."""
     mesh = _MESHES[mesh_key]
     shard = batch_sharding(mesh)
     repl = replicated(mesh)
@@ -223,8 +221,6 @@ def _batch_level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
         from ..models.analogy import (
             _level_plan,
             assemble_features_lean,
-            random_init_planes,
-            upsample_nnf_planes,
         )
         from ..ops.pca import fit_and_project
 
@@ -263,30 +259,13 @@ def _batch_level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
                 lambda i: jax.random.fold_in(base_key, i)
             )(frame_idx)
 
-        if has_coarse:
-            if lean:
-                p_py, p_px = (
-                    prev_nnf if prev_kind == "planes"
-                    else (prev_nnf[..., 0], prev_nnf[..., 1])
-                )
-                nnf = jax.vmap(
-                    lambda py, px: upsample_nnf_planes(
-                        py, px, (h, w), ha, wa
-                    )
-                )(p_py, p_px)
-            else:
-                nnf = jax.vmap(
-                    lambda n: upsample_nnf(n, (h, w), ha, wa)
-                )(prev_nnf)
-            flt_bp_coarse = prev_bp
-            flt_bp = jax.vmap(lambda x: upsample(x, (h, w)))(prev_bp)
-        else:
-            init = random_init_planes if lean else random_init
-            nnf = jax.vmap(
-                lambda k: init(k, h, w, ha, wa)
-            )(frame_keys(jax.random.fold_in(level_key, 0x1217)))
-            flt_bp = raw_b_l
-            flt_bp_coarse = flt_bp
+        from ..models.analogy import _level_state_glue
+
+        nnf, flt_bp, flt_bp_coarse = _level_state_glue(
+            lean, prev_kind, prev_nnf, prev_bp, raw_b_l, h, w, ha, wa,
+            frame_keys(jax.random.fold_in(level_key, 0x1217)),
+            batched=True,
+        )
 
         nnf_ax = (0, 0) if lean else 0
         mk_vstep = lambda s: jax.vmap(  # noqa: E731
@@ -508,49 +487,34 @@ def synthesize_batch(
         h, w = pyr_src_b[level].shape[1:3]
         has_coarse = level < levels - 1
 
-        from ..models.analogy import (
-            _assemble_fa_fn,
-            _fa_external,
-            _kernel_eligible,
-        )
+        from ..models.analogy import _assemble_fa_fn, plan_level
 
         ha, wa = pyr_src_a[level].shape[:2]
-        # Lean levels mirror the single driver's rule (the decision must
-        # precede assembly — assembly is what OOMs), with the batch's
-        # per-frame multiplicity in the byte estimate.
-        lean = (
-            _kernel_eligible(
-                cfg, pyr_src_a[level], pyr_flt_a[level], has_coarse, h, w
-            )
-            and _batch_feature_table_bytes(
+        # Shared planner, with the batch's per-frame multiplicity in
+        # the byte estimate and in the brute unfuse rule (the resident
+        # frame count scales every chunk execution's work); brute never
+        # takes the lean-brute path here (the oracle runs per-frame,
+        # frames_per_step=1).
+        plan = plan_level(
+            cfg, level, pyr_src_a[level], pyr_flt_a[level], has_coarse,
+            h, w, prev_nnf=nnf,
+            table_bytes=_batch_feature_table_bytes(
                 frames.shape[0], h, w, ha, wa
-            ) > cfg.feature_bytes_budget
+            ),
+            work_scale=frames.shape[0],
+            brute_lean=False,
         )
-        prev_kind = (
-            "none" if not has_coarse
-            else ("planes" if isinstance(nnf, tuple) else "stacked")
-        )
-        fa_ext = _fa_external(ha, wa, lean)
         f_a_ext = proj_ext = None
-        if fa_ext:
+        if plan.fa_external:
             f_a_ext, proj_ext = _assemble_fa_fn(cfg, has_coarse)(
                 pyr_src_a[level],
                 pyr_flt_a[level],
                 pyr_src_a[level + 1] if has_coarse else None,
                 pyr_flt_a[level + 1] if has_coarse else None,
             )
-        # Oversized brute levels run unfused, mirroring the single
-        # driver (models/analogy._SAFE_EXEC_DIST_ELEMS); the resident
-        # frame count scales the per-execution work.
-        from ..models.analogy import _SAFE_EXEC_DIST_ELEMS
-
-        fuse = (
-            cfg.matcher != "brute"
-            or frames.shape[0] * cfg.em_iters * (h * w) * (ha * wa)
-            <= _SAFE_EXEC_DIST_ELEMS
-        )
         run = _batch_level_fn(
-            cfg, level, has_coarse, token, fa_ext, lean, prev_kind, fuse
+            cfg, level, has_coarse, token, plan.fa_external, plan.lean,
+            plan.prev_kind, plan.fuse,
         )
         nnf, dist, bp = run(
             pyr_src_a[level],
