@@ -55,12 +55,19 @@ level function (`models/analogy._level_fn`) with the A side
 replicated — those levels' A tables are 4^-l of the finest one's, so
 replication there never binds.
 
-Production-hardening note (v1 scope): the full (N_A, D) table and the
-kernel planes are ASSEMBLED unsharded (one jit) before being placed
-band-sharded; assembling each band's slice directly on its owner
-(windowed assembly needs halo rows of the A pyramids) is the remaining
-step for an A side beyond one device's *assembly* headroom, which at
-bf16 sits ~8x past the gather-table wall this runner removes.
+Assembly is band-sharded too (round-5; removes the round-4 "v1 scope"
+ceiling): each device assembles ITS band's slice of the (N_A, D) lean
+table from a halo-extended row slab of the A pyramids
+(`_band_assemble_fn` — `_split_slabs` provides the slab geometry the
+spatial runner proves bit-exact; window reach is covered by
+`slab_halo` rows, and edge clamping matches full assembly because
+boundary slabs ARE the boundary).  Per-device peak during assembly is
+one slab's table + temps (~1/n of the single-chip assembly), so the
+reachable style pair is no longer bounded by one device's assembly
+headroom.  Bit-identity with slicing the full table is pinned by
+tests/test_spatial.py test_sharded_a_band_assembly_matches_full.
+Only the kernel A-planes (raw image planes, ~MBs) are still prepared
+globally before placement — they are not a memory-binding item.
 """
 
 from __future__ import annotations
@@ -75,16 +82,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import SynthConfig
 from ..models.analogy import (
-    _SAFE_EXEC_DIST_ELEMS,
-    _feature_table_bytes,
-    _kernel_eligible,
     _level_fn,
-    _fa_external,
     _assemble_fa_fn,
     _finalize,
     _prologue_fn,
     assemble_features_lean,
     lean_em_step,
+    plan_level,
     random_init_planes,
     upsample_nnf_planes,
 )
@@ -122,6 +126,87 @@ def _sharded_dist(f_b_tab, f_a_shard, row_lo_flat, idx):
     return jax.lax.pmin(
         jnp.where(owned, d_loc, jnp.float32(jnp.inf)), _AXIS
     )
+
+
+def _band_assembly_aligned(ha: int, hc, n_dev: int,
+                           has_coarse: bool) -> bool:
+    """Whether the band-sharded assembly's slab geometry is exact for
+    these shapes.  Beyond ha % n_dev == 0, the COARSE pyramid slabs
+    must land on the same band boundaries: rows-per-band even and the
+    coarse height exactly ha/2 with n_dev dividing it — otherwise
+    `_split_slabs` on the coarse side would offset every non-zero
+    band's coarse rows (silently wrong coarse features, exit 0).
+    Misaligned shapes fall back to global assembly + sharded
+    placement."""
+    if ha % n_dev:
+        return False
+    if not has_coarse:
+        return True
+    rows_pb = ha // n_dev
+    return (
+        rows_pb % 2 == 0
+        and hc is not None
+        and hc * 2 == ha
+        and hc % n_dev == 0
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _band_assemble_fn(cfg: SynthConfig, mesh_key, has_coarse: bool,
+                      n_dev: int):
+    """Band-sharded lean A-table assembly: ONE jitted shard_map call in
+    which each device assembles its own band's (rows/n * wa, D) slice
+    from a halo-extended slab of the A pyramids, so no device ever
+    holds the full table OR the full assembly temps (module docstring;
+    the slab geometry is `_split_slabs`' — bit-exact per the spatial
+    runner's halo contract, pinned by
+    test_sharded_a_band_assembly_matches_full)."""
+    from jax.sharding import PartitionSpec as P
+
+    from .batch import _MESHES
+    from .spatial import _split_slabs, slab_halo
+
+    mesh = _MESHES[mesh_key]
+    halo = slab_halo(cfg)
+
+    def call(src_a, flt_a, src_c=None, flt_c=None):
+        rows_pb = src_a.shape[0] // n_dev
+        wa = src_a.shape[1]
+        slabs = [
+            _split_slabs(src_a, n_dev, halo),
+            _split_slabs(flt_a, n_dev, halo),
+        ]
+        if has_coarse:
+            slabs += [
+                _split_slabs(src_c, n_dev, halo // 2),
+                _split_slabs(flt_c, n_dev, halo // 2),
+            ]
+
+        def body(*bslabs):
+            parts = [s[0] for s in bslabs]
+            s_src, s_flt = parts[0], parts[1]
+            s_src_c = parts[2] if has_coarse else None
+            s_flt_c = parts[3] if has_coarse else None
+            tab = assemble_features_lean(
+                s_src, s_flt, cfg, s_src_c, s_flt_c
+            )
+            d = tab.shape[1]
+            core = tab.reshape(rows_pb + 2 * halo, wa, d)[
+                halo : halo + rows_pb
+            ]
+            return core.reshape(rows_pb * wa, d)
+
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(_AXIS),) * len(slabs),
+            out_specs=P(_AXIS),
+            # assemble_features_lean's fori_loop body carries no
+            # varying-mesh-axes info (same pattern as the level fns).
+            check_vma=False,
+        )(*slabs)
+
+    return jax.jit(call)
 
 
 @functools.lru_cache(maxsize=32)
@@ -289,48 +374,52 @@ def synthesize_sharded_a(
         has_coarse = level < levels - 1
         level_key = jax.random.fold_in(key, level)
 
-        # MAINTENANCE NOTE: this per-level glue (lean decision,
-        # prev_kind, fa_ext, fuse) mirrors create_image_analogy's loop
-        # (models/analogy.py) — a change there must be mirrored here;
-        # the EM bodies themselves are shared (lean_em_step /
-        # _level_fn), only the loop glue is duplicated.
-        lean = (
-            _kernel_eligible(
-                cfg, pyr_src_a[level], pyr_flt_a[level], has_coarse, h, w
-            )
-            and _feature_table_bytes(h, w, ha, wa) > cfg.feature_bytes_budget
+        # All dispatch decisions come from the shared planner
+        # (models/analogy.plan_level); brute never takes the lean-brute
+        # path here, so big-brute levels fall through to the stock
+        # level function's unfuse rule.
+        plan = plan_level(
+            cfg, level, pyr_src_a[level], pyr_flt_a[level], has_coarse,
+            h, w, prev_nnf=nnf, brute_lean=False,
         )
-        if lean and cfg.pca_dims:
-            import logging
-
-            logging.getLogger("image_analogies_tpu").warning(
-                "level %d exceeds feature_bytes_budget: lean path "
-                "matches in full-D bf16 space, pca_dims=%s is not "
-                "applied at this level", level, cfg.pca_dims,
-            )
+        lean = plan.lean
         if lean:
             if ha % n_dev:
                 raise ValueError(
                     f"sharded-A level {level}: A rows ({ha}) must split "
                     f"evenly over {n_dev} devices"
                 )
-            plan = _level_plan(
+            chan_plan = _level_plan(
                 cfg, pyr_src_a[level], pyr_flt_a[level], has_coarse, h, w
             )
-            specs, use_coarse, _ = plan
-            # Assemble the full table/planes once (see the module
-            # docstring's v1 scope note), then place them band-sharded:
-            # from here on each device touches only its shard.
-            f_a_tab = jax.device_put(
-                assemble_features_lean(
-                    pyr_src_a[level],
-                    pyr_flt_a[level],
-                    cfg,
-                    pyr_src_a[level + 1] if has_coarse else None,
-                    pyr_flt_a[level + 1] if has_coarse else None,
-                ),
-                shard,
-            )
+            specs, use_coarse, _ = chan_plan
+            # Band-sharded assembly: each device assembles its own
+            # band's table slice from a halo-extended A-pyramid slab
+            # (module docstring) — no device ever holds the full table
+            # or the full assembly temps.  Shapes whose coarse slabs
+            # would not land on band boundaries fall back to global
+            # assembly + sharded placement (_band_assembly_aligned).
+            hc = pyr_src_a[level + 1].shape[0] if has_coarse else None
+            if _band_assembly_aligned(ha, hc, n_dev, has_coarse):
+                coarse_args = (
+                    (pyr_src_a[level + 1], pyr_flt_a[level + 1])
+                    if has_coarse
+                    else ()
+                )
+                f_a_tab = _band_assemble_fn(
+                    _strip_noncompute(cfg), token, has_coarse, n_dev
+                )(pyr_src_a[level], pyr_flt_a[level], *coarse_args)
+            else:
+                f_a_tab = jax.device_put(
+                    assemble_features_lean(
+                        pyr_src_a[level],
+                        pyr_flt_a[level],
+                        cfg,
+                        pyr_src_a[level + 1] if has_coarse else None,
+                        pyr_flt_a[level + 1] if has_coarse else None,
+                    ),
+                    shard,
+                )
             bands = prepare_a_planes(
                 pyr_src_a[level],
                 pyr_flt_a[level],
@@ -370,28 +459,17 @@ def synthesize_sharded_a(
             )
             nnf = (py, px)
         else:
-            prev_kind = (
-                "none" if not has_coarse
-                else ("planes" if isinstance(nnf, tuple) else "stacked")
-            )
-            fa_ext = _fa_external(ha, wa, False)
             f_a_ext = proj_ext = None
-            if fa_ext:
+            if plan.fa_external:
                 f_a_ext, proj_ext = _assemble_fa_fn(cfg, has_coarse)(
                     pyr_src_a[level],
                     pyr_flt_a[level],
                     pyr_src_a[level + 1] if has_coarse else None,
                     pyr_flt_a[level + 1] if has_coarse else None,
                 )
-            # Same oversized-brute unfuse rule as the single driver
-            # (models/analogy._SAFE_EXEC_DIST_ELEMS).
-            fuse = (
-                cfg.matcher != "brute"
-                or cfg.em_iters * (h * w) * (ha * wa)
-                <= _SAFE_EXEC_DIST_ELEMS
-            )
             run = _level_fn(
-                cfg, level, has_coarse, False, prev_kind, fa_ext, fuse
+                cfg, level, has_coarse, False, plan.prev_kind,
+                plan.fa_external, plan.fuse,
             )
             nnf, dist, bp = run(
                 pyr_src_a[level],

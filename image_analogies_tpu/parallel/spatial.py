@@ -67,21 +67,16 @@ import jax.numpy as jnp
 
 from ..config import SynthConfig
 from ..models.analogy import (
-    _feature_table_bytes,
     _finalize,
-    _kernel_eligible,
+    _level_state_glue,
     _prologue_fn,
     _save_level,
     assemble_features_lean,
     lean_em_step,
-    random_init_planes,
+    plan_level,
     resume_prologue,
-    upsample_nnf,
-    upsample_nnf_planes,
 )
-from ..models.patchmatch import random_init
 from ..ops.features import assemble_features
-from ..ops.pyramid import upsample
 from .batch import (
     _batch_step_fn as _spatial_step_fn,
     _lean_step_fn as _spatial_lean_step_fn,
@@ -327,13 +322,15 @@ def synthesize_spatial(
         # table — so the sharded runner reaches the sizes the
         # single-chip lean path handles, times the mesh (the round-2
         # runner stacked an (H, W, 2) field: 8 GB of lane pad at
-        # 4096^2, exactly the wall it existed to pass).
-        lean = (
-            _kernel_eligible(
-                cfg, f_a_src, pyr_flt_a[level], has_coarse, *slab_shape
-            )
-            and _feature_table_bytes(h, w, ha, wa) > cfg.feature_bytes_budget
+        # 4096^2, exactly the wall it existed to pass).  Decision from
+        # the shared planner: kernel eligibility is planned against the
+        # SLAB the vmapped step will see, the byte estimate against the
+        # global tables.
+        plan = plan_level(
+            cfg, level, f_a_src, pyr_flt_a[level], has_coarse, h, w,
+            prev_nnf=nnf, eligible_shape=slab_shape, brute_lean=False,
         )
+        lean = plan.lean
 
         banded = lean and n_bands > 1
         a_stacked = bounds_stacked = None
@@ -343,35 +340,54 @@ def synthesize_spatial(
                 f"evenly over {n_bands} bands"
             )
         if lean:
-            f_a = assemble_features_lean(
-                f_a_src,
-                pyr_flt_a[level],
-                cfg,
-                pyr_src_a[level + 1] if has_coarse else None,
-                pyr_flt_a[level + 1] if has_coarse else None,
-            )
             proj = None
             if banded:
                 # Band-sharded A side (parallel/sharded_a.py data
                 # path): the lean table's rows and the kernel planes
                 # split into per-device ownership bands over the bands
-                # axis; from here on each device touches only its
-                # shard.  (Assembly itself is unsharded — the same v1
-                # scope note as sharded_a.py.)
+                # axis, and the table is ASSEMBLED band-sharded too —
+                # each band owner assembles its slice from a
+                # halo-extended A-pyramid slab (sharded_a
+                # _band_assemble_fn), so no device holds the full
+                # table or its assembly temps.
                 from jax.sharding import NamedSharding, PartitionSpec as P
                 from ..kernels.patchmatch_tile import (
                     band_bounds,
                     prepare_a_planes,
                 )
-                from ..models.analogy import _level_plan
+                from ..models.analogy import _level_plan, _strip_noncompute
+                from .sharded_a import (
+                    _band_assemble_fn,
+                    _band_assembly_aligned,
+                )
 
                 band_shard = NamedSharding(mesh, P(_BANDS_AXIS))
-                f_a = jax.device_put(f_a, band_shard)
-                plan = _level_plan(
+                hc = pyr_src_a[level + 1].shape[0] if has_coarse else None
+                if _band_assembly_aligned(ha, hc, n_bands, has_coarse):
+                    coarse_args = (
+                        (pyr_src_a[level + 1], pyr_flt_a[level + 1])
+                        if has_coarse
+                        else ()
+                    )
+                    f_a = _band_assemble_fn(
+                        _strip_noncompute(cfg), token, has_coarse, n_bands
+                    )(f_a_src, pyr_flt_a[level], *coarse_args)
+                else:
+                    f_a = jax.device_put(
+                        assemble_features_lean(
+                            f_a_src,
+                            pyr_flt_a[level],
+                            cfg,
+                            pyr_src_a[level + 1] if has_coarse else None,
+                            pyr_flt_a[level + 1] if has_coarse else None,
+                        ),
+                        band_shard,
+                    )
+                chan_plan = _level_plan(
                     cfg, f_a_src, pyr_flt_a[level], has_coarse,
                     *slab_shape,
                 )
-                specs, use_coarse, _ = plan
+                specs, use_coarse, _ = chan_plan
                 bands_p = prepare_a_planes(
                     f_a_src,
                     pyr_flt_a[level],
@@ -383,6 +399,17 @@ def synthesize_spatial(
                 a_stacked = jax.device_put(jnp.stack(bands_p), band_shard)
                 bounds_stacked = jax.device_put(
                     jnp.stack(band_bounds(ha, n_bands)), band_shard
+                )
+            else:
+                # 1-D lean: the A side is replicated (its single-chip
+                # ceiling applies per device by design; the bands axis
+                # is the escape hatch).
+                f_a = assemble_features_lean(
+                    f_a_src,
+                    pyr_flt_a[level],
+                    cfg,
+                    pyr_src_a[level + 1] if has_coarse else None,
+                    pyr_flt_a[level + 1] if has_coarse else None,
                 )
         else:
             f_a = assemble_features(
@@ -404,30 +431,10 @@ def synthesize_spatial(
         )
 
         level_key = jax.random.fold_in(key, level)
-        if has_coarse:
-            if lean:
-                p_py, p_px = (
-                    nnf if isinstance(nnf, tuple)
-                    else (nnf[..., 0], nnf[..., 1])
-                )
-                nnf = upsample_nnf_planes(p_py, p_px, (h, w), ha, wa)
-            elif isinstance(nnf, tuple):
-                uy, ux = upsample_nnf_planes(
-                    nnf[0], nnf[1], (h, w), ha, wa
-                )
-                nnf = jnp.stack([uy, ux], axis=-1)
-            else:
-                nnf = upsample_nnf(nnf, (h, w), ha, wa)
-            flt_bp_coarse_g = flt_bp
-            flt_bp = upsample(flt_bp, (h, w))
-        else:
-            nnf = (
-                random_init_planes(level_key, h, w, ha, wa)
-                if lean
-                else random_init(level_key, h, w, ha, wa)
-            )
-            flt_bp = pyr_raw_b[level]
-            flt_bp_coarse_g = None
+        nnf, flt_bp, flt_bp_coarse_g = _level_state_glue(
+            lean, plan.prev_kind, nnf, flt_bp, pyr_raw_b[level],
+            h, w, ha, wa, level_key,
+        )
 
         # Level-invariant slab views of the match-side images (the
         # coarse B' estimate is frozen for the whole level, so its slab
