@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict, Tuple
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -104,15 +105,33 @@ def candidate_dist_lean(
     (a whole-field (N, 128-lane-padded) gather is 4 GB bf16 at 4096^2,
     on top of the two resident tables).
 
+    `idx` may carry leading CANDIDATE axes — shape (..., N), query row
+    i pairing with idx[..., i] — and the result matches it: the Jacobi
+    polish (models/patchmatch.polish_sweeps_planes) evaluates all ~12
+    candidates of a sweep as ONE (K, N) call, whose per-chunk gather
+    moves K*chunk rows in one `jnp.take` (measured 1.8x cheaper per
+    candidate row than K separate N-row gathers,
+    tools/profile_gather.py — the gather floor is per-call, not
+    per-byte-pattern).
+
     Chunking is a static Python unroll over `lax.slice`s, NOT
     `lax.map`: the map formulation carried (n_chunks, chunk) operands
     whose per-step (1, chunk) slices were laid out lane-minor on the
     unit axis — a 128x padding expansion (measured: ten 512 MB temps
     for 4 MB of data in the fused 2048^2 level graph).  The query rows
-    are CONSECUTIVE (b row i pairs with idx[i]), so the B side is a
-    slice, not a gather — only the A side pays gather cost.  Distances
-    accumulate in f32 regardless of table dtype."""
-    n = idx.shape[0]
+    are CONSECUTIVE along the last axis (b row i pairs with
+    idx[..., i]), so the B side is a slice, not a gather — only the A
+    side pays gather cost.  Distances accumulate in f32 regardless of
+    table dtype."""
+    lead = idx.shape[:-1]
+    n = idx.shape[-1]
+    n_lead = int(np.prod(lead)) if lead else 1
+    idx2 = idx.reshape(n_lead, n)
+    # The chunk bound is a TEMP-SIZE bound: with K leading candidates
+    # every chunk gathers K*chunk rows, so divide the budget by K or
+    # the batched polish would materialize K full-size temps at once —
+    # the exact allocation the chunking exists to prevent.
+    chunk = max(1 << 14, chunk // n_lead)
     # Width comes from the B side: the lean-brute oracle pairs a NARROW
     # B table with the 128-lane-padded A table (models/analogy.py —
     # the pad columns are zeros, so truncating gathered A rows to the
@@ -134,22 +153,24 @@ def candidate_dist_lean(
         end = min(start + chunk, n)
         m = end - start
         m_pad = -(-m // LANES) * LANES
-        ix = jax.lax.slice(idx, (start,), (end,))
+        ix = jax.lax.slice(idx2, (0, start), (n_lead, end))
         rows_b = jax.lax.slice(f_b_tab, (start, 0), (end, d_feat))
         if m_pad != m:
-            ix = jnp.pad(ix, (0, m_pad - m))
+            ix = jnp.pad(ix, ((0, 0), (0, m_pad - m)))
             rows_b = jnp.pad(rows_b, ((0, m_pad - m), (0, 0)))
         rows2 = m_pad // LANES
-        a_rows = jnp.take(f_a_tab, ix, axis=0)
+        a_rows = jnp.take(f_a_tab, ix.reshape(-1), axis=0)
         if a_rows.shape[1] != d_feat:
             a_rows = jax.lax.slice(
                 a_rows, (0, 0), (a_rows.shape[0], d_feat)
             )
-        a3 = a_rows.astype(jnp.float32).reshape(rows2, LANES, d_feat)
-        b3 = rows_b.astype(jnp.float32).reshape(rows2, LANES, d_feat)
-        outs.append(jnp.sum((b3 - a3) ** 2, axis=-1))  # (rows2, LANES)
-    d = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
-    return d.reshape(-1)[:n]
+        a4 = a_rows.astype(jnp.float32).reshape(
+            n_lead, rows2, LANES, d_feat
+        )
+        b3 = rows_b.astype(jnp.float32).reshape(1, rows2, LANES, d_feat)
+        outs.append(jnp.sum((b3 - a4) ** 2, axis=-1))  # (K, rows2, LANES)
+    d = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+    return d.reshape(n_lead, -1)[:, :n].reshape(*lead, n)
 
 
 # ---------------------------------------------------------------------------

@@ -286,16 +286,30 @@ def tile_patchmatch(
         return nnf_m, d_m
     # Per-pixel polish sweeps (propagation + ties canonicalization) on
     # the bf16 accept metric, then one exact f32 re-rank of the final
-    # correspondences (the output contract's dist).
-    nnf_p, d_p = patchmatch_sweeps(
-        f_b16,
-        f_a16,
-        nnf_m,
-        jax.random.fold_in(key, cfg.pm_iters),
-        iters=polish_iters,
-        n_random=cfg.pm_polish_random,
-        coh_factor=coh,
-    )
+    # correspondences (the output contract's dist).  Default: the
+    # batched jump-flooding polish (_POLISH_MODE, 3 gathers/sweep); d_m
+    # is already in the accept metric, so no entry re-evaluation.
+    if _POLISH_MODE == "sequential":
+        nnf_p, d_p = patchmatch_sweeps(
+            f_b16,
+            f_a16,
+            nnf_m,
+            jax.random.fold_in(key, cfg.pm_iters),
+            iters=polish_iters,
+            n_random=cfg.pm_polish_random,
+            coh_factor=coh,
+        )
+    else:
+        nnf_p, d_p = polish_sweeps(
+            f_b16,
+            f_a16,
+            nnf_m,
+            d_m,
+            jax.random.fold_in(key, cfg.pm_iters),
+            iters=polish_iters,
+            n_random=cfg.pm_polish_random,
+            coh_factor=coh,
+        )
     if cfg.kappa > 0.0:
         # Ashikhmin adoption pass — the SAME coherence_sweeps the
         # kappa-aware brute oracle runs (models/coherence.py), on the
@@ -397,6 +411,208 @@ def patchmatch_sweeps_lean(
         sweep, (py, px, dist), jax.random.split(key, iters)
     )
     return py, px, dist
+
+
+# Pure-roll steps of the polish's canonical-tie flood per sweep (4
+# directions each): 16 single-pixel hops lets the lowest-index
+# representative cross tied regions ~2x faster per sweep than the
+# sequential polish's ~8-deep accept chain, for the cost of one extra
+# N-row verification gather (the rolls themselves are VPU-free next to
+# the gathers).
+_TIE_FLOOD_STEPS = 16
+
+# Jump-flooding propagation distances (coarse-to-fine, per sweep): a
+# neighbor at distance s proposes its match shifted by s — so one
+# BATCHED candidate gather reaches as far as an 8-deep sequential
+# accept chain, without any chain.
+_JUMP_STEPS = (8, 4, 2, 1)
+
+# Polish implementation selector (module-level, not a config knob: the
+# choice is a measured performance decision, not user surface).
+# "jump": batched jump-flooding polish (polish_sweeps_planes) — 3
+# gathers per sweep.  "sequential": the chained per-candidate cascade
+# (patchmatch_sweeps/_lean) — 12 gathers per sweep.  The TPU headline
+# A/B (wall + PSNR-vs-oracle over 3 seeds) picks the default; tests
+# may mock.patch it to pin one path.
+_POLISH_MODE = "jump"
+
+
+def _lex_min(d: jnp.ndarray, idx: jnp.ndarray):
+    """Lexicographic (distance, flat-index) argmin over axis 0: the
+    canonical representative `jnp.argmin` picks in the brute oracle —
+    min distance, ties to the lowest flat index."""
+    d_min = jnp.min(d, axis=0)
+    i_min = jnp.min(
+        jnp.where(d == d_min, idx, jnp.iinfo(jnp.int32).max), axis=0
+    )
+    return d_min, i_min
+
+
+def polish_sweeps_planes(
+    py: jnp.ndarray,
+    px: jnp.ndarray,
+    dist: jnp.ndarray,
+    key: jax.Array,
+    *,
+    ha: int,
+    wa: int,
+    iters: int,
+    n_random: int,
+    coh_factor: float,
+    dist_fn,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Batched jump-flooding polish: 3 dist_fn calls per sweep instead
+    of the sequential cascade's 12 single-candidate gathers.
+
+    The gathers ARE the polish's cost (~320 ms of the ~410 ms level-0
+    EM step at 1024^2, tools/profile_phases.py; the per-row rate is a
+    pattern-independent issue floor, but a BATCHED multi-candidate
+    gather is measured 1.8x cheaper per candidate row,
+    tools/profile_gather.py).  Plain Jacobi batching of the sequential
+    polish's candidate set measured ~5 dB below it on the lean-path
+    oracle-tracking content (TestLeanPath) — one-hop accepts lose the
+    sequential chain's propagation depth — so this variant puts the
+    depth INTO THE CANDIDATE SET instead of into a chain.  Per sweep:
+
+      1. JUMP-FLOODING propagation: coherent candidates from neighbors
+         at `_JUMP_STEPS` distances (a neighbor at distance s proposes
+         its match shifted by s — Ashikhmin's r* = s(r) + (q - r) for
+         r at distance s), 4 directions x len(_JUMP_STEPS) scales in
+         ONE batched gather.  Best-of-K by lexicographic (dist, flat
+         idx) — the canonical tie-breaking of the sequential chain's
+         fixed point — accepted against the incumbent at factor 1.
+         Scale combinations give up to 15 px of travel per sweep
+         vs the sequential cascade's ~8-deep chain.
+      2. The `n_random` exponential random-search probes in ONE
+         batched (R, N) gather, best-of-R, accepted under the kappa
+         factor — the kernel's best-coherent-vs-best-approximate
+         merge rule.
+      3. Canonical-tie flooding through flat regions, GATHER-FREE:
+         equal-distance neighbors propose their lower flat index
+         through `_TIE_FLOOD_STEPS` pure-roll steps — in a flat region
+         the neighbor's own distance IS the candidate's distance at
+         this pixel, so distance equality is the flood criterion —
+         then one dist_fn call applies the exact accept rule
+         (better | equal-and-lower-index), reverting any proposal the
+         flat-region assumption got wrong.
+
+    Every accept applies the exact accept rule against the live
+    incumbent, so the output is a member of the same accept family as
+    `patchmatch_sweeps` (canonical ties included); what differs is the
+    proposal mechanism (long-range jumps instead of chained one-hop
+    accepts) and, at kappa > 0, best-of-set random merging instead of
+    the chain's first-survivor — the same trade the band-sharded
+    runner's cross-band pmin makes (parallel/sharded_a.py
+    'Equivalence').  The A/B against the sequential cascade (wall +
+    PSNR over 3 seeds at the headline) picks `_POLISH_MODE`.
+
+    `dist_fn` takes flat indices shaped (..., N) with query rows
+    pairing along the LAST axis (candidate_dist_lean's contract), so
+    the band-sharded masked-pmin hook works unchanged.
+    """
+    h, w = py.shape
+    max_radius = max(ha, wa)
+    radii = [max(1, int(max_radius * (0.5**s))) for s in range(n_random)]
+
+    def sweep(state, it_key):
+        py_c, px_c, d_c = state
+
+        # 1. Jump-flooding propagation: coherent candidates from
+        # neighbors at log-stepped distances (s*delta shifted by
+        # s*delta — Ashikhmin's r* = s(r) + (q - r) for r at distance
+        # s), all in ONE batched gather.  Depth is in the CANDIDATE
+        # SET (up to 15 px of travel per sweep through scale
+        # combinations), not in an accept chain.
+        cys, cxs = [], []
+        for s in _JUMP_STEPS:
+            for dy, dx in _DELTAS:
+                cys.append(
+                    jnp.roll(py_c, (s * dy, s * dx), (0, 1)) + s * dy
+                )
+                cxs.append(
+                    jnp.roll(px_c, (s * dy, s * dx), (0, 1)) + s * dx
+                )
+        n_coh = len(cys)
+        cy = jnp.clip(jnp.stack(cys), 0, ha - 1)
+        cx = jnp.clip(jnp.stack(cxs), 0, wa - 1)
+        idx = cy * wa + cx  # (K, H, W)
+        d_all = dist_fn(idx.reshape(n_coh, h * w)).reshape(idx.shape)
+        i_cur = py_c * wa + px_c
+        d_coh, i_coh = _lex_min(d_all, idx)
+        accept = (d_coh < d_c) | ((d_coh == d_c) & (i_coh < i_cur))
+        d1 = jnp.where(accept, d_coh, d_c)
+        i1 = jnp.where(accept, i_coh, i_cur)
+        py_c, px_c = i1 // wa, i1 % wa
+
+        # 2. Random probes: one batched (R, N) gather, best-of-R.
+        if radii:
+            keys = jax.random.split(it_key, len(radii))
+            cys, cxs = [], []
+            for r, rk in zip(radii, keys):
+                ky, kx = jax.random.split(rk)
+                cys.append(
+                    py_c + jax.random.randint(ky, (h, w), -r, r + 1)
+                )
+                cxs.append(
+                    px_c + jax.random.randint(kx, (h, w), -r, r + 1)
+                )
+            cy = jnp.clip(jnp.stack(cys), 0, ha - 1)
+            cx = jnp.clip(jnp.stack(cxs), 0, wa - 1)
+            idx = cy * wa + cx  # (R, H, W)
+            d_all = dist_fn(idx.reshape(len(cys), h * w)).reshape(idx.shape)
+            d_rnd, i_rnd = _lex_min(d_all, idx)
+            accept = (d_rnd * coh_factor < d1) | (
+                (d_rnd == d1) & (i_rnd < i1)
+            )
+            d1 = jnp.where(accept, d_rnd, d1)
+            i1 = jnp.where(accept, i_rnd, i1)
+
+        # 3. Gather-free canonical-tie flood + one verifying gather.
+        i_prop = i1
+        for _ in range(_TIE_FLOOD_STEPS):
+            for dy, dx in _DELTAS:
+                n_i = jnp.roll(i_prop, (dy, dx), (0, 1))
+                n_d = jnp.roll(d1, (dy, dx), (0, 1))
+                take = (n_d == d1) & (n_i < i_prop)
+                i_prop = jnp.where(take, n_i, i_prop)
+        d_prop = dist_fn(i_prop.reshape(-1)).reshape(h, w)
+        accept = (d_prop < d1) | ((d_prop == d1) & (i_prop < i1))
+        d1 = jnp.where(accept, d_prop, d1)
+        i1 = jnp.where(accept, i_prop, i1)
+        return (i1 // wa, i1 % wa, d1), None
+
+    (py, px, dist), _ = jax.lax.scan(
+        sweep, (py, px, dist), jax.random.split(key, iters)
+    )
+    return py, px, dist
+
+
+def polish_sweeps(
+    f_b16: jnp.ndarray,
+    f_a16: jnp.ndarray,
+    nnf: jnp.ndarray,
+    dist: jnp.ndarray,
+    key: jax.Array,
+    *,
+    iters: int,
+    n_random: int,
+    coh_factor: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """`polish_sweeps_planes` for the stacked-field standard path:
+    flattens the bf16 feature images to lean-shaped tables, carries the
+    field as planes internally, and restacks.  `dist` is the incoming
+    field's distance in the SAME bf16 accept metric (the exact-metric
+    merge's output), so no entry re-evaluation gather is needed."""
+    h, w, d = f_b16.shape
+    ha, wa = f_a16.shape[:2]
+    f_b_tab = f_b16.reshape(-1, d)
+    f_a_tab = f_a16.reshape(-1, d)
+    py, px, dist = polish_sweeps_planes(
+        nnf[..., 0], nnf[..., 1], dist, key, ha=ha, wa=wa, iters=iters,
+        n_random=n_random, coh_factor=coh_factor,
+        dist_fn=lambda idx: candidate_dist_lean(f_b_tab, f_a_tab, idx),
+    )
+    return jnp.stack([py, px], axis=-1), dist
 
 
 def tile_patchmatch_lean(
@@ -523,21 +739,42 @@ def tile_patchmatch_lean(
     better = d_k < dist0
     py_m = jnp.where(better, ky, py)
     px_m = jnp.where(better, kx, px)
+    d_m = jnp.where(better, d_k, dist0)
     if polish_iters == 0:
-        return py_m, px_m, jnp.where(better, d_k, dist0)
-    py_p, px_p, d_p = patchmatch_sweeps_lean(
-        f_b_tab,
-        f_a_tab,
-        py_m,
-        px_m,
-        jax.random.fold_in(key, cfg.pm_iters),
-        ha=ha,
-        wa=wa,
-        iters=polish_iters,
-        n_random=cfg.pm_polish_random,
-        coh_factor=coh,
-        dist_fn=dist_fn,
-    )
+        return py_m, px_m, d_m
+    # Batched jump-flooding polish (3 dist_fn calls per sweep —
+    # polish_sweeps_planes; _POLISH_MODE selects the sequential cascade
+    # instead); d_m is already in the accept metric, so no entry
+    # re-evaluation is needed.  The sharded dist_fn hook works
+    # unchanged: candidate indices arrive (K, N) with query rows
+    # pairing along the last axis.
+    if _POLISH_MODE == "sequential":
+        py_p, px_p, d_p = patchmatch_sweeps_lean(
+            f_b_tab,
+            f_a_tab,
+            py_m,
+            px_m,
+            jax.random.fold_in(key, cfg.pm_iters),
+            ha=ha,
+            wa=wa,
+            iters=polish_iters,
+            n_random=cfg.pm_polish_random,
+            coh_factor=coh,
+            dist_fn=dist_fn,
+        )
+    else:
+        py_p, px_p, d_p = polish_sweeps_planes(
+            py_m,
+            px_m,
+            d_m,
+            jax.random.fold_in(key, cfg.pm_iters),
+            ha=ha,
+            wa=wa,
+            iters=polish_iters,
+            n_random=cfg.pm_polish_random,
+            coh_factor=coh,
+            dist_fn=dist_fn,
+        )
     if cfg.kappa > 0.0:
         # Ashikhmin adoption pass on the plane-pair field — the same
         # rule tile_patchmatch runs after ITS polish (the kappa-aware
