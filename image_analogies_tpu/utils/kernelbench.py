@@ -115,3 +115,101 @@ def sweep_time_ms(cfg, size: int, iters: int = 16):
     t_n = timed(iters)
     t_2n = timed(2 * iters)
     return (t_2n - t_n) / iters * 1000, meta
+
+
+def sweep_time_device_loop_ms(cfg, size: int, iters: int = 24,
+                              reps: int = 5):
+    """Steady-state ms per full sweep with the iteration loop ON DEVICE
+    — the round-5 replacement for `sweep_time_ms` as the published
+    figure (VERDICT r4: one committed run reported an HBM roofline
+    fraction of 1.159, physically impossible; host-differenced timing
+    is contaminated when a tunnel stall lands inside the t_n window and
+    SUBTRACTS from the difference).
+
+    Three defenses, in order of importance:
+      1. `lax.fori_loop` runs N sweeps as ONE device execution, so
+         per-iteration dispatch/queue effects cannot enter the number
+         at all — the only host cost is one tunnel round trip.
+      2. N and 2N executions are timed separately, each taking the MIN
+         over `reps` runs (stalls only ever ADD time, so min is the
+         clean-run estimator), and the mins are differenced to cancel
+         the round trip.
+      3. The loop-carried state makes each iteration depend on the
+         last, so XLA cannot elide or overlap iterations.
+
+    Returns (ms_per_sweep, meta) or None when kernel-ineligible."""
+    setup = sweep_setup(cfg, size)
+    if setup is None:
+        return None
+    one_iter, s0, meta = setup
+
+    def make_run(n):
+        return jax.jit(
+            lambda s: jax.lax.fori_loop(
+                0, n, lambda _, st: one_iter(*st), s
+            )
+        )
+
+    run_n, run_2n = make_run(iters), make_run(2 * iters)
+    sync(run_n(s0)[2])  # compile + warm
+    sync(run_2n(s0)[2])
+
+    def best_of(run):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            sync(run(s0)[2])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_n = best_of(run_n)
+    t_2n = best_of(run_2n)
+    return (t_2n - t_n) / iters * 1000, meta
+
+
+def sweep_time_trace_ms(cfg, size: int, iters: int = 16,
+                        trace_dir: str = None):
+    """Device-trace-derived ms per sweep: run `iters` sweeps inside
+    `jax.profiler.trace` (compiles warmed beforehand) and read the
+    device plane's total op busy time from the xplane files
+    (utils/xplane.py — no TensorBoard dependency).  This is the
+    instrument-grade number: pure on-device execution time, immune to
+    host clocks, tunnel stalls, and dispatch overhead entirely.
+
+    Returns (ms_per_sweep, meta, {op_name: total_ms}) or None when the
+    geometry is kernel-ineligible OR the backend does not forward
+    device traces (a tunnelled PJRT plugin may not) — callers fall
+    back to `sweep_time_device_loop_ms`."""
+    import shutil
+    import tempfile
+
+    from .xplane import device_op_totals
+
+    setup = sweep_setup(cfg, size)
+    if setup is None:
+        return None
+    one_iter, s0, meta = setup
+    s = one_iter(*s0)
+    sync(s[2])  # warm/compile outside the trace window
+    d = trace_dir or tempfile.mkdtemp(prefix="kernelbench_trace_")
+    try:
+        with jax.profiler.trace(d):
+            for _ in range(iters):
+                s = one_iter(*s)
+            sync(s[2])
+        totals = device_op_totals(d)
+    finally:
+        if trace_dir is None:
+            shutil.rmtree(d, ignore_errors=True)
+    if not totals:
+        return None
+    per_op: dict = {}
+    for ops in totals.values():
+        for name, ms in ops.items():
+            per_op[name] = per_op.get(name, 0.0) + ms
+    busy = sum(per_op.values())
+    if busy <= 0.0:
+        # Device plane present but no op timeline matched the filter —
+        # treat as "traces not forwarded" rather than publishing 0 ms.
+        return None
+    return busy / iters, meta, per_op

@@ -1,0 +1,192 @@
+"""Minimal XPlane (jax.profiler / XProf) trace reader (SURVEY.md §5).
+
+`jax.profiler.trace` writes protobuf `*.xplane.pb` files (TF XSpace
+schema) that normally need TensorBoard's profile plugin to read; this
+module decodes just enough of the wire format to answer the question
+the kernel bench needs: *how long did the device actually run each
+op?* — without any TensorFlow dependency (not in this image).
+
+Wire schema decoded (tensorflow/core/profiler/protobuf/xplane.proto,
+stable field numbers):
+
+    XSpace  { repeated XPlane planes = 1; }
+    XPlane  { int64 id = 1; string name = 2; repeated XLine lines = 3;
+              map<int64, XEventMetadata> event_metadata = 4; }
+    XLine   { int64 id = 1; string name = 2; int64 timestamp_ns = 3;
+              repeated XEvent events = 4; int64 duration_ps = 9;
+              int64 display_id = 10; string display_name = 11; }
+    XEvent  { int64 metadata_id = 1; int64 offset_ps = 2;
+              int64 duration_ps = 3; repeated XStat stats = 4; }
+    XEventMetadata { int64 id = 1; string name = 2;
+                     string display_name = 3; }
+
+Unknown fields are skipped by wire type, so schema additions are
+harmless.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Iterator, Optional, Tuple
+
+_WIRE_VARINT = 0
+_WIRE_I64 = 1
+_WIRE_LEN = 2
+_WIRE_I32 = 5
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over a message buffer.
+    LEN fields yield the raw bytes; varints the int; fixed widths the
+    raw little-endian bytes (unused here)."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == _WIRE_VARINT:
+            val, pos = _read_varint(buf, pos)
+        elif wire == _WIRE_LEN:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos : pos + ln]
+            pos += ln
+        elif wire == _WIRE_I64:
+            val = buf[pos : pos + 8]
+            pos += 8
+        elif wire == _WIRE_I32:
+            val = buf[pos : pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _parse_event(buf: bytes) -> Tuple[int, int]:
+    """(metadata_id, duration_ps)."""
+    mid = dur = 0
+    for field, _w, val in _fields(buf):
+        if field == 1:
+            mid = val
+        elif field == 3:
+            dur = val
+    return mid, dur
+
+
+def _parse_line(buf: bytes):
+    """(name, [(metadata_id, duration_ps)])."""
+    name = disp = ""
+    events = []
+    for field, _w, val in _fields(buf):
+        if field == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == 11 and val:
+            disp = val.decode("utf-8", "replace")
+        elif field == 4:
+            events.append(_parse_event(val))
+    return disp or name, events
+
+
+def _parse_metadata_entry(buf: bytes) -> Tuple[int, str]:
+    """map<int64, XEventMetadata> entry -> (id, name)."""
+    mid = 0
+    name = ""
+    for field, _w, val in _fields(buf):
+        if field == 1:
+            mid = val
+        elif field == 2:
+            for f2, _w2, v2 in _fields(val):
+                if f2 == 2 and not name:
+                    name = v2.decode("utf-8", "replace")
+                elif f2 == 3 and v2:
+                    name = v2.decode("utf-8", "replace")
+    return mid, name
+
+
+def _parse_plane(buf: bytes):
+    """(name, {metadata_id: name}, [(line_name, events)])."""
+    name = ""
+    meta: Dict[int, str] = {}
+    lines = []
+    for field, _w, val in _fields(buf):
+        if field == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == 3:
+            lines.append(_parse_line(val))
+        elif field == 4:
+            mid, mname = _parse_metadata_entry(val)
+            meta[mid] = mname
+    return name, meta, lines
+
+
+def parse_xspace(path: str):
+    """[(plane_name, {metadata_id: name}, [(line_name, events)])]."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    planes = []
+    for field, _w, val in _fields(buf):
+        if field == 1:
+            planes.append(_parse_plane(val))
+    return planes
+
+
+def find_xplane_files(trace_dir: str):
+    """All *.xplane.pb under a jax.profiler.trace output directory."""
+    return sorted(
+        glob.glob(
+            os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+        )
+    )
+
+
+def device_op_totals(
+    trace_dir: str, line_filter: Optional[str] = "XLA Ops"
+) -> Dict[str, Dict[str, float]]:
+    """Per-op total device time in ms, per device plane.
+
+    Returns {plane_name: {op_name: total_ms}} for planes that look like
+    accelerator devices (name contains 'TPU' or 'GPU', or '/device:'
+    but not 'CPU'/'Host').  `line_filter` selects the op-level timeline
+    (the 'XLA Ops' line on TPU planes; pass None to sum every line —
+    beware module/op double counting)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for path in find_xplane_files(trace_dir):
+        for pname, meta, lines in parse_xspace(path):
+            lname = pname.lower()
+            is_dev = ("tpu" in lname or "gpu" in lname) or (
+                "/device:" in lname
+                and "cpu" not in lname
+                and "host" not in lname
+            )
+            if not is_dev:
+                continue
+            ops = out.setdefault(pname, {})
+            for line_name, events in lines:
+                if line_filter is not None and line_filter not in line_name:
+                    continue
+                for mid, dur_ps in events:
+                    name = meta.get(mid, f"op_{mid}")
+                    ops[name] = ops.get(name, 0.0) + dur_ps / 1e9
+    return out
+
+
+def device_busy_ms(trace_dir: str) -> Optional[float]:
+    """Total device op time (ms) summed over accelerator planes' op
+    timelines, or None when the trace carries no device plane (a
+    tunnelled PJRT backend may not forward device traces)."""
+    totals = device_op_totals(trace_dir)
+    if not totals:
+        return None
+    return sum(sum(ops.values()) for ops in totals.values())
