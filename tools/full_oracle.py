@@ -112,13 +112,32 @@ def _cached_run(name: str, size: int, matcher: str, **kw):
                     "(e.g. 3e11)"
                 )
 
-        def beat_chunk(*a2, **k2):
+        def _beat(tag):
             try:
                 with open(hb, "w") as f:
-                    f.write(str(time.time()))
+                    f.write(f"{time.time()} {tag}")
             except OSError:
                 pass
-            return real_chunk(*a2, **k2)
+
+        def beat_chunk(fb_chunk, fa, *a2, **k2):
+            # Round-5 wedge hunt: the oracle's first level-0 chunk
+            # wedged (client asleep, 0 CPU) while the SAME kernel
+            # shapes ran fine as isolated probes (probe_nn_wedge.py —
+            # 9.4M x 98k at 23.6 s OK), so the suspect is the eager
+            # dispatch pipeline: dozens of queued executions (table
+            # assembly + slices + kernels) in flight through the
+            # tunnel at once.  Sync HARD on the A table before the
+            # first search dispatch and on every chunk's result after
+            # it — bounds the in-flight queue to ~1 execution and, via
+            # the heartbeat tag, localizes any remaining wedge
+            # (assembly vs search).
+            _beat("pre-sync-fa")
+            float(jnp.sum(fa[0, :1]))
+            _beat("chunk-dispatch")
+            out = real_chunk(fb_chunk, fa, *a2, **k2)
+            float(jnp.asarray(out[0][0, 0]))
+            _beat("chunk-done")
+            return out
 
         with mock.patch.object(nb, "exact_nn_pallas", big_tiles), \
                 mock.patch.object(nb, "_nn_chunk_call", beat_chunk):

@@ -1,0 +1,52 @@
+"""Probe: at what (n_a, n_b) does the exact-NN kernel execution wedge?
+
+Round-5 wedge hunt.  The 3072^2 lean-brute oracle's first level-0
+search chunk wedges (client asleep, 0 CPU) while 8 GB allocations and
+multi-GB assembly executions complete fine — so the damage is specific
+to the exact-NN kernel execution shape.  Run ONE shape per process
+(isolation: a wedged session must not poison the next probe):
+
+    python tools/probe_nn_wedge.py N_A N_B [tq] [ta]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax.numpy as jnp
+
+from image_analogies_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+from image_analogies_tpu.kernels.nn_brute import exact_nn_pallas
+
+
+def main():
+    n_a = int(float(sys.argv[1]))
+    n_b = int(float(sys.argv[2]))
+    tq = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
+    ta = int(sys.argv[4]) if len(sys.argv) > 4 else 256
+    d = 128
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    f_a = jnp.asarray(rng.random((n_a, d), np.float32), jnp.bfloat16)
+    f_b = jnp.asarray(rng.random((n_b, d), np.float32), jnp.bfloat16)
+    float(f_a[0, 0]); float(f_b[0, 0])
+    print(f"tables up at {round(time.time()-t0,1)}s", flush=True)
+    t0 = time.time()
+    idx, dist = exact_nn_pallas(
+        f_b, f_a, match_dtype=jnp.bfloat16, interpret=False, tq=tq, ta=ta
+    )
+    s = float(dist.sum())
+    print(
+        f"OK n_a={n_a} n_b={n_b} tq={tq} ta={ta} "
+        f"wall={round(time.time()-t0,1)}s sum={s}", flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
