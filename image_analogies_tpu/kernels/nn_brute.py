@@ -189,6 +189,17 @@ def exact_nn_pallas(
     while n_a // ta > _MAX_GRID_DIM and tq >= 16:
         ta *= 2
         tq = max(tq // 2, 8)
+    if n_a // ta > _MAX_GRID_DIM:
+        # ADVICE r4: the rescale loop exits once tq bottoms out, so an
+        # extreme N_A (~8e8+ rows at default tiles) could still land
+        # the A-axis grid on the 2^16 wedge boundary — fail loudly
+        # instead of hanging the worker session.
+        raise ValueError(
+            f"exact_nn_pallas: A-axis grid {n_a // ta} exceeds the "
+            f"{_MAX_GRID_DIM} wedge cap even at ta={ta} (N_A={n_a}); "
+            "split the A table (lean-brute B-banding splits B, not A) "
+            "or pass a larger ta explicitly"
+        )
 
     # Pad D to lanes, N_B/N_A to tile multiples.  Pads and casts are
     # conditional: when the caller's tables are already tile-shaped and

@@ -329,18 +329,37 @@ def lean_brute_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
             **tiles,
         )
 
+    def _drain(x):
+        """Scalar-readback barrier between the eager oracle path's big
+        executions: the axon tunnel wedges when many large executions
+        queue async (round-5 wedge hunt, tools/full_oracle.py
+        beat_chunk) — the search chunks are synced by the oracle's
+        heartbeat hook, but the inter-phase work (band assembly,
+        concat, render) must not pile up either.  Walls don't matter
+        on this path (it exists for the exact oracle, not production
+        synthesis), so the lost overlap is free correctness.
+
+        Under a FUSED lean-brute level (small distance work,
+        plan.fuse=True) this body runs inside jit where a readback is
+        both impossible (tracer) and meaningless (one execution) — so
+        tracers pass through."""
+        if not isinstance(x, jax.core.Tracer):
+            float(jnp.asarray(x).ravel()[0])  # readback: the reliable
+        return x                              # barrier on this platform
+
     if n_b == 1:
-        idx, dist = search(band_table(0, h))
+        idx, dist = search(_drain(band_table(0, h)))
     else:
         idx_parts, dist_parts = [], []
         for i in range(n_b):
             idx_i, dist_i = search(
-                band_table(i * band_rows, (i + 1) * band_rows)
+                _drain(band_table(i * band_rows, (i + 1) * band_rows))
             )
             idx_parts.append(idx_i)
             dist_parts.append(dist_i)
         idx = jnp.concatenate(idx_parts, axis=0)
         dist = jnp.concatenate(dist_parts, axis=0)
+    _drain(idx)
     py = (idx // wa).reshape(h, w)
     px = (idx % wa).reshape(h, w)
     dist = dist.reshape(h, w)

@@ -108,9 +108,9 @@ def test_spatial_engages_pallas_kernel(rng):
         )
     a = ((a - a.min()) / (a.max() - a.min())).astype(np.float32)
     ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
-    b = np.concatenate(
-        [a, np.flipud(a), a[:, ::-1], a], axis=0
-    ).astype(np.float32)
+    # 2 stacked transforms (256 rows): half the interpret-kernel wall
+    # of the old 4-stack; exact matches still exist for every B row.
+    b = np.concatenate([a, np.flipud(a)], axis=0).astype(np.float32)
     cfg = SynthConfig(
         levels=1, matcher="patchmatch", pallas_mode="interpret",
         em_iters=1, pm_iters=2,
@@ -123,7 +123,9 @@ def test_spatial_engages_pallas_kernel(rng):
         return real_sweep(*args, **kw)
 
     with mock.patch.object(pt, "tile_sweep", counting_sweep):
-        sharded = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(4)))
+        # mesh(2): two 128-row slabs — the smallest kernel-eligible slab
+        # with the 2-stack content.
+        sharded = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(2)))
     assert calls, "the Pallas tile kernel was never traced on the spatial path"
     assert sharded.shape == b.shape
     assert np.isfinite(sharded).all()
@@ -283,9 +285,9 @@ def test_spatial_lean_composes_with_lean_path(rng):
         )
     a = ((a - a.min()) / (a.max() - a.min())).astype(np.float32)
     ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
-    b = np.concatenate(
-        [a, np.flipud(a), a[:, ::-1], a], axis=0
-    ).astype(np.float32)
+    # 2 stacked transforms (256 rows): half the interpret-kernel wall
+    # of the old 4-stack; exact matches still exist for every B row.
+    b = np.concatenate([a, np.flipud(a)], axis=0).astype(np.float32)
     cfg = SynthConfig(
         levels=1, matcher="patchmatch", pallas_mode="interpret",
         em_iters=1, pm_iters=2,
@@ -300,8 +302,10 @@ def test_spatial_lean_composes_with_lean_path(rng):
         return real(*args, **kw)
 
     with mock.patch.object(pm_mod, "tile_patchmatch_lean", counting):
+        # mesh(2): two 128-row slabs — the smallest kernel-eligible slab
+        # with the 2-stack content.
         sharded = np.asarray(
-            synthesize_spatial(a, ap, b, cfg, make_mesh(4))
+            synthesize_spatial(a, ap, b, cfg, make_mesh(2))
         )
     assert lean_calls, "spatial runner never took the lean step"
     assert sharded.shape == b.shape
@@ -329,7 +333,7 @@ def test_spatial_lean_checkpoint_roundtrip(rng, tmp_path):
     b = np.concatenate([a, a[:, ::-1]], axis=0).astype(np.float32)
     cfg = SynthConfig(
         levels=1, matcher="patchmatch", pallas_mode="interpret",
-        em_iters=1, pm_iters=2, feature_bytes_budget=1,
+        em_iters=1, pm_iters=1, feature_bytes_budget=1,
         save_level_artifacts=str(tmp_path / "ck"),
     )
     full = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(2)))
@@ -362,6 +366,10 @@ def test_sharded_a_runner_bit_identical_to_single_device(rng):
     a = base
     ap = np.clip(base * 0.6 + 0.3, 0, 1).astype(np.float32)
     b = np.roll(base, 17, axis=0)
+    # em_iters=2 x pm_iters=2 deliberately: this is the ONE test that
+    # pins the full combination (state carried from a prior EM step
+    # into a multi-iteration banded sweep) — the other sharded tests
+    # trim to em or pm = 1 and cite this one.
     cfg = SynthConfig(
         levels=2, matcher="patchmatch", em_iters=2, pm_iters=2,
         feature_bytes_budget=1, pallas_mode="interpret",
@@ -513,9 +521,12 @@ def test_spatial_2d_bands_bit_identical_to_1d(rng):
     a = rng.random((128, 128)).astype(np.float32)
     ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
     b = np.concatenate([a, a[:, ::-1]], axis=0).astype(np.float32)
+    # em_iters=1: the em-chain bit-identity is pinned at em2 by
+    # test_sharded_a_runner_bit_identical_to_single_device; this test
+    # pins the 2-D banding, which one EM step exercises fully.
     cfg = SynthConfig(
         levels=1, matcher="patchmatch", pallas_mode="interpret",
-        em_iters=2, pm_iters=2, feature_bytes_budget=1,
+        em_iters=1, pm_iters=2, feature_bytes_budget=1,
     )
     out_1d = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(2)))
 
@@ -558,7 +569,7 @@ def test_spatial_2d_kappa_same_accept_family(rng):
     b = np.concatenate([np.flipud(a), a], axis=0).astype(np.float32)
     cfg = SynthConfig(
         levels=1, matcher="patchmatch", pallas_mode="interpret",
-        em_iters=1, pm_iters=2, feature_bytes_budget=1, kappa=5.0,
+        em_iters=1, pm_iters=1, feature_bytes_budget=1, kappa=5.0,
     )
     out_1d = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(2)))
     mesh2d = make_mesh(4, axis_names=("bands", "slabs"), shape=(2, 2))
@@ -590,7 +601,7 @@ def test_sharded_a_checkpoint_roundtrip(rng, tmp_path):
     b = np.roll(a, 17, axis=0)
     mesh = make_mesh(2, axis_names=("bands",))
     cfg = SynthConfig(
-        levels=2, matcher="patchmatch", em_iters=1, pm_iters=2,
+        levels=2, matcher="patchmatch", em_iters=1, pm_iters=1,
         feature_bytes_budget=1, pallas_mode="interpret",
         save_level_artifacts=str(tmp_path / "ck"),
     )

@@ -1,0 +1,111 @@
+"""Measured probe: per-band lean A-table assembly peak memory.
+
+VERDICT r4 task 7 asks for the band-sharded assembly's memory claim to
+be MEASURED, not asserted: with n bands, each device assembles only a
+halo-extended slab, so its peak assembly footprint should be ~1/n of
+the single-device full assembly (plus the halo overhead and the
+resident band slice).
+
+Only one real chip exists here, so the probe measures the per-device
+work directly: assemble the FULL table at `size`, then assemble ONE
+band's slab (rows/n + 2*halo rows) — exactly the computation
+`parallel/sharded_a._band_assemble_fn` runs per device — and compare
+`peak_bytes_in_use` from the device's allocator stats, resetting the
+peak between phases via a fresh process run per phase (allocator peaks
+are monotonic within a process).
+
+    python tools/probe_band_assembly.py 2048 8      # one phase per call
+    python tools/probe_band_assembly.py 2048 8 full
+    python tools/probe_band_assembly.py 2048 8 band
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _measure(size: int, n_bands: int, phase: str) -> dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from image_analogies_tpu.config import SynthConfig
+    from image_analogies_tpu.models.analogy import assemble_features_lean
+    from image_analogies_tpu.parallel.spatial import slab_halo
+    from image_analogies_tpu.utils.cache import enable_compilation_cache
+    from image_analogies_tpu.utils.kernelbench import sync
+
+    enable_compilation_cache()
+    cfg = SynthConfig()
+    halo = slab_halo(cfg)
+    rng = np.random.default_rng(0)
+    if phase == "full":
+        rows = size
+        rows_c = size // 2
+    else:
+        rows = size // n_bands + 2 * halo
+        rows_c = size // (2 * n_bands) + halo
+    src = jnp.asarray(rng.random((rows, size), np.float32))
+    flt = jnp.asarray(rng.random((rows, size), np.float32))
+    src_c = jnp.asarray(rng.random((rows_c, size // 2), np.float32))
+    flt_c = jnp.asarray(rng.random((rows_c, size // 2), np.float32))
+    for x in (src, flt, src_c, flt_c):
+        sync(x)
+    dev = jax.devices()[0]
+    base = (dev.memory_stats() or {}).get("peak_bytes_in_use", 0)
+    tab = jax.jit(
+        lambda *a: assemble_features_lean(a[0], a[1], cfg, a[2], a[3])
+    )(src, flt, src_c, flt_c)
+    sync(tab)
+    stats = dev.memory_stats() or {}
+    return {
+        "phase": phase,
+        "rows": int(rows),
+        "table_shape": [int(s) for s in tab.shape],
+        "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", -1)),
+        "peak_before_mb": round(base / 1e6, 1),
+        "peak_after_mb": round(stats.get("peak_bytes_in_use", -1) / 1e6, 1),
+    }
+
+
+def main():
+    size = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    n_bands = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    if len(sys.argv) > 3:
+        print(json.dumps(_measure(size, n_bands, sys.argv[3])), flush=True)
+        return
+    # Driver mode: one fresh process per phase so allocator peaks are
+    # independent.
+    out = {}
+    for phase in ("full", "band"):
+        res = subprocess.run(
+            [sys.executable, __file__, str(size), str(n_bands), phase],
+            capture_output=True, text=True,
+        )
+        if res.returncode != 0 or not res.stdout.strip():
+            sys.stderr.write(res.stderr)
+            raise SystemExit(
+                f"phase {phase!r} failed (rc={res.returncode}); "
+                "stderr above"
+            )
+        line = res.stdout.strip().splitlines()[-1]
+        out[phase] = json.loads(line)
+    ratio = (
+        out["band"]["peak_after_mb"] / out["full"]["peak_after_mb"]
+        if out["full"]["peak_after_mb"] > 0 else None
+    )
+    print(json.dumps({
+        "size": size,
+        "n_bands": n_bands,
+        "full_peak_mb": out["full"]["peak_after_mb"],
+        "band_peak_mb": out["band"]["peak_after_mb"],
+        "band_over_full": round(ratio, 3) if ratio else None,
+        "ideal": round(1 / n_bands, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
