@@ -182,25 +182,31 @@ def validate():
 
 def full(size: int):
     pm, pm_info = _cached_run(f"pm_{size}", size, "patchmatch", pm_iters=6)
-    # >= 3072: force the lean-brute oracle at EVERY level.  Not only is
-    # the f32 path's table pair (2 x 4.8 GB at 3072^2) past what the
-    # worker reliably grants — executions whose footprint approaches
-    # the pool don't fail, they WAIT forever (the wedge the heartbeat
-    # watchdog exists for), so the oracle runs at the smallest
-    # footprint that preserves exactness: bf16 lean tables (the metric
-    # the production path matches in at these sizes; cross-validated
-    # at 1024^2, `validate`).
-    kw = {"brute_lean_bytes": 1} if size >= 3072 else {}
+    # 3072: force the lean-brute oracle at EVERY level (the f32 path's
+    # table pair, 2 x 4.8 GB, approaches what the worker grants; its
+    # recorded checkpoints were written under this cfg and resumed to
+    # completion — 38.06 dB, round 5).  4096: DEFAULT budget — its
+    # round-4 checkpoints (levels 5-1) were computed at the default
+    # (exact f32 oracle at the sub-wall levels, the stricter metric;
+    # lean-brute at levels 0-1 by the byte rule), so the default cfg
+    # resumes them instead of recomputing ~30 min of pyramid; level 0
+    # is lean-brute either way.
+    kw = {"brute_lean_bytes": 1} if size == 3072 else {}
     # Distinct cache names per oracle mode: a default-config run at a
     # sub-3072 size runs the f32 path and must not collide with (or
     # mislabel itself as) a forced-lean run.
-    name = f"oracle_lean_{size}" if kw else f"oracle_f32_{size}"
+    name = f"oracle_lean_{size}" if size >= 3072 else f"oracle_f32_{size}"
     oracle, o_info = _cached_run(name, size, "brute", **kw)
     print(json.dumps({
         "size": size,
         "oracle": (
             "lean-brute (exact NN over bf16 lean tables)" if kw
-            else "brute (exact NN, f32 tables)"
+            else (
+                "brute (exact NN; f32 tables at sub-wall levels, "
+                "bf16 lean tables past the byte rule)"
+                if size >= 3072
+                else "brute (exact NN, f32 tables)"
+            )
         ),
         "psnr_vs_full_oracle_db": round(psnr(pm, oracle), 2),
         "oracle_wall_s": o_info["wall_s"],
