@@ -1,10 +1,14 @@
 """PSNR-vs-kappa curves: kernel PatchMatch path vs the kappa-aware brute
-oracle (VERDICT r3 task 3).
+oracle (VERDICT r3 task 3; r4 weak 5 adds the NPR content family).
 
-Runs the artistic-filter pair at 512^2 for kappa in {0, 2, 5}, measuring
-PSNR of the kernel-path output against the CoherenceWrapper(brute)
-oracle — the exact acceptance metric BENCH's configs 2/5 use.  Prints
-one JSON line; run on the TPU backend.
+Runs a content pair for kappa in {0, 2, 5}, measuring PSNR of the
+kernel-path output against the CoherenceWrapper(brute) oracle — the
+exact acceptance metric BENCH's configs 2/5 use.  Prints one JSON
+line; run on the TPU backend.
+
+    python tools/kappa_curves.py 512            # artistic_filter
+    python tools/kappa_curves.py 1024 npr       # config 5's own
+                                                # content family/scale
 """
 
 import json
@@ -22,11 +26,18 @@ from image_analogies_tpu.utils.cache import enable_compilation_cache
 enable_compilation_cache()
 
 from image_analogies_tpu import SynthConfig, create_image_analogy, psnr
-from image_analogies_tpu.utils.examples import artistic_filter
+from image_analogies_tpu.utils.examples import artistic_filter, npr_frames
 
 
-def main(size: int = 512):
-    a_h, ap_h, b_h = artistic_filter(size)
+def main(size: int = 512, family: str = "artistic"):
+    if family == "npr":
+        # Config 5's own content: the style pair + ONE representative
+        # frame of the NPR stack (the batch runner's per-frame synthesis
+        # is exactly this computation; kappa acts per frame).
+        a_h, ap_h, frames = npr_frames(n_frames=1, size=size)
+        b_h = np.asarray(frames)[0]
+    else:
+        a_h, ap_h, b_h = artistic_filter(size)
     a = jnp.asarray(a_h, jnp.float32)
     ap = jnp.asarray(ap_h, jnp.float32)
     b = jnp.asarray(b_h, jnp.float32)
@@ -52,8 +63,11 @@ def main(size: int = 512):
                 "wall_s": round(time.perf_counter() - t0, 3),
             }
         )
-    print(json.dumps({"size": size, "curves": rows}))
+    print(json.dumps({"size": size, "family": family, "curves": rows}))
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 512)
+    main(
+        int(sys.argv[1]) if len(sys.argv) > 1 else 512,
+        sys.argv[2] if len(sys.argv) > 2 else "artistic",
+    )

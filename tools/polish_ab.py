@@ -1,0 +1,140 @@
+"""Headline A/B: jump-flooding polish vs the sequential cascade.
+
+Round-5 decision gate for `models/patchmatch._POLISH_MODE`: at the
+headline schedule (1024^2 super-resolution, 5 levels, em_iters=2,
+pm_iters=6, pm_polish_iters=1) measure BOTH polish implementations'
+
+  - steady-state wall (median of 5, device-resident inputs, scalar-
+    readback barrier — bench.py's protocol), and
+  - PSNR vs the exact-NN brute oracle over 3 seeds (the oracle is
+    seed-independent and runs once),
+
+plus the level-0 wall from a progress-instrumented run (the polish is
+a level-0 cost).  Prints one JSON line; the winner becomes the default
+and README's 'polish restructure' section quotes this run.
+
+    python tools/polish_ab.py [size]
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax.numpy as jnp
+
+from image_analogies_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+from image_analogies_tpu import SynthConfig, create_image_analogy, psnr
+from image_analogies_tpu.utils.examples import super_resolution
+from image_analogies_tpu.utils.kernelbench import sync as _sync
+from image_analogies_tpu.utils.progress import ProgressWriter
+
+
+def _clear_caches():
+    import image_analogies_tpu.models.analogy as an
+
+    an._level_fn_cached.cache_clear()
+    an._em_step_fn.cache_clear()
+
+
+def measure(mode: str, a, ap, b, size: int) -> dict:
+    import image_analogies_tpu.models.patchmatch as pm
+
+    pm._POLISH_MODE = mode
+    _clear_caches()
+    cfg = SynthConfig(
+        levels=5, matcher="patchmatch", em_iters=2, pm_iters=6,
+        pm_polish_iters=1,
+    )
+    run = lambda: create_image_analogy(a, ap, b, cfg)  # noqa: E731
+    _sync(run())  # compile
+    walls = []
+    out = None
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = run()
+        _sync(out)
+        walls.append(round(time.perf_counter() - t0, 4))
+
+    # Level walls from an instrumented run (per-level sync).
+    import tempfile
+
+    fd, path = tempfile.mkstemp(suffix=".jsonl")
+    os.close(fd)
+    level_walls = {}
+    try:
+        _sync(create_image_analogy(
+            a, ap, b, cfg, progress=ProgressWriter(path)
+        ))
+        for line in open(path):
+            rec = json.loads(line)
+            if rec.get("event") == "level_done":
+                level_walls[rec["level"]] = rec["wall_ms"]
+    finally:
+        os.unlink(path)
+
+    # PSNR over seeds vs the shared oracle.
+    seeds_psnr = []
+    for seed in (0, 1, 2):
+        cfg_s = SynthConfig(
+            levels=5, matcher="patchmatch", em_iters=2, pm_iters=6,
+            pm_polish_iters=1, seed=seed,
+        )
+        o = np.asarray(create_image_analogy(a, ap, b, cfg_s))
+        seeds_psnr.append(round(psnr(o, _ORACLE), 2))
+    return {
+        "mode": mode,
+        "wall_median_s": statistics.median(walls),
+        "wall_runs_s": walls,
+        "level0_wall_ms": level_walls.get(0),
+        "level_wall_ms": [level_walls[k] for k in sorted(level_walls)],
+        "psnr_seeds_db": seeds_psnr,
+        "psnr_min_db": min(seeds_psnr),
+        "psnr_mean_db": round(float(np.mean(seeds_psnr)), 2),
+    }
+
+
+def main():
+    global _ORACLE
+    size = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    a, ap, b = super_resolution(size)
+    a, ap, b = (jnp.asarray(x, jnp.float32) for x in (a, ap, b))
+    for x in (a, ap, b):
+        _sync(x)
+    # Exact oracle, once (seed-independent); cached on disk by
+    # tools/full_oracle.py naming if available.
+    opath = os.path.join(
+        os.path.dirname(__file__), "_oracle_out", f"oracle_f32_{size}.npy"
+    )
+    if os.path.exists(opath):
+        _ORACLE = np.load(opath)
+    else:
+        _ORACLE = np.asarray(create_image_analogy(
+            a, ap, b, SynthConfig(levels=5, matcher="brute", em_iters=2)
+        ))
+    res = {
+        "size": size,
+        "jump": measure("jump", a, ap, b, size),
+        "sequential": measure("sequential", a, ap, b, size),
+    }
+    j, s = res["jump"], res["sequential"]
+    res["delta"] = {
+        "wall_s": round(j["wall_median_s"] - s["wall_median_s"], 4),
+        "level0_ms": (
+            round(j["level0_wall_ms"] - s["level0_wall_ms"], 1)
+            if j["level0_wall_ms"] and s["level0_wall_ms"] else None
+        ),
+        "psnr_min_db": round(j["psnr_min_db"] - s["psnr_min_db"], 2),
+    }
+    print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()
