@@ -215,6 +215,7 @@ def tile_patchmatch(
     bounds = band_bounds(ha, n_bands)
     geom = tile_geometry(h, w, specs)
     coh = kappa_factor(cfg.kappa, level)
+    pm_iters = _pm_iters_for(cfg, ha, wa)
     if polish_iters is None:
         polish_iters = cfg.pm_polish_iters
     # bf16 accept-metric tables (see docstring); candidate_dist does its
@@ -253,7 +254,7 @@ def tile_patchmatch(
     d_b = jnp.full(
         (geom.n_ty * geom.thp, geom.n_tx * 128), jnp.inf, jnp.float32
     )
-    for t in range(cfg.pm_iters):
+    for t in range(pm_iters):
         # Candidates sampled straight from the blocked state: the
         # compact layout is never rebuilt inside the loop (round-2
         # VERDICT item — from_blocked ran twice per pm iteration just
@@ -294,7 +295,7 @@ def tile_patchmatch(
             f_b16,
             f_a16,
             nnf_m,
-            jax.random.fold_in(key, cfg.pm_iters),
+            jax.random.fold_in(key, pm_iters),
             iters=polish_iters,
             n_random=cfg.pm_polish_random,
             coh_factor=coh,
@@ -305,7 +306,7 @@ def tile_patchmatch(
             f_a16,
             nnf_m,
             d_m,
-            jax.random.fold_in(key, cfg.pm_iters),
+            jax.random.fold_in(key, pm_iters),
             iters=polish_iters,
             n_random=cfg.pm_polish_random,
             coh_factor=coh,
@@ -426,6 +427,26 @@ _TIE_FLOOD_STEPS = 16
 # BATCHED candidate gather reaches as far as an 8-deep sequential
 # accept chain, without any chain.
 _JUMP_STEPS = (8, 4, 2, 1)
+
+# Size-aware search schedule (round 5, VERDICT r4 missing 4): pm_iters
+# is constant in the config while the A search domain grows 16x from
+# 1024^2 to 4096^2, and the measured consequence was quality drift
+# (SCALE dist_ratio_vs_exact 1.50 -> 1.69 at fixed pm_iters=6).
+# Levels whose A domain exceeds _PM_BOOST_AREA run _PM_ITERS_BOOST
+# extra kernel sweeps.  Implemented at the matcher-call level (the A
+# shape is known right here), so every runner — single, batch,
+# spatial slabs, sharded-A bands — inherits the same rule with no
+# per-runner plumbing; cross-runner bit-identity is preserved because
+# the rule is a pure function of (cfg, A shape).
+_PM_BOOST_AREA = 4 * 1024 * 1024
+_PM_ITERS_BOOST = 2
+
+
+def _pm_iters_for(cfg: SynthConfig, ha: int, wa: int) -> int:
+    return cfg.pm_iters + (
+        _PM_ITERS_BOOST if ha * wa > _PM_BOOST_AREA else 0
+    )
+
 
 # Polish implementation selector (module-level, not a config knob: the
 # choice is a measured performance decision, not user surface).
@@ -683,6 +704,7 @@ def tile_patchmatch_lean(
         bounds = band_bounds(ha, n_bands)
     geom = tile_geometry(h, w, specs)
     coh = kappa_factor(cfg.kappa, level)
+    pm_iters = _pm_iters_for(cfg, ha, wa)
     if polish_iters is None:
         polish_iters = cfg.pm_polish_iters
     if dist_fn is None:
@@ -716,7 +738,7 @@ def tile_patchmatch_lean(
     d_b = jnp.full(
         (geom.n_ty * geom.thp, geom.n_tx * 128), jnp.inf, jnp.float32
     )
-    for t in range(cfg.pm_iters):
+    for t in range(pm_iters):
         cand_y, cand_x, cand_valid = sample_candidates_blocked(
             oy_b, ox_b, jax.random.fold_in(key, t), geom, ha, wa
         )
@@ -754,7 +776,7 @@ def tile_patchmatch_lean(
             f_a_tab,
             py_m,
             px_m,
-            jax.random.fold_in(key, cfg.pm_iters),
+            jax.random.fold_in(key, pm_iters),
             ha=ha,
             wa=wa,
             iters=polish_iters,
@@ -767,7 +789,7 @@ def tile_patchmatch_lean(
             py_m,
             px_m,
             d_m,
-            jax.random.fold_in(key, cfg.pm_iters),
+            jax.random.fold_in(key, pm_iters),
             ha=ha,
             wa=wa,
             iters=polish_iters,
@@ -828,7 +850,7 @@ class PatchMatchMatcher(Matcher):
             f_a,
             nnf,
             key,
-            iters=cfg.pm_iters,
+            iters=_pm_iters_for(cfg, *f_a.shape[:2]),
             n_random=cfg.pm_random_candidates,
             coh_factor=coh,
         )
