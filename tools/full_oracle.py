@@ -83,8 +83,12 @@ def _cached_run(name: str, size: int, matcher: str, **kw):
         orig = nb.exact_nn_pallas
 
         def big_tiles(fb, fa, **kw2):
-            kw2.setdefault("tq", 2048)
-            kw2.setdefault("ta", 256)
+            # ADVICE r4: gate on the database size like the lean path
+            # does — tiny coarse levels must not pad small-N queries to
+            # 2048-row tiles for nothing.
+            if fa.shape[0] >= (1 << 20):
+                kw2.setdefault("tq", 2048)
+                kw2.setdefault("ta", 256)
             return orig(fb, fa, **kw2)
 
         # Heartbeat per query-chunk execution (~25 s apart during the
@@ -98,14 +102,15 @@ def _cached_run(name: str, size: int, matcher: str, **kw):
         real_chunk = nb._nn_chunk_call
 
         # Optional per-execution budget override (element count of
-        # distance-tile work per chunk): the degraded-tunnel hunt
-        # suggested long back-to-back executions wedge where shorter
-        # ones may survive; ORACLE_MAX_TILE_ELEMS=3e11 quarters the
-        # ~22 s level-0 executions to ~6 s.
+        # distance-tile work per chunk — ORACLE_MAX_TILE_ELEMS=3e11
+        # quarters the ~22 s level-0 executions to ~6 s).  Applied as a
+        # scoped patch below (ADVICE r4: the old global mutation leaked
+        # past this run).
         budget = os.environ.get("ORACLE_MAX_TILE_ELEMS")
+        budget_val = None
         if budget:
             try:
-                nb._MAX_TILE_ELEMS = int(float(budget))
+                budget_val = int(float(budget))
             except ValueError:
                 raise SystemExit(
                     f"ORACLE_MAX_TILE_ELEMS={budget!r} is not a number "
@@ -139,8 +144,19 @@ def _cached_run(name: str, size: int, matcher: str, **kw):
             _beat("chunk-done")
             return out
 
-        with mock.patch.object(nb, "exact_nn_pallas", big_tiles), \
-                mock.patch.object(nb, "_nn_chunk_call", beat_chunk):
+        import contextlib
+
+        with contextlib.ExitStack() as stack:
+            stack.enter_context(
+                mock.patch.object(nb, "exact_nn_pallas", big_tiles)
+            )
+            stack.enter_context(
+                mock.patch.object(nb, "_nn_chunk_call", beat_chunk)
+            )
+            if budget_val is not None:
+                stack.enter_context(
+                    mock.patch.object(nb, "_MAX_TILE_ELEMS", budget_val)
+                )
             out = create_image_analogy(
                 a, ap, b, _cfg(size, matcher, ckpt, **kw),
                 progress=prog, resume_from=resume,
