@@ -1124,7 +1124,10 @@ def _ckpt_fingerprint(cfg: SynthConfig, b_shape) -> str:
     `pallas_mode`/`brute_chunk`/`match_dtype` (dispatch/precision/perf
     knobs — the saved per-level (nnf, dist, bp) state is valid input for
     any of them, so flipping one between save and resume must not force
-    a from-scratch recompute)."""
+    a from-scratch recompute).  Saves stamp the TRUE config; knobs that
+    cannot shape a particular run's results are relaxed at COMPARE time
+    instead (`_fingerprint_matches`), so the stamp keeps full
+    information and the accept rule carries the justification."""
     import dataclasses
 
     cfg_id = dataclasses.replace(
@@ -1135,6 +1138,28 @@ def _ckpt_fingerprint(cfg: SynthConfig, b_shape) -> str:
         match_dtype="float32",
     )
     return f"{tuple(b_shape)}|{cfg_id!r}"
+
+
+def _fingerprint_matches(saved: str, expected: str, cfg) -> bool:
+    """Whether a saved checkpoint stamp identifies the same run as the
+    current config's expected fingerprint.
+
+    Exact string compare, except that under a non-brute matcher
+    `brute_lean_bytes=<n>` is wildcarded on BOTH sides before comparing:
+    the budget only selects the lean-brute path under `matcher="brute"`
+    (`_level_plan`), so retuning the oracle budget must not invalidate
+    multi-hour patchmatch/ann checkpoints it cannot affect (ADVICE r4) —
+    including checkpoints stamped with any historical budget value."""
+    if saved == expected:
+        return True
+    if cfg.matcher == "brute":
+        return False
+    import re
+
+    def wild(fp: str) -> str:
+        return re.sub(r"brute_lean_bytes=\d+", "brute_lean_bytes=*", fp)
+
+    return wild(saved) == wild(expected)
 
 
 def _save_level(path: str, level: int, nnf, dist, bp, cfg, b_shape) -> None:
@@ -1167,7 +1192,7 @@ def resume_prologue(resume_from, levels: int, cfg, b_shape, progress):
     if not resume_from:
         return None
     loaded = _load_resume_state(
-        resume_from, levels, _ckpt_fingerprint(cfg, b_shape)
+        resume_from, levels, _ckpt_fingerprint(cfg, b_shape), cfg
     )
     if loaded is None:
         # ADVICE r2: an explicitly-requested resume that silently
@@ -1189,7 +1214,7 @@ def resume_prologue(resume_from, levels: int, cfg, b_shape, progress):
     return resumed_level - 1, nnf, bp, aux_fill
 
 
-def _load_resume_state(path: str, levels: int, fingerprint: str):
+def _load_resume_state(path: str, levels: int, fingerprint: str, cfg):
     """Resume state from a checkpoint dir: (finest_loadable_level, nnf,
     dist, bp, {level: (nnf, dist)} for every loadable level), or None
     when nothing usable exists.
@@ -1222,7 +1247,7 @@ def _load_resume_state(path: str, levels: int, fingerprint: str):
                     )
                     continue
                 saved_fp = str(data["fingerprint"])
-                if saved_fp != fingerprint:
+                if not _fingerprint_matches(saved_fp, fingerprint, cfg):
                     log.warning(
                         "resume: skipping %s (checkpoint from a different "
                         "run: %s != %s)", name, saved_fp, fingerprint,

@@ -244,3 +244,40 @@ def test_resume_warns_when_nothing_loadable(rng, tmp_path, caplog):
         )
     assert out is None
     assert any("no usable checkpoint" in r.message for r in caplog.records)
+
+
+def test_fingerprint_scopes_brute_lean_bytes_to_brute_matcher():
+    """Retuning the oracle's lean budget must not invalidate checkpoints
+    of runs it cannot shape (ADVICE r4): `brute_lean_bytes` only selects
+    the lean-brute path under matcher="brute", so the accept rule
+    wildcards it for every other matcher — in BOTH directions, so a
+    checkpoint stamped with any historical budget value resumes under
+    any retuned budget."""
+    from image_analogies_tpu.models.analogy import (
+        _ckpt_fingerprint,
+        _fingerprint_matches,
+    )
+
+    shape = (64, 64)
+
+    def fp(**kw):
+        return _ckpt_fingerprint(SynthConfig(**kw), shape)
+
+    pm_new = SynthConfig(matcher="patchmatch", brute_lean_bytes=2**33)
+    saved = fp(matcher="patchmatch", brute_lean_bytes=2**34)
+    expected = _ckpt_fingerprint(pm_new, shape)
+    assert saved != expected  # stamps keep full information...
+    assert _fingerprint_matches(saved, expected, pm_new)  # ...accept relaxes
+
+    # Under matcher="brute" the budget shapes results: no relaxation.
+    br_new = SynthConfig(matcher="brute", brute_lean_bytes=2**33)
+    assert not _fingerprint_matches(
+        fp(matcher="brute", brute_lean_bytes=2**34),
+        _ckpt_fingerprint(br_new, shape),
+        br_new,
+    )
+
+    # Other result-shaping knobs still bind for every matcher.
+    assert not _fingerprint_matches(
+        fp(matcher="patchmatch", patch_size=7), expected, pm_new
+    )

@@ -10,9 +10,16 @@ Only one real chip exists here, so the probe measures the per-device
 work directly: assemble the FULL table at `size`, then assemble ONE
 band's slab (rows/n + 2*halo rows) — exactly the computation
 `parallel/sharded_a._band_assemble_fn` runs per device — and compare
-`peak_bytes_in_use` from the device's allocator stats, resetting the
-peak between phases via a fresh process run per phase (allocator peaks
-are monotonic within a process).
+peak memory, one fresh process per phase so peaks are independent.
+By default it runs on the CPU backend (never attaching a second
+client to the tunnelled TPU) and reports the process's maxrss growth
+across the assembly call; `PROBE_DEVICE=tpu` opts into the real
+chip's allocator `peak_bytes_in_use` when the chip is free.  The
+maxrss window includes the jit compile's near-constant memory, so the
+ratio is only meaningful when the table dwarfs it — probe at
+size >= 2048 (at 2048x2048/8 bands the measured ratio is 0.129 vs
+the 0.125 ideal; at 256x256 compile overhead dominates and the ratio
+is meaningless).
 
     python tools/probe_band_assembly.py 2048 8      # one phase per call
     python tools/probe_band_assembly.py 2048 8 full
@@ -30,6 +37,17 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def _measure(size: int, n_bands: int, phase: str) -> dict:
     import numpy as np
     import jax
+
+    # Default to the CPU backend BEFORE first device use: this probe
+    # measures per-device assembly footprint scaling, which is
+    # structural, and it must never attach a second client to the
+    # tunnelled TPU while a long oracle run holds it (sitecustomize pins
+    # jax_platforms=axon,cpu, so the env var alone is ignored — the
+    # in-process override is the reliable one, same as
+    # tests/conftest.py).  PROBE_DEVICE=tpu opts into the real-chip
+    # allocator-stats measurement when the chip is free.
+    if os.environ.get("PROBE_DEVICE", "cpu") != "tpu":
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from image_analogies_tpu.config import SynthConfig
@@ -54,20 +72,32 @@ def _measure(size: int, n_bands: int, phase: str) -> dict:
     flt_c = jnp.asarray(rng.random((rows_c, size // 2), np.float32))
     for x in (src, flt, src_c, flt_c):
         sync(x)
+    import resource
+
     dev = jax.devices()[0]
     base = (dev.memory_stats() or {}).get("peak_bytes_in_use", 0)
+    rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
     tab = jax.jit(
         lambda *a: assemble_features_lean(a[0], a[1], cfg, a[2], a[3])
     )(src, flt, src_c, flt_c)
     sync(tab)
     stats = dev.memory_stats() or {}
+    peak = stats.get("peak_bytes_in_use", -1)
+    if peak <= 0:
+        # CPU backend (or a backend that doesn't forward allocator
+        # stats): buffers live in host memory, so the process's maxrss
+        # growth across the assembly call is the assembly-attributable
+        # peak.  The two phases run in fresh identical processes, so
+        # the interpreter/jax baseline cancels in the delta.
+        rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        peak = rss_after - rss_before
     return {
         "phase": phase,
         "rows": int(rows),
         "table_shape": [int(s) for s in tab.shape],
         "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", -1)),
         "peak_before_mb": round(base / 1e6, 1),
-        "peak_after_mb": round(stats.get("peak_bytes_in_use", -1) / 1e6, 1),
+        "peak_after_mb": round(peak / 1e6, 1),
     }
 
 
@@ -102,7 +132,7 @@ def main():
         "n_bands": n_bands,
         "full_peak_mb": out["full"]["peak_after_mb"],
         "band_peak_mb": out["band"]["peak_after_mb"],
-        "band_over_full": round(ratio, 3) if ratio else None,
+        "band_over_full": round(ratio, 3) if ratio is not None else None,
         "ideal": round(1 / n_bands, 3),
     }), flush=True)
 
