@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-5 post-oracle TPU measurement queue.  Waits for the 4096^2
+# oracle wrapper to exit, verifies no TPU client is alive (ONE client
+# at a time through the axon tunnel — see the wedge post-mortem in
+# README), then runs each measurement as its own process, sequentially,
+# with a hard timeout per step so one hang cannot starve the rest.
+cd /root/repo
+out=tools/_r5_out
+log=$out/queue.log
+mkdir -p $out
+
+step() {  # step <name> <timeout-secs> <cmd...>
+  name=$1; secs=$2; shift 2
+  echo "=== $name start $(date)" >> $log
+  timeout -k 30 $secs "$@" > $out/$name.log 2>&1
+  rc=$?
+  echo "=== $name done rc=$rc $(date)" >> $log
+  sleep 30  # let the client tear down before the next one attaches
+}
+
+echo "=== queue waiting for oracle wrapper $(date)" >> $log
+while ps -p "$(cat $out/oracle_wrapper_pid 2>/dev/null || echo 0)" > /dev/null 2>&1; do
+  sleep 60
+done
+# Belt and braces: no python TPU client may be alive.
+while ps aux | grep -E "full_oracle|scale_bench|polish_ab|kappa_curves|bench\.py" | grep -v grep | grep -v run_queue > /dev/null; do
+  echo "=== queue: client still alive, waiting $(date)" >> $log
+  sleep 60
+done
+sleep 30
+echo "=== queue starting $(date)" >> $log
+
+step polish_ab   2700 python tools/polish_ab.py 1024
+step kappa_npr   5400 python tools/kappa_curves.py 1024 npr
+# New-schedule PM outputs vs the cached exact oracles: drop the PM
+# caches so full_oracle re-synthesizes with the size-aware schedule,
+# reusing the (schedule-independent) oracle .npy.
+rm -f tools/_oracle_out/pm_3072.npy tools/_oracle_out/pm_3072.json
+rm -rf tools/_oracle_out/pm_3072.ckpt
+rm -f tools/_oracle_out/pm_4096.npy tools/_oracle_out/pm_4096.json
+rm -rf tools/_oracle_out/pm_4096.ckpt
+step oracle_3072_newpm 3600 python tools/full_oracle.py 3072
+step oracle_4096_newpm 5400 python tools/full_oracle.py 4096
+step scale_rows  9000 python tools/scale_bench.py 4096
+step bench_a     3600 python bench.py
+step bench_b     3600 python bench.py
+touch $out/QUEUE_DONE
+echo "=== queue complete $(date)" >> $log
