@@ -22,8 +22,16 @@ echo "=== queue waiting for oracle wrapper $(date)" >> $log
 while ps -p "$(cat $out/oracle_wrapper_pid 2>/dev/null || echo 0)" > /dev/null 2>&1; do
   sleep 60
 done
-# Belt and braces: no python TPU client may be alive.
-while ps aux | grep -E "full_oracle|scale_bench|polish_ab|kappa_curves|bench\.py" | grep -v grep | grep -v run_queue > /dev/null; do
+# Belt and braces: no python TPU client may be alive.  Match the
+# INVOCATION (python + script path), not bare names: the session
+# driver's own cmdline carries strings like "bench.py" in its prompt
+# text and a bare-name grep waits on it forever (hit 2026-08-01).
+_clients() {
+  ps aux \
+    | grep -E "python[0-9.]* (tools/(full_oracle|scale_bench|polish_ab|kappa_curves)\.py|bench\.py)" \
+    | grep -v grep
+}
+while _clients > /dev/null; do
   echo "=== queue: client still alive, waiting $(date)" >> $log
   sleep 60
 done
