@@ -288,8 +288,9 @@ def tile_patchmatch(
     # Per-pixel polish sweeps (propagation + ties canonicalization) on
     # the bf16 accept metric, then one exact f32 re-rank of the final
     # correspondences (the output contract's dist).  Default: the
-    # batched jump-flooding polish (_POLISH_MODE, 3 gathers/sweep); d_m
-    # is already in the accept metric, so no entry re-evaluation.
+    # sequential cascade (_POLISH_MODE — the A/B at the selector's
+    # definition); d_m is already in the accept metric, so no entry
+    # re-evaluation.
     if _POLISH_MODE == "sequential":
         nnf_p, d_p = patchmatch_sweeps(
             f_b16,
@@ -453,9 +454,13 @@ def _pm_iters_for(cfg: SynthConfig, ha: int, wa: int) -> int:
 # "jump": batched jump-flooding polish (polish_sweeps_planes) — 3
 # gathers per sweep.  "sequential": the chained per-candidate cascade
 # (patchmatch_sweeps/_lean) — 12 gathers per sweep.  The TPU headline
-# A/B (wall + PSNR-vs-oracle over 3 seeds) picks the default; tests
-# may mock.patch it to pin one path.
-_POLISH_MODE = "jump"
+# A/B picked the default (tools/polish_ab.py, 1024^2, 2026-08-01):
+# sequential 0.551 s / 35.56 dB min-over-seeds vs jump 0.725 s /
+# 35.34 dB — the microbenched 1.8x-per-candidate batched gather did
+# NOT compose into a faster level 0 (the jump candidate set + tie
+# flood cost more than the chain's 12 gathers), so sequential wins on
+# BOTH axes and stays the default.  Tests may mock.patch either path.
+_POLISH_MODE = "sequential"
 
 
 def _lex_min(d: jnp.ndarray, idx: jnp.ndarray):
@@ -764,12 +769,13 @@ def tile_patchmatch_lean(
     d_m = jnp.where(better, d_k, dist0)
     if polish_iters == 0:
         return py_m, px_m, d_m
-    # Batched jump-flooding polish (3 dist_fn calls per sweep —
-    # polish_sweeps_planes; _POLISH_MODE selects the sequential cascade
-    # instead); d_m is already in the accept metric, so no entry
-    # re-evaluation is needed.  The sharded dist_fn hook works
-    # unchanged: candidate indices arrive (K, N) with query rows
-    # pairing along the last axis.
+    # Per-pixel polish under _POLISH_MODE: the sequential cascade by
+    # default (the A/B at the selector's definition), the batched
+    # jump-flooding variant (3 dist_fn calls per sweep,
+    # polish_sweeps_planes) selectable; d_m is already in the accept
+    # metric, so no entry re-evaluation is needed.  The sharded
+    # dist_fn hook works unchanged: candidate indices arrive (K, N)
+    # with query rows pairing along the last axis.
     if _POLISH_MODE == "sequential":
         py_p, px_p, d_p = patchmatch_sweeps_lean(
             f_b_tab,
