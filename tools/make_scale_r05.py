@@ -24,8 +24,10 @@ _OUT = os.path.join(os.path.dirname(__file__), "_r5_out")
 COMMENT = (
     "Large-image scaling rows, TPU v5e-1, 2026-08-01, round 5: "
     "size-aware search schedule (pm sweeps +2 past a 4M-px A domain, "
-    "models/patchmatch._pm_iters_for) and the batched jump-flooding "
-    "polish.  Quality: EVERY row >= 1024^2 carries PSNR vs a "
+    "models/patchmatch._pm_iters_for) with the sequential polish "
+    "cascade (the jump-flood restructure measured worse on both axes "
+    "and is non-default; tools/polish_ab.py).  Quality: EVERY row >= "
+    "1024^2 carries PSNR vs a "
     "FULL-SYNTHESIS exact-NN oracle — f32-table brute to 2048^2, the "
     "lean-brute bf16-table oracle (the matched metric) at 3072^2 and "
     "4096^2, where the f32 table pair cannot fit one chip — plus the "
