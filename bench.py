@@ -225,6 +225,7 @@ def _kernel_utilization(cfg, size: int, iters: int = 16):
     if timed is None:
         return None
     ms, meta = timed
+    ms_loop = round(ms, 3)  # published alongside: two instruments agreeing
     ms_trace = None
     try:
         traced = sweep_time_trace_ms(cfg, size, iters=iters)
@@ -279,6 +280,7 @@ def _kernel_utilization(cfg, size: int, iters: int = 16):
         "kernel_mxu_flops_per_sweep": mxu_flops,
         "kernel_bytes_per_sweep": sweep_bytes,
         "kernel_sweep_ms": round(ms, 3),
+        "kernel_sweep_ms_loop": ms_loop,
         "kernel_sweep_ms_trace": ms_trace,
         "kernel_n_bands": n_bands,
         "kernel_spec_groups": len(spec_groups(tuple(specs))),

@@ -28,9 +28,13 @@ COMMENT = (
     "cascade (the jump-flood restructure measured worse on both axes "
     "and is non-default; tools/polish_ab.py).  Quality: EVERY row >= "
     "1024^2 carries PSNR vs a "
-    "FULL-SYNTHESIS exact-NN oracle — f32-table brute to 2048^2, the "
-    "lean-brute bf16-table oracle (the matched metric) at 3072^2 and "
-    "4096^2, where the f32 table pair cannot fit one chip — plus the "
+    "FULL-SYNTHESIS exact-NN oracle — f32-table brute to 2048^2; at "
+    "3072^2 the pure lean-brute bf16-table oracle; at 4096^2 the "
+    "default-budget brute oracle (exact f32 tables at the sub-wall "
+    "coarse levels, bf16 lean-brute at levels 1-0 — the finest levels, "
+    "which dominate the final image, match in the same bf16 lean "
+    "metric the production path uses; per-row oracle_kind records "
+    "this) — plus the "
     "stratified-jittered exact probe (1M px, bootstrap 95% CI on the "
     "achieved/exact mean-distance ratio) at scale_bench sizes.  The "
     "3072^2/4096^2 oracle outputs were computed once (checkpointed, "
