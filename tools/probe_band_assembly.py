@@ -13,8 +13,12 @@ band's slab (rows/n + 2*halo rows) — exactly the computation
 peak memory, one fresh process per phase so peaks are independent.
 By default it runs on the CPU backend (never attaching a second
 client to the tunnelled TPU) and reports the process's maxrss growth
-across the assembly call; `PROBE_DEVICE=tpu` opts into the real
-chip's allocator `peak_bytes_in_use` when the chip is free.  The
+across the assembly call; `PROBE_DEVICE=tpu` opts into the chip's
+allocator `peak_bytes_in_use` when the chip is free — but note the
+tunnelled axon backend does NOT forward real allocator peaks
+(measured 2026-08-01: it reports ~15-48 MB for 1-GB-scale
+assemblies), so on this environment the CPU default is the
+meaningful measurement.  The
 maxrss window includes the jit compile's near-constant memory, so the
 ratio is only meaningful when the table dwarfs it — probe at
 size >= 2048 (at 2048x2048/8 bands the measured ratio is 0.129 vs
