@@ -690,7 +690,7 @@ def tile_patchmatch_lean(
       argmin-merges here so the next iteration's candidates sample
       from the GLOBAL best field, mirroring the sequential banded
       search's carried state (strict-improvement accepts make the
-      merge order-equivalent — tests/test_spatial.py
+      merge order-equivalent — tests/test_sharded_a.py
       test_sharded_a_band_search_matches_sequential).
     """
     from ..kernels.patchmatch_tile import (

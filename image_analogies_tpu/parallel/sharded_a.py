@@ -20,7 +20,7 @@ Data path per EM step (all inside one `shard_map` over the band axis):
 
 1. **Kernel bulk search** — each device runs the tile kernel against
    ONLY its band (the ownership-band contract validated bit-identically
-   against the sequential banded search in tests/test_spatial.py
+   against the sequential banded search in tests/test_sharded_a.py
    test_sharded_a_band_search_matches_sequential), and after every pm
    iteration the per-device fields argmin-merge across the axis
    (`pmin` on distance, ties to the lower band — order-equivalent to
@@ -37,7 +37,7 @@ Equivalence: at kappa=0, sharded-lean levels are BIT-IDENTICAL to the
 single-device lean path (same PRNG streams, same candidate order,
 banded kernel == single-band kernel by the ownership contract,
 masked-gather distances == table distances) — pinned by
-tests/test_spatial.py.  At kappa>0 the kernel's accept is NOT a plain
+tests/test_sharded_a.py.  At kappa>0 the kernel's accept is NOT a plain
 min (an approximate candidate must clear `d_app * coh_factor <
 d_coh`), so the cross-band raw-distance pmin is not order-equivalent
 to the sequential carry: a band may accept an approximate candidate
@@ -65,7 +65,7 @@ boundary slabs ARE the boundary).  Per-device peak during assembly is
 one slab's table + temps (~1/n of the single-chip assembly), so the
 reachable style pair is no longer bounded by one device's assembly
 headroom.  Bit-identity with slicing the full table is pinned by
-tests/test_spatial.py test_sharded_a_band_assembly_matches_full.
+tests/test_sharded_a.py test_sharded_a_band_assembly_matches_full.
 Only the kernel A-planes (raw image planes, ~MBs) are still prepared
 globally before placement — they are not a memory-binding item.
 """

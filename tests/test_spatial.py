@@ -352,164 +352,6 @@ def test_spatial_lean_checkpoint_roundtrip(rng, tmp_path):
     np.testing.assert_array_equal(resumed, full)
 
 
-def test_sharded_a_runner_bit_identical_to_single_device(rng):
-    """Full band-sharded-A synthesis (parallel/sharded_a.py, round-3
-    VERDICT task 7's 'full runner'): with the A-side lean tables and
-    kernel planes split into per-device ownership bands, the output
-    must be BIT-IDENTICAL to the single-device lean path — same PRNG
-    streams and candidate order; banded kernel == single-band kernel by
-    the ownership contract (test below); masked local gathers merged by
-    pmin == single-table gathers because every flat A index has exactly
-    one owner.  A forced-tiny feature budget makes every kernel-eligible
-    level lean, so the sharded step carries the whole synthesis."""
-    from unittest import mock
-
-    from image_analogies_tpu.parallel.sharded_a import synthesize_sharded_a
-
-    n_dev = 4
-    size = 128
-    base = rng.random((size, size), np.float32)
-    a = base
-    ap = np.clip(base * 0.6 + 0.3, 0, 1).astype(np.float32)
-    b = np.roll(base, 17, axis=0)
-    # em_iters=2 x pm_iters=2 deliberately: this is the ONE test that
-    # pins the full combination (state carried from a prior EM step
-    # into a multi-iteration banded sweep) — the other sharded tests
-    # trim to em or pm = 1 and cite this one.
-    cfg = SynthConfig(
-        levels=2, matcher="patchmatch", em_iters=2, pm_iters=2,
-        feature_bytes_budget=1, pallas_mode="interpret",
-    )
-    single = np.asarray(create_image_analogy(a, ap, b, cfg))
-    mesh = make_mesh(n_dev, axis_names=("bands",))
-
-    # The claim the runner exists for: the table handed to the sharded
-    # level fn must actually be ROW-SHARDED — each device's addressable
-    # shard holds exactly 1/n of the A rows (a silently replicated
-    # table would still produce correct output).
-    import image_analogies_tpu.parallel.sharded_a as sa
-
-    real_level_fn = sa._sharded_level_fn
-    shard_rows = []
-
-    def spying_level_fn(*fargs, **fkw):
-        fn = real_level_fn(*fargs, **fkw)
-
-        def wrapper(f_a_tab, *rest):
-            shard_rows.append(
-                (f_a_tab.shape[0],
-                 [s.data.shape[0] for s in f_a_tab.addressable_shards])
-            )
-            return fn(f_a_tab, *rest)
-
-        return wrapper
-
-    with mock.patch.object(sa, "_sharded_level_fn", spying_level_fn):
-        sharded = np.asarray(synthesize_sharded_a(a, ap, b, cfg, mesh))
-    np.testing.assert_array_equal(sharded, single)
-    assert shard_rows, "no level ran the sharded step"
-    for total, per_dev in shard_rows:
-        assert len(per_dev) == n_dev
-        assert all(r == total // n_dev for r in per_dev)
-
-
-def test_sharded_a_band_search_matches_sequential(rng):
-    """Sharded-A prototype (round-3 VERDICT task 7): A's rows are split
-    into ownership bands, each mesh device runs the tile kernel against
-    ONLY its band under shard_map, and the per-device results merge by
-    elementwise distance argmin.  With strict-improvement accepts the
-    merged field must be BIT-IDENTICAL to the sequential banded search
-    (band calls with carried state), because a band-1 candidate beats
-    the band-0 winner in the sequential order iff it is strictly better
-    — exactly the parallel merge's tie-break toward the lower band.
-    This pins the kernel-level contract the full sharded-A runner
-    builds on: per-device HBM holds only that device's A band."""
-    import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
-
-    from image_analogies_tpu.kernels.patchmatch_tile import (
-        LANE,
-        band_bounds,
-        channel_specs,
-        channel_images,
-        prepare_a_planes,
-        sample_candidates,
-        tile_geometry,
-        tile_sweep,
-        to_blocked,
-    )
-
-    n_dev = 2
-    cfg = SynthConfig()
-    specs = channel_specs(1, 1, cfg, False)
-    h = w = ha = wa = 128
-    geom = tile_geometry(h, w, specs)
-    mk = lambda *s: jnp.asarray(rng.random(s, np.float32))  # noqa: E731
-    src_a, flt_a = mk(ha, wa), mk(ha, wa)
-    src_b, flt_b = mk(h, w), mk(h, w)
-
-    bands = prepare_a_planes(src_a, flt_a, None, None, specs, n_bands=n_dev)
-    bounds = band_bounds(ha, n_dev)
-    chans_b = channel_images(src_b, flt_b, None, None)
-    b_blocked = jnp.stack([to_blocked(c, geom) for c in chans_b])
-
-    off0 = jnp.zeros((h, w), jnp.int32)
-    cand_y, cand_x, cand_valid = sample_candidates(
-        jnp.asarray(rng.integers(-ha, ha, (h, w), dtype=np.int32)),
-        jnp.asarray(rng.integers(-wa, wa, (h, w), dtype=np.int32)),
-        jax.random.PRNGKey(0), geom, ha, wa,
-    )
-    thp = geom.thp
-    z = jnp.zeros((geom.n_ty * thp, geom.n_tx * LANE), jnp.int32)
-    d0 = jnp.full((geom.n_ty * thp, geom.n_tx * LANE), np.inf, jnp.float32)
-
-    def sweep_one_band(band_planes, band):
-        return tile_sweep(
-            band_planes, b_blocked, cand_y, cand_x, z, z, d0, band,
-            cand_valid,
-            specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=1.0,
-            interpret=True,
-        )
-
-    # Sequential reference: carried state through the band calls.
-    oy_s, ox_s, d_s = z, z, d0
-    for band_planes, band in zip(bands, bounds):
-        oy_s, ox_s, d_s = tile_sweep(
-            band_planes, b_blocked, cand_y, cand_x, oy_s, ox_s, d_s, band,
-            cand_valid,
-            specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=1.0,
-            interpret=True,
-        )
-
-    # Sharded: each device owns one band; shard_map runs the kernel
-    # per device; outputs gather on the band axis and argmin-merge.
-    mesh = make_mesh(n_dev, axis_names=("bands",))
-    a_stacked = jnp.stack(bands)           # (n_dev, rows, Wq, C, LANE)
-    b_stacked = jnp.stack(bounds)          # (n_dev, 2)
-
-    def per_device(band_planes, band):
-        oy, ox, d = sweep_one_band(band_planes[0], band[0])
-        return oy[None], ox[None], d[None]
-
-    oy_g, ox_g, d_g = jax.shard_map(
-        per_device,
-        mesh=mesh,
-        in_specs=(P("bands"), P("bands")),
-        out_specs=P("bands"),
-        # pallas_call's out_shapes carry no varying-mesh-axes info.
-        check_vma=False,
-    )(a_stacked, b_stacked)
-    # Elementwise argmin across bands, ties to the lower band.
-    best = jnp.argmin(d_g, axis=0)
-    oy_m = jnp.take_along_axis(oy_g, best[None], axis=0)[0]
-    ox_m = jnp.take_along_axis(ox_g, best[None], axis=0)[0]
-    d_m = jnp.take_along_axis(d_g, best[None], axis=0)[0]
-
-    np.testing.assert_array_equal(np.asarray(oy_m), np.asarray(oy_s))
-    np.testing.assert_array_equal(np.asarray(ox_m), np.asarray(ox_s))
-    np.testing.assert_array_equal(np.asarray(d_m), np.asarray(d_s))
-
-
 def test_spatial_2d_bands_bit_identical_to_1d(rng):
     """2-D bands x slabs composition (round-4: the 'remaining step' of
     spatial.py / sharded_a.py): on a ("bands", "slabs") mesh the lean
@@ -596,86 +438,3 @@ def test_spatial_2d_mesh_validation():
         synthesize_spatial(a, a, b, SynthConfig(levels=1), bad)
 
 
-def test_sharded_a_checkpoint_roundtrip(rng, tmp_path):
-    """Sharded-A checkpoint/resume (round-4: removed the v1
-    NotImplementedError): per-level artifacts use the standard stacked
-    schema and a resumed run reproduces the uninterrupted one."""
-    from image_analogies_tpu.parallel.sharded_a import synthesize_sharded_a
-
-    a = rng.random((128, 128)).astype(np.float32)
-    ap = np.clip(a * 0.6 + 0.3, 0, 1).astype(np.float32)
-    b = np.roll(a, 17, axis=0)
-    mesh = make_mesh(2, axis_names=("bands",))
-    cfg = SynthConfig(
-        levels=2, matcher="patchmatch", em_iters=1, pm_iters=1,
-        feature_bytes_budget=1, pallas_mode="interpret",
-        save_level_artifacts=str(tmp_path / "ck"),
-    )
-    full = np.asarray(synthesize_sharded_a(a, ap, b, cfg, mesh))
-    # Mid-pyramid restart — the crash-resume path the feature exists
-    # for: drop the finest level's artifact so the resumed run loads
-    # the stacked level-1 field and re-synthesizes level 0 through the
-    # sharded step (an all-levels-complete resume would just finalize
-    # without entering the loop).
-    os.unlink(tmp_path / "ck" / "level_0.npz")
-    resumed = np.asarray(
-        synthesize_sharded_a(
-            a, ap, b, cfg, mesh, resume_from=str(tmp_path / "ck"),
-        )
-    )
-    np.testing.assert_array_equal(resumed, full)
-    # And the degenerate all-complete resume (level_0.npz re-written by
-    # the resumed run) finalizes directly.
-    again = np.asarray(
-        synthesize_sharded_a(
-            a, ap, b, cfg, mesh, resume_from=str(tmp_path / "ck"),
-        )
-    )
-    np.testing.assert_array_equal(again, full)
-
-
-def test_sharded_a_band_assembly_matches_full(rng):
-    """Band-sharded lean A-table assembly (round-5; removes the round-4
-    'v1 scope' note): each device assembles its own band's table slice
-    from a halo-extended A-pyramid slab — the result must be
-    BIT-IDENTICAL to slicing the full single-device assembly (the
-    slab-halo geometry covers every window's reach, and edge clamping
-    matches because boundary slabs ARE the boundary)."""
-    from image_analogies_tpu.models.analogy import (
-        _strip_noncompute,
-        assemble_features_lean,
-    )
-    from image_analogies_tpu.parallel.batch import _mesh_token
-    from image_analogies_tpu.parallel.sharded_a import _band_assemble_fn
-
-    n_dev = 4
-    cfg = SynthConfig(levels=2, matcher="patchmatch")
-    src = rng.random((64, 48), np.float32)
-    flt = rng.random((64, 48), np.float32)
-    src_c = rng.random((32, 24), np.float32)
-    flt_c = rng.random((32, 24), np.float32)
-
-    full = np.asarray(
-        assemble_features_lean(src, flt, cfg, src_c, flt_c)
-    )
-    mesh = make_mesh(n_dev, axis_names=("bands",))
-    token = _mesh_token(mesh)
-    sharded = _band_assemble_fn(
-        _strip_noncompute(cfg), token, True, n_dev
-    )(src, flt, src_c, flt_c)
-    # The output must be genuinely row-sharded over the bands axis.
-    shards = {
-        d.id: s.data.shape for s in sharded.addressable_shards
-        for d in [s.device]
-    }
-    assert all(s[0] == full.shape[0] // n_dev for s in shards.values()), (
-        shards
-    )
-    np.testing.assert_array_equal(np.asarray(sharded), full)
-
-    # Coarsest-level variant (no coarse pyramid).
-    full0 = np.asarray(assemble_features_lean(src, flt, cfg, None, None))
-    sharded0 = _band_assemble_fn(
-        _strip_noncompute(cfg), token, False, n_dev
-    )(src, flt)
-    np.testing.assert_array_equal(np.asarray(sharded0), full0)
