@@ -22,8 +22,11 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--patch-size", type=int, default=5)
     p.add_argument("--coarse-patch-size", type=int, default=3)
     p.add_argument("--kappa", type=float, default=0.0)
+    # choices: a matcher typo must fail at parse time, before the
+    # (possibly large) image loads.
     p.add_argument(
         "--matcher", default="patchmatch",
+        choices=("brute", "patchmatch", "ann"),
         help="brute | patchmatch | ann (native C++ kd-tree, CPU backend)",
     )
     p.add_argument(
