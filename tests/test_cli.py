@@ -97,3 +97,33 @@ def test_bad_matcher_rejected_at_parse_time(tmp_path):
         _run(["synth", "--matcher", "nonsense", "--a", "x", "--ap", "x",
               "--b", "x", "--out", str(tmp_path / "o.png")])
     assert exc.value.code not in (0, None)
+
+
+def test_sharded_runner_flags(assets, tmp_path):
+    """--spatial / --sharded-a / --bands reach the sharded runners on
+    the 8-virtual-device mesh (the runners' semantics are pinned in
+    test_spatial/test_sharded_a; this pins the CLI wiring)."""
+    base = [
+        "synth",
+        "--a", os.path.join(assets, "texture_by_numbers_A.png"),
+        "--ap", os.path.join(assets, "texture_by_numbers_Ap.png"),
+        "--b", os.path.join(assets, "texture_by_numbers_B.png"),
+        "--levels", "1", "--matcher", "brute", "--em-iters", "1",
+        "--device", "cpu",
+    ]
+    out_sp = str(tmp_path / "sp.png")
+    _run(base + ["--out", out_sp, "--spatial"])
+    assert os.path.exists(out_sp)
+
+    out_sa = str(tmp_path / "sa.png")
+    _run(base + ["--out", out_sa, "--sharded-a"])
+    assert os.path.exists(out_sa)
+
+    out_2d = str(tmp_path / "b2.png")
+    _run(base + ["--out", out_2d, "--spatial", "--bands", "2"])
+    assert os.path.exists(out_2d)
+
+    # --bands without --spatial must fail loudly, not mis-shard.
+    with pytest.raises(SystemExit) as exc:
+        _run(base + ["--out", str(tmp_path / "bad.png"), "--bands", "2"])
+    assert exc.value.code not in (0, None)
