@@ -147,14 +147,19 @@ def _exact_probe(a, ap, b, cfg, aux):
 
 
 def main():
-    max_size = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    # `scale_bench.py [max_size]` runs the standard rows up to max_size
+    # (the recorded-artifact contract); `scale_bench.py --sizes N...`
+    # runs an explicit list (e.g. --sizes 3072 for the off-grid row).
+    if len(sys.argv) > 2 and sys.argv[1] == "--sizes":
+        sizes = tuple(int(x) for x in sys.argv[2:])
+    else:
+        max_size = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+        sizes = tuple(s for s in (1024, 2048, 4096) if s <= max_size)
     from unittest import mock
 
     import image_analogies_tpu.kernels.nn_brute as nb
 
-    for size in (1024, 2048, 4096):
-        if size > max_size:
-            break
+    for size in sizes:
         a, ap, b = super_resolution(size)
         a, ap, b = (jnp.asarray(x, jnp.float32) for x in (a, ap, b))
         for x in (a, ap, b):
