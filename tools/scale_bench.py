@@ -24,6 +24,8 @@ NN-field energy, and quality:
   calibrating the ratio against known PSNR.
 
 Run on the TPU box:  python tools/scale_bench.py [max_size]
+                     python tools/scale_bench.py --sizes 3072 ...
+(the --sizes form runs an explicit list, e.g. the off-grid 3072 row)
 """
 
 import json
@@ -150,7 +152,9 @@ def main():
     # `scale_bench.py [max_size]` runs the standard rows up to max_size
     # (the recorded-artifact contract); `scale_bench.py --sizes N...`
     # runs an explicit list (e.g. --sizes 3072 for the off-grid row).
-    if len(sys.argv) > 2 and sys.argv[1] == "--sizes":
+    if sys.argv[1:] and sys.argv[1] == "--sizes":
+        if len(sys.argv) < 3:
+            raise SystemExit("usage: scale_bench.py --sizes N [N...]")
         sizes = tuple(int(x) for x in sys.argv[2:])
     else:
         max_size = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
