@@ -4,6 +4,8 @@ Subcommands:
   synth     A + A' + B -> B'   (the reference's main entry point)
   batch     A + A' + frame dir -> stylized frames (config 5)
   examples  generate the procedural example assets (C14)
+  report    merge a traced run's host spans + device trace into
+            report.json (telemetry/report.py)
 
 Flags mirror the reference's knob surface (levels, patch size, kappa,
 matcher) plus `--device {cpu,tpu}` to pick the JAX backend [north star].
@@ -17,7 +19,17 @@ import sys
 import time
 
 
+def _add_common_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--log-level", default=None,
+        choices=["debug", "info", "warning", "error", "critical"],
+        help="attach a stderr handler to the image_analogies_tpu "
+        "logger at this level (default: leave logging unconfigured)",
+    )
+
+
 def _add_synth_flags(p: argparse.ArgumentParser) -> None:
+    _add_common_flags(p)
     p.add_argument("--levels", type=int, default=5)
     p.add_argument("--patch-size", type=int, default=5)
     p.add_argument("--coarse-patch-size", type=int, default=3)
@@ -72,10 +84,22 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
         help="resume mid-pyramid from a --save-level-artifacts directory",
     )
     p.add_argument("--progress", default=None, help="JSONL progress path")
-    p.add_argument(
-        "--profile", default=None, metavar="DIR",
-        help="write a jax.profiler (Perfetto/XProf) trace of the "
-        "synthesis to DIR (SURVEY.md §5 tracing)",
+    trace = p.add_mutually_exclusive_group()
+    trace.add_argument(
+        "--trace-dir", dest="trace_dir", default=None, metavar="DIR",
+        help="telemetry directory: a jax.profiler (Perfetto/XProf) "
+        "device trace of the synthesis plus the run's host span tree "
+        "(host_spans.json) and metrics exposition (metrics.json/.prom) "
+        "— self-contained input for the `report` subcommand.  Enables "
+        "per-level host spans (one sync per level, like --progress)",
+    )
+    trace.add_argument(
+        "--profile", dest="profile", default=None, metavar="DIR",
+        help="device-trace-only directory (the historic flag): no "
+        "telemetry artifacts are written, and the flag itself adds no "
+        "per-level host syncs (the run is only instrumented if "
+        "--progress also asks for it).  Use --trace-dir for the full "
+        "telemetry layout",
     )
 
 
@@ -124,6 +148,7 @@ def cmd_synth(args) -> int:
     _select_device(args.device)
     from .models.analogy import create_image_analogy
     from .utils.io import load_image, save_image
+    from .utils.profiling import telemetry_session
     from .utils.progress import ProgressWriter
 
     progress = ProgressWriter(args.progress)
@@ -131,20 +156,31 @@ def cmd_synth(args) -> int:
     ap = load_image(args.ap)
     b = load_image(args.b)
     cfg = _config_from(args)
-    progress.emit("start", shape=list(b.shape), matcher=cfg.matcher)
     t0 = time.perf_counter()
-    from .utils.profiling import device_trace
 
-    # Per-level progress costs one host sync per level; only pay it when
-    # the user asked for a progress file (north-star: minimal host syncs).
-    level_progress = progress if args.progress else None
+    # Per-level spans cost one host sync per level; only pay when the
+    # user asked for a progress stream or a telemetry dir (north-star:
+    # minimal host syncs).  The historic --profile keeps its original
+    # meaning — a device trace of the UN-instrumented run — so it does
+    # NOT enable spans; --trace-dir (the telemetry layout) does.
+    instrument = bool(args.progress or args.trace_dir)
     if args.bands > 1 and not args.spatial:
         raise SystemExit(
             "--bands requires --spatial (it names the A-band axis of "
             "the 2-D bands x slabs mesh); for A-side banding alone use "
             "--sharded-a"
         )
-    with device_trace(args.profile):
+    # Telemetry artifacts go ONLY to --trace-dir; a --profile dir is
+    # device-trace-only (its documented contract).
+    with telemetry_session(
+        args.trace_dir or args.profile, sink=progress,
+        enabled=instrument, artifact_dir=args.trace_dir,
+    ) as tracer:
+        # Disabled tracer: events still reach the JSONL/log stream
+        # directly through the writer (the historic behavior).
+        events = tracer if tracer.enabled else progress
+        events.emit("start", shape=list(b.shape), matcher=cfg.matcher)
+        level_progress = tracer if instrument else None
         if args.spatial:
             import jax
 
@@ -190,7 +226,7 @@ def cmd_synth(args) -> int:
         import numpy as np
 
         bp = np.asarray(bp)
-    progress.emit("done", wall_s=round(time.perf_counter() - t0, 3))
+        events.emit("done", wall_s=round(time.perf_counter() - t0, 3))
     save_image(args.out, bp)
     print(f"wrote {args.out} ({time.perf_counter() - t0:.2f}s)")
     return 0
@@ -203,6 +239,7 @@ def cmd_batch(args) -> int:
     from .parallel.batch import synthesize_batch
     from .parallel.mesh import make_mesh
     from .utils.io import load_image, save_image
+    from .utils.profiling import telemetry_session
     from .utils.progress import ProgressWriter
 
     progress = ProgressWriter(args.progress)
@@ -216,13 +253,19 @@ def cmd_batch(args) -> int:
     cfg = _config_from(args)
     mesh = make_mesh(args.n_devices)
     t0 = time.perf_counter()
-    from .utils.profiling import device_trace
 
-    with device_trace(args.profile):
+    # --profile keeps its historic un-instrumented-trace meaning (see
+    # cmd_synth); only --progress / --trace-dir enable spans, and
+    # telemetry artifacts land only in --trace-dir.
+    instrument = bool(args.progress or args.trace_dir)
+    with telemetry_session(
+        args.trace_dir or args.profile, sink=progress,
+        enabled=instrument, artifact_dir=args.trace_dir,
+    ) as tracer:
         bps = np.asarray(
             synthesize_batch(
                 a, ap, frames, cfg, mesh,
-                progress=progress if args.progress else None,
+                progress=tracer if instrument else None,
                 frames_per_step=args.frames_per_step,
                 resume_from=args.resume_from,
             )
@@ -259,6 +302,34 @@ def cmd_examples(args) -> int:
     for i, f in enumerate(np.asarray(frames)):
         save_image(os.path.join(args.out, f"npr_frame_{i}.png"), f)
     print(f"wrote example assets to {args.out}")
+    return 0
+
+
+def cmd_report(args) -> int:
+    """Merge a traced run's host spans with its device trace into
+    report.json + a human-readable table (telemetry/report.py)."""
+    import json
+
+    from .telemetry.report import (
+        REPORT_FILE,
+        build_report,
+        render_table,
+        write_report,
+    )
+
+    try:
+        report = build_report(
+            trace_dir=args.trace_dir, progress_path=args.progress
+        )
+    except (FileNotFoundError, ValueError) as e:
+        raise SystemExit(f"report: {e}")
+    out = args.out or os.path.join(args.trace_dir, REPORT_FILE)
+    write_report(report, out)
+    if args.format == "json":
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_table(report))
+    print(f"wrote {out}")
     return 0
 
 
@@ -312,11 +383,38 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_batch)
 
     p = sub.add_parser("examples", help="generate procedural example assets")
+    _add_common_flags(p)
     p.add_argument("--out", default="examples")
     p.add_argument("--size", type=int, default=256)
     p.set_defaults(fn=cmd_examples)
 
+    p = sub.add_parser(
+        "report",
+        help="merge a traced run's host spans + device trace into "
+        "report.json (input: a synth/batch --trace-dir directory)",
+    )
+    _add_common_flags(p)
+    p.add_argument(
+        "--trace-dir", required=True, metavar="DIR",
+        help="telemetry directory a traced run wrote "
+        "(host_spans.json / metrics.json / *.xplane.pb)",
+    )
+    p.add_argument(
+        "--progress", default=None, metavar="JSONL",
+        help="legacy progress stream to reconstruct host spans from "
+        "when the trace dir has no host_spans.json",
+    )
+    p.add_argument(
+        "--out", default=None,
+        help="report path (default: <trace-dir>/report.json)",
+    )
+    p.add_argument("--format", default="table", choices=["table", "json"])
+    p.set_defaults(fn=cmd_report)
+
     args = parser.parse_args(argv)
+    from .utils.progress import configure_logging
+
+    configure_logging(getattr(args, "log_level", None))
     return args.fn(args)
 
 

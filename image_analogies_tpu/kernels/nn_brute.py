@@ -178,6 +178,10 @@ def exact_nn_pallas(
     cuts A re-streaming 8x vs the (256, 512) default, which stays
     optimal for the small-N calls the synthesis pipeline makes.
     """
+    from ..telemetry.metrics import count_kernel_launch
+
+    count_kernel_launch("exact_nn")  # trace-time count (see helper)
+
     n, d_feat = f_b_flat.shape
     n_a = f_a_flat.shape[0]
     match_dtype = jnp.dtype(match_dtype)

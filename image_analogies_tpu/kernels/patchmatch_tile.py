@@ -791,6 +791,10 @@ def tile_sweep(
     the samplers produce (None: computed here — the samplers hoist it so
     multi-band callers don't recompute it per band call).
     """
+    from ..telemetry.metrics import count_kernel_launch
+
+    count_kernel_launch("tile_sweep")  # trace-time count (see helper)
+
     thp = geom.thp
     n_ty, n_tx = geom.n_ty, geom.n_tx
     n_chan = a_planes.shape[2]

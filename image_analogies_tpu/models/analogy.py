@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 
 from ..config import SynthConfig
+from ..telemetry.metrics import get_registry
+from ..telemetry.spans import as_tracer
 from ..ops.color import luminance, rgb_to_yiq, yiq_to_rgb
 from ..ops.features import assemble_features
 from ..ops.pca import fit_and_project as pca_fit_and_project, project as pca_project
@@ -182,13 +184,14 @@ def lean_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
     n_src = 1 if src_b.ndim == 2 else src_b.shape[-1]
     n_flt = 1 if flt_b.ndim == 2 else flt_b.shape[-1]
     plan = plan_channels(n_src, n_flt, cfg, has_coarse, h, w, ha, wa)
-    f_b_tab = assemble_features_lean(
-        src_b,
-        flt_b,
-        cfg,
-        src_b_c if has_coarse else None,
-        flt_b_c if has_coarse else None,
-    )
+    with jax.named_scope("tlm_assemble"):
+        f_b_tab = assemble_features_lean(
+            src_b,
+            flt_b,
+            cfg,
+            src_b_c if has_coarse else None,
+            flt_b_c if has_coarse else None,
+        )
     raw = RawPlanes(
         src_b,
         flt_b,
@@ -198,17 +201,19 @@ def lean_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
     )
     if dist_fn is not None:
         dist_fn = dist_fn(f_b_tab)
-    py, px, dist = tile_patchmatch_lean(
-        f_b_tab, f_a, py, px, key, raw=raw, cfg=cfg, level=level,
-        interpret=interpret, plan=plan,
-        ha=ha, wa=wa, polish_iters=polish_iters,
-        dist_fn=dist_fn, bounds=bounds, sweep_merge=sweep_merge,
-    )
-    flat = copy_a.reshape(ha * wa, -1)
-    out = jnp.take(
-        flat, (py * wa + px).reshape(-1), axis=0
-    ).reshape(h, w, -1)
-    bp = out[..., 0] if copy_a.ndim == 2 else out
+    with jax.named_scope("tlm_match"):
+        py, px, dist = tile_patchmatch_lean(
+            f_b_tab, f_a, py, px, key, raw=raw, cfg=cfg, level=level,
+            interpret=interpret, plan=plan,
+            ha=ha, wa=wa, polish_iters=polish_iters,
+            dist_fn=dist_fn, bounds=bounds, sweep_merge=sweep_merge,
+        )
+    with jax.named_scope("tlm_render"):
+        flat = copy_a.reshape(ha * wa, -1)
+        out = jnp.take(
+            flat, (py * wa + px).reshape(-1), axis=0
+        ).reshape(h, w, -1)
+        bp = out[..., 0] if copy_a.ndim == 2 else out
     return (py, px), dist, bp
 
 
@@ -447,15 +452,20 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
 
     def em_step(src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf, key,
                 proj=None, a_planes=None):
-        f_b = assemble_features(
-            src_b,
-            flt_b,
-            cfg,
-            src_b_c if has_coarse else None,
-            flt_b_c if has_coarse else None,
-        )
-        if cfg.pca_dims:
-            f_b = pca_project(f_b, proj)
+        # tlm_* named scopes: trace-time-only phase tags that thread
+        # through to profiler op names, which is how the run report
+        # attributes device time to matcher phases
+        # (telemetry/report.py via xplane.device_scope_totals).
+        with jax.named_scope("tlm_assemble"):
+            f_b = assemble_features(
+                src_b,
+                flt_b,
+                cfg,
+                src_b_c if has_coarse else None,
+                flt_b_c if has_coarse else None,
+            )
+            if cfg.pca_dims:
+                f_b = pca_project(f_b, proj)
         raw = None
         if a_planes is not None:
             from .patchmatch import RawPlanes
@@ -467,11 +477,13 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
                 flt_b_c if has_coarse else None,
                 a_planes,
             )
-        nnf, dist = matcher.match(
-            f_b, f_a, nnf, key=key, level=level, cfg=cfg, raw=raw,
-            polish_iters=polish_iters,
-        )
-        bp = _gather_image(copy_a, nnf)
+        with jax.named_scope("tlm_match"):
+            nnf, dist = matcher.match(
+                f_b, f_a, nnf, key=key, level=level, cfg=cfg, raw=raw,
+                polish_iters=polish_iters,
+            )
+        with jax.named_scope("tlm_render"):
+            bp = _gather_image(copy_a, nnf)
         return nnf, dist, bp
 
     return em_step
@@ -508,16 +520,20 @@ def _prologue_fn_cached(cfg: SynthConfig, levels: int):
     """
 
     def prologue(a, ap, b):
-        src_a, flt_a, src_b, copy_a, yiq_b = _resolve_channels(a, ap, b, cfg)
-        pyr_src_a = tuple(
-            _with_steerable(x, cfg) for x in build_pyramid(src_a, levels)
-        )
-        pyr_flt_a = tuple(build_pyramid(flt_a, levels))
-        pyr_src_b = tuple(
-            _with_steerable(x, cfg) for x in build_pyramid(src_b, levels)
-        )
-        pyr_copy_a = tuple(build_pyramid(copy_a, levels))
-        pyr_raw_b = tuple(build_pyramid(src_b, levels))
+        # tlm_prologue: device-attribution tag (telemetry/report.py).
+        with jax.named_scope("tlm_prologue"):
+            src_a, flt_a, src_b, copy_a, yiq_b = _resolve_channels(
+                a, ap, b, cfg
+            )
+            pyr_src_a = tuple(
+                _with_steerable(x, cfg) for x in build_pyramid(src_a, levels)
+            )
+            pyr_flt_a = tuple(build_pyramid(flt_a, levels))
+            pyr_src_b = tuple(
+                _with_steerable(x, cfg) for x in build_pyramid(src_b, levels)
+            )
+            pyr_copy_a = tuple(build_pyramid(copy_a, levels))
+            pyr_raw_b = tuple(build_pyramid(src_b, levels))
         return pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, pyr_raw_b, yiq_b
 
     return jax.jit(prologue)
@@ -791,25 +807,37 @@ def _level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
         dist = bp = None
         for em in range(cfg.em_iters):
             step = step_final if em == cfg.em_iters - 1 else step_mid
-            nnf, dist, bp = step(
-                src_b_l,
-                flt_bp,
-                src_b_c if has_coarse else src_b_l,
-                flt_bp_coarse if has_coarse else flt_bp,
-                f_a,
-                copy_a_l,
-                nnf,
-                jax.random.fold_in(level_key, em),
-                proj,
-                a_planes,
-            )
+            # tlm_em<i>: EM-iteration tag for the device-time join —
+            # the host cannot clock iterations inside this one fused
+            # call, so the report recovers their cost from profiler op
+            # names instead (telemetry/report.py).
+            with jax.named_scope(f"tlm_em{em}"):
+                nnf, dist, bp = step(
+                    src_b_l,
+                    flt_bp,
+                    src_b_c if has_coarse else src_b_l,
+                    flt_bp_coarse if has_coarse else flt_bp,
+                    f_a,
+                    copy_a_l,
+                    nnf,
+                    jax.random.fold_in(level_key, em),
+                    proj,
+                    a_planes,
+                )
             flt_bp = bp
         return nnf, dist, bp
+
+    def run_level_tagged(*args, **kw):
+        # tlm_L<level>: the per-level device-attribution tag.  A
+        # wrapper (not an in-body with-block) so the tag encloses the
+        # WHOLE level graph — state glue, assembly, every EM step.
+        with jax.named_scope(f"tlm_L{level}"):
+            return run_level(*args, **kw)
 
     # fuse=False (oversized brute levels, _SAFE_EXEC_DIST_ELEMS): the
     # same function eagerly — exact_nn_pallas then execution-chunks its
     # query axis itself.
-    return jax.jit(run_level) if fuse else run_level
+    return jax.jit(run_level_tagged) if fuse else run_level_tagged
 
 
 _prologue_fn.cache_clear = _prologue_fn_cached.cache_clear
@@ -953,6 +981,61 @@ def _resolve_channels(a, ap, b, cfg: SynthConfig):
     return a, ap, b, ap, None
 
 
+def record_prologue(tracer, pyr_raw_b, levels: int, t0: float) -> None:
+    """Drain the async prologue and record its span — shared by every
+    runner so the sync barrier lives in ONE place.
+
+    The drain must happen before the first level's clock starts so the
+    prologue wall is charged to its own span, not the coarsest level
+    (the round-2 bench charged 3.4 s of prologue to a 64^2 level).
+    The scalar readback is the reliable barrier on the tunnelled
+    platform (block_until_ready can return early — bench.py _sync)."""
+    if not tracer.enabled:
+        return
+    float(jnp.sum(pyr_raw_b[levels - 1]))
+    tracer.record(
+        "prologue", round((time.perf_counter() - t0) * 1000, 3)
+    )
+
+
+def _record_level_telemetry(tracer, cfg: SynthConfig, level: int,
+                            lvl_span, plan: LevelPlan) -> None:
+    """Span-tree structure + metrics-registry updates for one finished
+    level.
+
+    EM iterations and matcher phases execute inside ONE jitted level
+    call (the dispatch-fusion design), so the host cannot clock them;
+    they are recorded as UNTIMED child spans and their device cost is
+    recovered from the xplane trace via the tlm_* scope tags
+    (telemetry/report.py).  Counters are host-driven statically-known
+    quantities (see telemetry/metrics.py on the jit trace-time caveat):
+    em_iters per executed level, one level per level.
+    """
+    for em in range(cfg.em_iters):
+        em_sp = tracer.annotate(
+            "em_iter", parent=lvl_span, em=em, fused=plan.fuse
+        )
+        for phase in ("assemble", "match", "render"):
+            tracer.annotate(phase, parent=em_sp)
+    reg = tracer.registry if tracer.registry is not None else get_registry()
+    reg.counter("ia_levels_total", "pyramid levels executed").inc()
+    reg.counter(
+        "ia_em_iters_total",
+        "EM iterations executed (em_iters per executed level)",
+    ).inc(cfg.em_iters)
+    energy = lvl_span.attrs.get("nnf_energy")
+    if energy is not None:
+        reg.gauge(
+            "ia_nnf_energy",
+            "final NNF mean match distance per pyramid level "
+            "(the PatchMatch convergence monitor)",
+        ).set(energy, labels={"level": str(level)})
+    if lvl_span.wall_ms is not None:
+        reg.histogram(
+            "ia_level_wall_ms", "host wall-clock per pyramid level (ms)"
+        ).observe(lvl_span.wall_ms)
+
+
 def create_image_analogy(
     a,
     ap,
@@ -968,9 +1051,14 @@ def create_image_analogy(
     and `ap` must share a shape.  Returns B' shaped like `b` (or a dict of
     auxiliary per-level artifacts when `return_aux`; at lean levels —
     past cfg.feature_bytes_budget — the per-level `nnf` entry is a
-    (py, px) plane pair rather than a stacked (H, W, 2) array).  `progress` is an
-    optional utils.progress.ProgressWriter: one timed `level_done` event
-    per pyramid level (SURVEY.md §5 metrics/observability).
+    (py, px) plane pair rather than a stacked (H, W, 2) array).
+
+    `progress`: optional observability hook — a
+    `utils.progress.ProgressWriter` (the historic JSONL interface: one
+    timed `level_done` event per pyramid level) or a
+    `telemetry.Tracer` (span tree + metrics; the JSONL stream is then
+    the tracer's backward-compatible view).  Either way the loop pays
+    exactly one host sync per level; None pays none.
 
     `resume_from`: directory of per-level artifacts written by a prior
     run with `cfg.save_level_artifacts` (SURVEY.md §5 checkpoint/resume).
@@ -979,6 +1067,7 @@ def create_image_analogy(
     run (per-level keys derive from the level index, not the path here).
     """
     cfg = cfg or SynthConfig()
+    tracer = as_tracer(progress)
     a = jnp.asarray(a, jnp.float32)
     ap = jnp.asarray(ap, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
@@ -986,6 +1075,18 @@ def create_image_analogy(
         raise ValueError(f"A {a.shape} and A' {ap.shape} must match")
 
     levels = cfg.clamp_levels(a.shape[:2], b.shape[:2])
+    with tracer.span(
+        "run", matcher=cfg.matcher, levels=levels,
+        shape=[int(s) for s in b.shape[:2]],
+    ):
+        return _synthesize_single(
+            a, ap, b, cfg, levels, return_aux, tracer, resume_from
+        )
+
+
+def _synthesize_single(a, ap, b, cfg: SynthConfig, levels: int,
+                       return_aux: bool, tracer, resume_from):
+    """`create_image_analogy` body, running under its `run` span."""
     prologue_t0 = time.perf_counter()
     (
         pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, pyr_raw_b, yiq_b
@@ -998,7 +1099,7 @@ def create_image_analogy(
     nnf = None
 
     start_level = levels - 1
-    resumed = resume_prologue(resume_from, levels, cfg, b.shape, progress)
+    resumed = resume_prologue(resume_from, levels, cfg, b.shape, tracer)
     if resumed is not None:
         start_level, nnf, bp, aux_fill = resumed
         if return_aux:
@@ -1013,80 +1114,71 @@ def create_image_analogy(
                 return {"bp": out, "nnf": aux["nnf"], "dist": aux["dist"]}
             return out
 
-    if progress is not None:
-        # Drain the async prologue before the first level's clock starts
-        # so its wall is charged to a `prologue` event, not the coarsest
-        # level (the round-2 bench charged 3.4 s of prologue to a 64^2
-        # level).  The scalar readback is the reliable barrier on the
-        # tunnelled platform (see bench.py _sync).
-        float(jnp.sum(pyr_raw_b[levels - 1]))
-        progress.emit(
-            "prologue",
-            wall_ms=round((time.perf_counter() - prologue_t0) * 1000, 3),
-        )
+    record_prologue(tracer, pyr_raw_b, levels, prologue_t0)
 
     for level in range(start_level, -1, -1):
-        level_t0 = time.perf_counter()
-        h, w = pyr_src_b[level].shape[:2]
-        ha, wa = pyr_src_a[level].shape[:2]
-        has_coarse = level < levels - 1
+        with tracer.span("level", level=level) as lvl_span:
+            h, w = pyr_src_b[level].shape[:2]
+            ha, wa = pyr_src_a[level].shape[:2]
+            has_coarse = level < levels - 1
+            lvl_span.set(shape=[int(h), int(w)])
 
-        # All dispatch decisions for the level come from the shared
-        # planner (the lean decision must precede assembly — assembly
-        # is what OOMs).
-        plan = plan_level(
-            cfg, level, pyr_src_a[level], pyr_flt_a[level], has_coarse,
-            h, w, prev_nnf=nnf,
-        )
-        f_a_ext = proj_ext = None
-        if plan.fa_external:
-            f_a_ext, proj_ext = _assemble_fa_fn(cfg, has_coarse)(
+            # All dispatch decisions for the level come from the shared
+            # planner (the lean decision must precede assembly —
+            # assembly is what OOMs).
+            plan = plan_level(
+                cfg, level, pyr_src_a[level], pyr_flt_a[level], has_coarse,
+                h, w, prev_nnf=nnf,
+            )
+            f_a_ext = proj_ext = None
+            if plan.fa_external:
+                f_a_ext, proj_ext = _assemble_fa_fn(cfg, has_coarse)(
+                    pyr_src_a[level],
+                    pyr_flt_a[level],
+                    pyr_src_a[level + 1] if has_coarse else None,
+                    pyr_flt_a[level + 1] if has_coarse else None,
+                )
+            run = _level_fn(
+                cfg, level, has_coarse, plan.lean, plan.prev_kind,
+                plan.fa_external, plan.fuse,
+            )
+            nnf, dist, bp = run(
                 pyr_src_a[level],
                 pyr_flt_a[level],
                 pyr_src_a[level + 1] if has_coarse else None,
                 pyr_flt_a[level + 1] if has_coarse else None,
+                pyr_src_b[level],
+                pyr_src_b[level + 1] if has_coarse else None,
+                pyr_raw_b[level],
+                pyr_copy_a[level],
+                nnf,
+                bp,
+                jax.random.fold_in(key, level),
+                f_a_ext,
+                proj_ext,
             )
-        run = _level_fn(
-            cfg, level, has_coarse, plan.lean, plan.prev_kind,
-            plan.fa_external, plan.fuse,
-        )
-        nnf, dist, bp = run(
-            pyr_src_a[level],
-            pyr_flt_a[level],
-            pyr_src_a[level + 1] if has_coarse else None,
-            pyr_flt_a[level + 1] if has_coarse else None,
-            pyr_src_b[level],
-            pyr_src_b[level + 1] if has_coarse else None,
-            pyr_raw_b[level],
-            pyr_copy_a[level],
-            nnf,
-            bp,
-            jax.random.fold_in(key, level),
-            f_a_ext,
-            proj_ext,
-        )
 
-        if return_aux:
-            # Only keep per-level device state alive when the caller
-            # asked for it: at oracle sizes the accumulated fields are
-            # hundreds of MB held until function exit for nothing.
-            aux["nnf"][level] = nnf
-            aux["dist"][level] = dist
-        if progress is not None:
-            # One device sync per level — the only host sync in the loop
-            # (north-star: minimize host round trips).  The sync is the
-            # scalar readback itself, evaluated BEFORE the clock is
-            # read: block_until_ready can return before remote execution
-            # completes on the tunnelled axon platform, which would
-            # charge this level's tail to the next level's window.
-            nnf_energy = float(dist.mean())
-            progress.emit(
-                "level_done",
-                level=level,
-                shape=[int(h), int(w)],
-                wall_ms=round((time.perf_counter() - level_t0) * 1000, 3),
-                nnf_energy=nnf_energy,
-            )
+            if return_aux:
+                # Only keep per-level device state alive when the caller
+                # asked for it: at oracle sizes the accumulated fields
+                # are hundreds of MB held until function exit for
+                # nothing.
+                aux["nnf"][level] = nnf
+                aux["dist"][level] = dist
+            if tracer.enabled:
+                # One device sync per level — the only host sync in the
+                # loop (north-star: minimize host round trips).  The
+                # sync is the scalar readback itself, evaluated BEFORE
+                # the span closes its clock: block_until_ready can
+                # return before remote execution completes on the
+                # tunnelled axon platform, which would charge this
+                # level's tail to the next level's window.
+                lvl_span.set(nnf_energy=float(dist.mean()))
+        # Span closed: the legacy `level_done` event (wall_ms included)
+        # has been emitted; now attach the compiled-in structure and
+        # update the registry.
+        if tracer.enabled:
+            _record_level_telemetry(tracer, cfg, level, lvl_span, plan)
         if cfg.save_level_artifacts:
             nnf_save = nnf
             if isinstance(nnf, tuple):

@@ -366,6 +366,11 @@ def synthesize_batch(
     whole-stack stats / global-frame-index / total-stack-length
     pass-throughs for chunked calls.
     """
+    import time
+
+    from ..telemetry.spans import as_tracer
+
+    tracer = as_tracer(progress)
     cfg = cfg or SynthConfig()
     mesh = mesh or make_mesh()
     if frames_per_step is not None and frames_per_step < 1:
@@ -466,7 +471,7 @@ def synthesize_batch(
     fp_shape = tuple(frames.shape) + (n_stack, _frame_offset)
 
     start_level = levels - 1
-    resumed = resume_prologue(resume_from, levels, cfg, fp_shape, progress)
+    resumed = resume_prologue(resume_from, levels, cfg, fp_shape, tracer)
     if resumed is not None:
         start_level, nnf, bp, _aux = resumed
         if start_level < 0:
@@ -479,11 +484,17 @@ def synthesize_batch(
             )
             return _finalize_batch(bp, yiq_b, frames, cfg)[:n_frames]
 
+    prologue_t0 = time.perf_counter()
     (
         pyr_src_a, pyr_flt_a, pyr_copy_a, pyr_src_b, pyr_raw_b, yiq_b
     ) = _batch_prologue_fn(cfg, levels, token)(a, ap, frames, _b_stats)
+    # Shared drain + span — uniform report phases across runners.
+    from ..models.analogy import record_prologue
+
+    record_prologue(tracer, pyr_raw_b, levels, prologue_t0)
 
     for level in range(start_level, -1, -1):
+        level_t0 = time.perf_counter()
         h, w = pyr_src_b[level].shape[1:3]
         has_coarse = level < levels - 1
 
@@ -533,10 +544,16 @@ def synthesize_batch(
             proj_ext,
         )
 
-        if progress is not None:
-            progress.emit(
-                "level_done", level=level, shape=[int(h), int(w)],
-                nnf_energy=float(dist.mean()),
+        if tracer.enabled:
+            # Sync first (nnf_energy readback), then record the timed
+            # `level` span — its emitted view is the legacy
+            # `level_done` event, which now also carries wall_ms.
+            nnf_energy = float(dist.mean())
+            tracer.record(
+                "level",
+                round((time.perf_counter() - level_t0) * 1000, 3),
+                level=level, shape=[int(h), int(w)],
+                nnf_energy=nnf_energy,
             )
         if cfg.save_level_artifacts:
             # Whole-batch per-level state through the single-image writer:

@@ -20,6 +20,28 @@ BATCH_AXIS = "batch"
 SPACE_AXIS = "space"
 
 
+def shard_map(f, *, mesh: Mesh, in_specs, out_specs, check_vma: bool = True):
+    """`jax.shard_map` across JAX versions.
+
+    The public `jax.shard_map` (its replication check is the
+    `check_vma` kwarg) landed after 0.4.x; on 0.4.x — this image ships
+    0.4.37, where the bare attribute raises AttributeError — the same
+    transform lives at `jax.experimental.shard_map.shard_map` with the
+    check named `check_rep`.  Every runner routes through here so the
+    sharded paths run on both."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def make_mesh(
     n_devices: Optional[int] = None,
     axis_names: Sequence[str] = (BATCH_AXIS,),

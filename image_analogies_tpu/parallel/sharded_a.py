@@ -94,7 +94,7 @@ from ..models.analogy import (
 )
 from ..models.matcher import candidate_dist_lean
 from ..ops.pyramid import upsample
-from .mesh import make_mesh
+from .mesh import make_mesh, shard_map
 
 _AXIS = "bands"
 
@@ -119,7 +119,23 @@ def _sharded_dist(f_b_tab, f_a_shard, row_lo_flat, idx):
     """Masked local-shard candidate distances merged by pmin: each flat
     A index has exactly one owning band, so the merge reproduces the
     single-table `candidate_dist_lean` value bit-for-bit."""
+    from ..telemetry.metrics import get_registry
+
     n_loc = f_a_shard.shape[0]
+    # Per-device bytes the masked local gather moves for this candidate
+    # batch (idx rows x one bf16 feature row each).  TRACE-TIME count
+    # (telemetry/metrics.py JAX caveat): under jit this tallies bytes
+    # per traced evaluation site, a static per-compilation figure — the
+    # quantity the gather-traffic budget reasons about — not a runtime
+    # execution count.
+    get_registry().counter(
+        "ia_sharded_gather_bytes_total",
+        "bytes gathered per device by sharded-A candidate evaluations "
+        "(trace-time static count)",
+    ).inc(
+        float(np.prod(idx.shape))
+        * f_a_shard.shape[1] * f_a_shard.dtype.itemsize
+    )
     loc = jnp.clip(idx - row_lo_flat, 0, n_loc - 1)
     d_loc = candidate_dist_lean(f_b_tab, f_a_shard, loc)
     owned = (idx >= row_lo_flat) & (idx < row_lo_flat + n_loc)
@@ -196,7 +212,7 @@ def _band_assemble_fn(cfg: SynthConfig, mesh_key, has_coarse: bool,
             ]
             return core.reshape(rows_pb * wa, d)
 
-        return jax.shard_map(
+        return shard_map(
             body,
             mesh=mesh,
             in_specs=(P(_AXIS),) * len(slabs),
@@ -269,7 +285,7 @@ def _sharded_level_fn(cfg: SynthConfig, level: int, has_coarse: bool,
                 flt_bp = bp
             return py, px, dist, bp
 
-        return jax.shard_map(
+        return shard_map(
             body,
             mesh=mesh,
             in_specs=(
@@ -329,6 +345,9 @@ def synthesize_sharded_a(
     )
     from .batch import _mesh_token
 
+    from ..telemetry.spans import as_tracer
+
+    tracer = as_tracer(progress)
     cfg = cfg or SynthConfig()
     mesh = mesh or make_mesh(axis_names=(_AXIS,))
     if mesh.axis_names != (_AXIS,):
@@ -346,9 +365,14 @@ def synthesize_sharded_a(
         raise ValueError(f"A {a.shape} and A' {ap.shape} must match")
 
     levels = cfg.clamp_levels(a.shape[:2], b.shape[:2])
+    prologue_t0 = time.perf_counter()
     (
         pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, pyr_raw_b, yiq_b
     ) = _prologue_fn(cfg, levels)(a, ap, b)
+    # Shared drain + span — uniform report phases across runners.
+    from ..models.analogy import record_prologue
+
+    record_prologue(tracer, pyr_raw_b, levels, prologue_t0)
 
     key = jax.random.PRNGKey(cfg.seed)
     interpret = bool(resolve_pallas(cfg))
@@ -358,7 +382,7 @@ def synthesize_sharded_a(
     nnf = None  # stacked array (replicated levels) or (py, px) planes
     n_sharded_levels = 0
     start_level = levels - 1
-    resumed = resume_prologue(resume_from, levels, cfg, b.shape, progress)
+    resumed = resume_prologue(resume_from, levels, cfg, b.shape, tracer)
     if resumed is not None:
         start_level, nnf, bp, _aux = resumed
         if start_level < 0:
@@ -487,13 +511,17 @@ def synthesize_sharded_a(
                 proj_ext,
             )
 
-        if progress is not None:
+        if tracer.enabled:
+            # Sync (the nnf_energy readback) BEFORE the wall is read,
+            # then record a timed `level` span — the legacy
+            # `level_done` event is the span's emitted view
+            # (telemetry/spans.py).
             nnf_energy = float(dist.mean())
-            progress.emit(
-                "level_done",
+            tracer.record(
+                "level",
+                round((time.perf_counter() - level_t0) * 1000, 3),
                 level=level,
                 shape=[int(h), int(w)],
-                wall_ms=round((time.perf_counter() - level_t0) * 1000, 3),
                 nnf_energy=nnf_energy,
             )
         if cfg.save_level_artifacts:

@@ -82,7 +82,7 @@ from .batch import (
     _lean_step_fn as _spatial_lean_step_fn,
     _mesh_token,
 )
-from .mesh import batch_sharding, make_mesh
+from .mesh import batch_sharding, make_mesh, shard_map
 
 
 def slab_halo(cfg: SynthConfig) -> int:
@@ -199,7 +199,7 @@ def _banded_lean_step_fn(cfg: SynthConfig, level: int, has_coarse: bool,
             return py[None], px[None], dist[None], bp[None]
 
         B, S = P(_BANDS_AXIS), P(_SLABS_AXIS)
-        return jax.shard_map(
+        return shard_map(
             body,
             mesh=mesh,
             in_specs=(B, B, B, S, S, S, S, P(), S, S, S),
@@ -245,6 +245,11 @@ def synthesize_spatial(
     create_image_analogy.  The fingerprint covers the *padded* B shape,
     so checkpoints only resume onto a mesh with the same padding grain.
     """
+    import time
+
+    from ..telemetry.spans import as_tracer
+
+    tracer = as_tracer(progress)
     cfg = cfg or SynthConfig()
     mesh = mesh or make_mesh()
     token = _mesh_token(mesh)
@@ -282,15 +287,22 @@ def synthesize_spatial(
     # bit-identical leaves to create_image_analogy's (the parity tests
     # compare the two runners exactly; separate compilations of the
     # reduction-bearing prologue ops could legally round differently).
+    prologue_t0 = time.perf_counter()
     (
         pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, pyr_raw_b, yiq_b
     ) = _prologue_fn(cfg, levels)(a, ap, b)
+    # Shared drain + span (models/analogy.record_prologue) — every
+    # runner's report carries the same prologue phase
+    # (tools/check_report.py requires it).
+    from ..models.analogy import record_prologue
+
+    record_prologue(tracer, pyr_raw_b, levels, prologue_t0)
 
     key = jax.random.PRNGKey(cfg.seed)
     bp = flt_bp = nnf = None  # global (H_l, W[, C]) state per level
 
     start_level = levels - 1
-    resumed = resume_prologue(resume_from, levels, cfg, b.shape, progress)
+    resumed = resume_prologue(resume_from, levels, cfg, b.shape, tracer)
     if resumed is not None:
         start_level, nnf, bp, _aux = resumed
         flt_bp = bp
@@ -298,6 +310,7 @@ def synthesize_spatial(
             return _finalize(bp, yiq_b, b, cfg)[:h0]
 
     for level in range(start_level, -1, -1):
+        level_t0 = time.perf_counter()
         f_a_src = pyr_src_a[level]
         h, w = pyr_src_b[level].shape[:2]
         ha, wa = f_a_src.shape[:2]
@@ -333,6 +346,21 @@ def synthesize_spatial(
         lean = plan.lean
 
         banded = lean and n_bands > 1
+        if banded and not hasattr(jax, "shard_map"):
+            # The 1-D paths are bit-identity-tested under the 0.4.x
+            # fallback (parallel/mesh.shard_map), but the 2-D bands x
+            # slabs composition produces numerically WRONG results on
+            # it (measured: 2.5% of pixels diverge from the 1-D
+            # reference on jax 0.4.37) — an exit-0 wrong image is the
+            # one failure mode observability cannot catch, so refuse
+            # loudly instead.
+            raise NotImplementedError(
+                "2-D bands x slabs lean levels require the public "
+                "jax.shard_map (jax >= 0.5); this jax only has the "
+                "experimental fallback, whose 2-D composition is "
+                "numerically unreliable here.  Use --sharded-a or a "
+                "1-D --spatial mesh instead."
+            )
         a_stacked = bounds_stacked = None
         if banded and ha % n_bands:
             raise ValueError(
@@ -555,10 +583,16 @@ def synthesize_spatial(
         bp = _merge_cores(bp_s, halo)
         flt_bp = bp
 
-        if progress is not None:
-            progress.emit(
-                "level_done", level=level, shape=[int(h), int(w)],
-                nnf_energy=float(dist.mean()), spatial_slabs=n_slabs,
+        if tracer.enabled:
+            # Sync first (nnf_energy readback), then record the timed
+            # `level` span whose emitted view is the legacy
+            # `level_done` event — which now also carries wall_ms.
+            nnf_energy = float(dist.mean())
+            tracer.record(
+                "level",
+                round((time.perf_counter() - level_t0) * 1000, 3),
+                level=level, shape=[int(h), int(w)],
+                nnf_energy=nnf_energy, spatial_slabs=n_slabs,
             )
         if cfg.save_level_artifacts:
             nnf_save = nnf

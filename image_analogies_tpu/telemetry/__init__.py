@@ -1,0 +1,47 @@
+"""Unified telemetry subsystem (SURVEY.md §5, round 6).
+
+One coherent layer over what used to be three disconnected fragments
+(`utils/progress.py` JSONL events, `utils/profiling.py` device traces,
+`utils/xplane.py` trace parsing):
+
+- `spans`   — hierarchical host span tracing (`Span`/`Tracer`),
+  zero-cost when disabled, emitting the legacy JSONL event stream as
+  a backward-compatible view;
+- `metrics` — counters / gauges / histograms with JSON and
+  Prometheus-text exposition (`MetricsRegistry`, `get_registry`);
+- `report`  — merged run reports joining host spans with
+  device-trace op totals (`build_report`, the `report` CLI
+  subcommand's engine).
+
+Every future perf PR reports against this layer: instrument with
+spans + named-scope tags, count with the registry, publish with the
+report.
+"""
+
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    reset_registry,
+)
+from .report import build_report, render_table, write_report
+from .spans import NULL_TRACER, SCHEMA_VERSION, Span, Tracer, as_tracer
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "reset_registry",
+    "build_report",
+    "render_table",
+    "write_report",
+    "NULL_TRACER",
+    "SCHEMA_VERSION",
+    "Span",
+    "Tracer",
+    "as_tracer",
+]

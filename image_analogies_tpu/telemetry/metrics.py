@@ -1,0 +1,282 @@
+"""Metrics registry — counters, gauges, histograms with JSON and
+Prometheus-text exposition.
+
+Instrumented sites (drivers, kernels, parallel runners) update a
+registry; `to_dict()` feeds `report.json` / bench rows and
+`to_prometheus()` renders the standard text exposition format for
+scrape-style consumers.  Stdlib-only and thread-safe (one lock per
+registry — these are host-side bookkeeping ops, never on a hot device
+path).
+
+JAX caveat, stated once here and referenced by every instrumented
+site: code under `jax.jit` runs its Python body at TRACE time, so a
+counter bumped inside a jitted function counts *traced* launches (one
+per compilation), not executions.  Sites that want per-run numbers
+increment from the driver loop (host side) with statically-known
+amounts — e.g. `em_iters_total.inc(cfg.em_iters)` per level — and
+sites inside traced code (kernel launches, sharded-gather bytes) are
+documented as trace-time counts where they live.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# Default histogram buckets: wall-clock-ish exponential ms scale, wide
+# enough for both a 64^2 CPU level (~10 ms) and a 4096^2 lean level
+# (~minutes).
+_DEFAULT_BUCKETS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0, 300000.0,
+)
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> _LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
+
+
+def _label_str(key: _LabelKey) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+class Counter:
+    """Monotonic counter (per label set)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._values: Dict[_LabelKey, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: negative inc {amount}")
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def to_dict(self):
+        return {
+            _label_str(k) or "total": v for k, v in sorted(self._values.items())
+        }
+
+    def expose(self) -> List[str]:
+        return [
+            f"{self.name}{_label_str(k)} {_fmt(v)}"
+            for k, v in sorted(self._values.items())
+        ] or [f"{self.name} 0"]
+
+
+class Gauge:
+    """Last-write-wins value (per label set)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._values: Dict[_LabelKey, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def value(self, labels: Optional[Dict[str, str]] = None):
+        return self._values.get(_label_key(labels))
+
+    def to_dict(self):
+        return {
+            _label_str(k) or "value": v
+            for k, v in sorted(self._values.items())
+        }
+
+    def expose(self) -> List[str]:
+        return [
+            f"{self.name}{_label_str(k)} {_fmt(v)}"
+            for k, v in sorted(self._values.items())
+        ]
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each `le`
+    bucket counts observations <= its bound, plus +Inf/count/sum)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Tuple[float, ...] = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[_LabelKey, List[int]] = {}
+        self._sums: Dict[_LabelKey, float] = {}
+        self._totals: Dict[_LabelKey, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + float(value)
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, labels: Optional[Dict[str, str]] = None) -> int:
+        return self._totals.get(_label_key(labels), 0)
+
+    def sum(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._sums.get(_label_key(labels), 0.0)
+
+    def to_dict(self):
+        out = {}
+        for key in sorted(self._totals):
+            out[_label_str(key) or "total"] = {
+                "count": self._totals[key],
+                "sum": round(self._sums[key], 6),
+                "buckets": dict(
+                    zip((str(b) for b in self.buckets), self._counts[key])
+                ),
+            }
+        return out
+
+    def expose(self) -> List[str]:
+        lines = []
+        for key in sorted(self._totals):
+            base = dict(key)
+            for bound, c in zip(self.buckets, self._counts[key]):
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_label_str(_label_key({**base, 'le': _fmt(bound)}))}"
+                    f" {c}"
+                )
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_label_str(_label_key({**base, 'le': '+Inf'}))}"
+                f" {self._totals[key]}"
+            )
+            lines.append(
+                f"{self.name}_sum{_label_str(key)} {_fmt(self._sums[key])}"
+            )
+            lines.append(
+                f"{self.name}_count{_label_str(key)} {self._totals[key]}"
+            )
+        return lines
+
+
+def _fmt(v: float) -> str:
+    """Prometheus-friendly number: integral values without the '.0'."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class MetricsRegistry:
+    """Named metric factory + exposition.  `counter`/`gauge`/
+    `histogram` get-or-create (re-registration with a different kind
+    is an error — silent aliasing would corrupt both series)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Tuple[float, ...] = _DEFAULT_BUCKETS
+                  ) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def to_dict(self) -> Dict[str, Dict]:
+        """JSON exposition: {name: {kind, help, values}}."""
+        return {
+            name: {"kind": m.kind, "help": m.help, "values": m.to_dict()}
+            for name, m in sorted(self._metrics.items())
+        }
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        for name, m in sorted(self._metrics.items()):
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+# Process-default registry: instrumented sites that are not threaded a
+# registry explicitly (kernels, parallel runners) record here.  A
+# telemetry session (utils/profiling.telemetry_session) installs its
+# own fresh registry for its duration so per-run expositions report
+# per-run counts; tests snapshot/reset around runs.
+_global_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _global_registry
+
+
+def set_registry(reg: Optional[MetricsRegistry]) -> MetricsRegistry:
+    """Install `reg` as the process-default registry (None restores a
+    fresh one) and return the previous default — the swap/restore pair
+    a telemetry session brackets a run with."""
+    global _global_registry
+    prev = _global_registry
+    _global_registry = reg if reg is not None else MetricsRegistry()
+    return prev
+
+
+def reset_registry() -> None:
+    """Clear the default registry (test isolation)."""
+    _global_registry.reset()
+
+
+def count_kernel_launch(kernel: str) -> None:
+    """Bump the shared Pallas-kernel launch counter — called at the
+    top of each kernel wrapper (kernels/patchmatch_tile.tile_sweep,
+    kernels/nn_brute.exact_nn_pallas).
+
+    TRACE-TIME count (module docstring's jit caveat): one bump per
+    call site traced into a compilation — e.g. tile_sweep's
+    pm_iters x n_bands x em_iters dispatch structure — not a
+    per-execution runtime count."""
+    get_registry().counter(
+        "ia_kernel_launches_total",
+        "Pallas kernel launches traced into compilations "
+        "(trace-time count)",
+    ).inc(labels={"kernel": kernel})

@@ -1,0 +1,272 @@
+"""Hierarchical span tracing — the host half of the telemetry layer.
+
+A `Span` is one timed region of a run (the whole run, a pyramid level,
+an EM iteration, a matcher phase); a `Tracer` owns the active span
+stack, the finished span forest, and an optional legacy-event sink.
+Three design rules, in priority order:
+
+1. **Zero cost when disabled.**  The drivers call `tracer.span(...)`
+   inside their level loops; a disabled tracer returns a shared no-op
+   context manager and never touches the clock, so un-instrumented runs
+   keep the one-sync-per-run contract (north star: minimal host round
+   trips).  Use `as_tracer(progress)` at every runner entry: it maps
+   None -> the disabled singleton, a ProgressWriter -> an enabled
+   tracer, a Tracer -> itself.
+
+2. **The legacy JSONL stream is a VIEW of the span tree.**  Existing
+   consumers (tests/test_profiling.py, bench.py's readers, any user
+   tailing `--progress`) see the same events as before: a span named
+   in `_SPAN_EVENTS` emits its legacy event (`level_done`, `prologue`)
+   on close, with the same fields (`wall_ms`, span attrs).  Ad-hoc
+   events (`start`, `done`, `resume`) go through `Tracer.emit`, which
+   also records them as zero-duration marks on the tree.
+
+3. **Compiled-in structure is annotated, not host-timed.**  EM
+   iterations and matcher phases execute inside ONE jitted level call
+   (models/analogy.py `_level_fn_cached` — the dispatch-fusion design
+   the 1024^2 headline rests on), so the host cannot clock them
+   without breaking that fusion.  They are recorded as untimed child
+   spans (`timed: false`); their device-side cost is recovered from
+   the xplane trace by the report joiner (telemetry/report.py), keyed
+   by the `jax.named_scope` tags the instrumented code emits.
+
+Event/span schema (versioned — consumed by telemetry/report.py and
+tools/check_report.py):
+
+    span: {"name": str, "t": rel-start-s, "ts": ISO-8601 UTC start,
+           "wall_ms": float | None (untimed), "attrs": {...},
+           "children": [span, ...]}
+    tree: {"schema_version": 1, "t0": ISO-8601, "spans": [span, ...]}
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.progress import _iso_now
+
+SCHEMA_VERSION = 1
+
+# Span name -> legacy JSONL event emitted on close (the backward-
+# compatible view rule 2 promises).  Spans outside this table are
+# tree-only.
+_SPAN_EVENTS = {
+    "level": "level_done",
+    "prologue": "prologue",
+    "run": "run_done",
+}
+
+
+class Span:
+    """One node of the span tree.  Created via `Tracer.span` (timed) or
+    `Tracer.annotate` (untimed, compiled-in structure); closes on
+    context exit.  `set(**attrs)` attaches fields mid-flight (e.g. the
+    level loop sets `nnf_energy` after its sync)."""
+
+    __slots__ = (
+        "name", "attrs", "children", "t_start", "t_end", "ts", "timed",
+        "_tracer",
+    )
+
+    def __init__(self, name: str, attrs: Dict[str, Any], tracer,
+                 timed: bool = True):
+        self.name = name
+        self.attrs = dict(attrs)
+        self.children: List[Span] = []
+        self.timed = timed
+        self.t_start = time.perf_counter() if timed else None
+        self.t_end: Optional[float] = None
+        self.ts = _iso_now()
+        self._tracer = tracer
+
+    @property
+    def wall_ms(self) -> Optional[float]:
+        if not self.timed or self.t_end is None:
+            return None
+        return round((self.t_end - self.t_start) * 1000, 3)
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self.timed:
+            self.t_end = time.perf_counter()
+        self._tracer._close(self)
+
+    def to_dict(self, t0: float) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "name": self.name,
+            "ts": self.ts,
+            "t": (
+                round(self.t_start - t0, 4) if self.t_start is not None
+                else None
+            ),
+            "wall_ms": self.wall_ms,
+            "attrs": self.attrs,
+        }
+        if self.children:
+            rec["children"] = [c.to_dict(t0) for c in self.children]
+        return rec
+
+
+class _NullSpan:
+    """Shared do-nothing span: the disabled tracer hands out ONE of
+    these, so a disabled `tracer.span(...)` allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+    def set(self, **attrs):
+        return self
+
+    children = ()
+    attrs: Dict[str, Any] = {}
+    wall_ms = None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Span collector + legacy-event emitter.
+
+    `sink`: optional utils.progress.ProgressWriter (or anything with
+    `.emit(event, **fields)`) that receives the legacy JSONL view.
+    `registry`: optional telemetry.metrics.MetricsRegistry the
+    instrumented drivers update alongside spans (kept here so one
+    object can be threaded through every runner).
+    """
+
+    def __init__(self, sink=None, registry=None, enabled: bool = True):
+        self.enabled = enabled
+        self.sink = sink
+        self.registry = registry
+        self._t0 = time.perf_counter()
+        self._ts0 = _iso_now()
+        self._stack: List[Span] = []
+        self.roots: List[Span] = []
+
+    # -- recording ----------------------------------------------------
+    def span(self, name: str, **attrs):
+        """Open a timed span as a context manager; emits the span's
+        legacy event (if any) on close."""
+        if not self.enabled:
+            return _NULL_SPAN
+        sp = Span(name, attrs, self)
+        self._push(sp)
+        return sp
+
+    def annotate(self, name: str, parent: Optional[Span] = None, **attrs):
+        """Record an UNTIMED child span under `parent` (default: the
+        current span) — compiled-in structure (EM iterations, matcher
+        phases) whose host wall is meaningless because it executes
+        inside one jitted call (module docstring, rule 3)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        sp = Span(name, attrs, self, timed=False)
+        if parent is not None:
+            parent.children.append(sp)
+        else:
+            self._attach(sp)
+        return sp
+
+    def record(self, name: str, wall_ms: float, **attrs):
+        """Record an already-measured span (e.g. the prologue, whose
+        clock starts before the tracer knows whether a sync will pay
+        for itself) — closed immediately with the given wall, emitting
+        the legacy event like a context-managed span would.  Both
+        `t_start` and `ts` are backdated by `wall_ms`, keeping the
+        schema's 'ts = start' promise for after-the-fact spans."""
+        if not self.enabled:
+            return _NULL_SPAN
+        sp = Span(name, attrs, self)
+        sp.t_start = time.perf_counter() - wall_ms / 1000.0
+        sp.t_end = sp.t_start + wall_ms / 1000.0
+        sp.ts = _iso_now(-wall_ms)
+        self._attach(sp)
+        self._close(sp)
+        return sp
+
+    def emit(self, event: str, **fields) -> None:
+        """Ad-hoc legacy event (`start`, `done`, `resume`) — forwarded
+        to the sink verbatim and recorded as a zero-duration mark, so
+        ProgressWriter call sites can pass a Tracer unchanged."""
+        if not self.enabled:
+            return
+        mark = Span(event, fields, self, timed=False)
+        self._attach(mark)
+        if self.sink is not None:
+            self.sink.emit(event, **fields)
+
+    # -- internals ----------------------------------------------------
+    def _attach(self, sp: Span) -> None:
+        (self._stack[-1].children if self._stack else self.roots).append(sp)
+
+    def _push(self, sp: Span) -> None:
+        self._attach(sp)
+        self._stack.append(sp)
+
+    def _close(self, sp: Span) -> None:
+        if self._stack and self._stack[-1] is sp:
+            self._stack.pop()
+        event = _SPAN_EVENTS.get(sp.name)
+        if event and self.sink is not None:
+            fields = dict(sp.attrs)
+            if sp.wall_ms is not None:
+                fields["wall_ms"] = sp.wall_ms
+            self.sink.emit(event, **fields)
+
+    # -- output -------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "t0": self._ts0,
+            "spans": [s.to_dict(self._t0) for s in self.roots],
+        }
+
+    def write(self, path: str) -> None:
+        """Serialize the span tree atomically (tmp + rename): the
+        telemetry session writes this in a crash's finally block, and
+        a half-written host_spans.json would poison the very report
+        that crash needs."""
+        from ..utils.io import atomic_write_json
+
+        atomic_write_json(path, self.to_dict())
+
+    def find(self, name: str) -> List[Span]:
+        """All spans named `name`, depth-first — test/report helper."""
+        out: List[Span] = []
+
+        def walk(spans):
+            for s in spans:
+                if s.name == name:
+                    out.append(s)
+                walk(s.children)
+
+        walk(self.roots)
+        return out
+
+
+NULL_TRACER = Tracer(enabled=False)
+
+
+def as_tracer(progress) -> Tracer:
+    """Adapt every runner's `progress` argument: None -> the disabled
+    singleton; a Tracer -> itself; anything with `.emit` (the historic
+    ProgressWriter contract) -> an enabled Tracer emitting the legacy
+    JSONL view through it."""
+    if progress is None:
+        return NULL_TRACER
+    if isinstance(progress, Tracer):
+        return progress
+    return Tracer(sink=progress)

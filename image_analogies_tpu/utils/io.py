@@ -26,3 +26,19 @@ def save_image(path: str, img) -> None:
     arr = np.asarray(img)
     arr = np.clip(arr * 255.0 + 0.5, 0, 255).astype(np.uint8)
     Image.fromarray(arr).save(path)
+
+
+def atomic_write_json(path: str, obj) -> None:
+    """JSON to `path` via tmp + rename, so a kill mid-write never
+    leaves a truncated file where a consumer would trip over it — the
+    same discipline the checkpoint writer applies to its .npz
+    artifacts (models/analogy._save_level).  Used for every telemetry
+    artifact (host_spans.json, report.json)."""
+    import json
+    import os
+
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)

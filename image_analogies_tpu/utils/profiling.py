@@ -4,9 +4,15 @@
 Perfetto/XProf traces (TensorBoard-loadable) of every XLA executable and
 Pallas kernel launch in the region — the TPU-native replacement for the
 host profilers a CPU reference would use.  Wall-clock per-level timings
-come from the drivers themselves (models/analogy.py emits `level_done`
-events with a single block_until_ready sync per level), not from this
+come from the drivers themselves (models/analogy.py runs under
+`telemetry.Tracer` spans with a single sync per level), not from this
 module.
+
+`telemetry_session` is the one-stop wrapper the CLI drives: device
+trace + host span tracer + end-of-run artifact writes (host_spans.json,
+metrics.json, metrics.prom) into the same trace directory, which is
+exactly the layout `telemetry.report.build_report` (the `report`
+subcommand) joins.
 """
 
 from __future__ import annotations
@@ -25,3 +31,63 @@ def device_trace(trace_dir: Optional[str]) -> Iterator[None]:
 
     with jax.profiler.trace(trace_dir):
         yield
+
+
+_SAME_AS_TRACE_DIR = object()
+
+
+@contextlib.contextmanager
+def telemetry_session(trace_dir: Optional[str], sink=None,
+                      enabled: bool = True,
+                      artifact_dir=_SAME_AS_TRACE_DIR):
+    """Device trace + span tracer + telemetry artifact writes.
+
+    Yields a `telemetry.Tracer` (disabled when `enabled` is False, so
+    un-instrumented runs stay zero-cost).  An enabled session owns a
+    FRESH metrics registry, installed as the process default for the
+    session's duration — so `metrics.json` reports this run's counts,
+    not everything the process has ever accumulated (kernel-launch and
+    sharded-gather counters record through `get_registry()` and land
+    in the session's registry too).
+
+    On exit — crash included, a partial run's telemetry is exactly
+    when you want the evidence — writes into `artifact_dir` (default:
+    `trace_dir`; the CLI passes them separately so the historic
+    device-trace-only `--profile` dir stays artifact-free):
+
+      host_spans.json   the span tree (telemetry/spans.py schema)
+      metrics.json      the registry's JSON exposition
+      metrics.prom      the registry's Prometheus text exposition
+
+    alongside whatever `*.xplane.pb` files `jax.profiler.trace` left,
+    making the directory self-contained input for the `report`
+    subcommand."""
+    import json
+    import os
+
+    from ..telemetry import NULL_TRACER, MetricsRegistry, Tracer
+    from ..telemetry.metrics import set_registry
+
+    if artifact_dir is _SAME_AS_TRACE_DIR:
+        artifact_dir = trace_dir
+    if enabled:
+        reg = MetricsRegistry()
+        tracer = Tracer(sink=sink, registry=reg)
+        prev_reg = set_registry(reg)
+    else:
+        tracer = NULL_TRACER
+        reg = prev_reg = None
+    try:
+        with device_trace(trace_dir):
+            yield tracer
+    finally:
+        if enabled:
+            set_registry(prev_reg)
+        if artifact_dir and tracer.enabled:
+            os.makedirs(artifact_dir, exist_ok=True)
+            tracer.write(os.path.join(artifact_dir, "host_spans.json"))
+            with open(os.path.join(artifact_dir, "metrics.json"), "w") as f:
+                json.dump(reg.to_dict(), f, indent=1)
+                f.write("\n")
+            with open(os.path.join(artifact_dir, "metrics.prom"), "w") as f:
+                f.write(reg.to_prometheus())

@@ -1,8 +1,18 @@
 """Observability: stdlib logging + JSON-lines progress events
-(SURVEY.md §5 metrics/logging)."""
+(SURVEY.md §5 metrics/logging).
+
+`ProgressWriter` is the legacy JSONL event sink; since round 6 it is
+usually driven as the sink of a `telemetry.Tracer` (the span layer
+emits the same event stream as its backward-compatible view) but
+remains directly usable.  Each event record carries both the relative
+`t` (seconds since writer construction, the historic field) and an
+absolute ISO-8601 UTC `ts` so streams from different hosts/runs can be
+aligned without knowing each writer's epoch.
+"""
 
 from __future__ import annotations
 
+import datetime as _dt
 import json
 import logging
 import time
@@ -10,18 +20,93 @@ from typing import Optional
 
 logger = logging.getLogger("image_analogies_tpu")
 
+_LEVELS = ("debug", "info", "warning", "error", "critical")
+
+
+def configure_logging(level: Optional[str]) -> None:
+    """Attach a stderr handler + formatter to the package logger at
+    `level` ('debug' | 'info' | ...; None = leave logging untouched).
+
+    Without this the package logs into a handler-less logger, which
+    under Python's default config prints nothing below WARNING — the
+    CLI's `--log-level` flag routes here so `--log-level info` actually
+    surfaces the per-event log lines.  Idempotent: re-configuring
+    adjusts the level instead of stacking handlers.
+    """
+    if level is None:
+        return
+    level = level.lower()
+    if level not in _LEVELS:
+        raise ValueError(f"log level {level!r} not in {_LEVELS}")
+    logger.setLevel(getattr(logging, level.upper()))
+    for h in logger.handlers:
+        if getattr(h, "_ia_cli_handler", False):
+            h.setLevel(getattr(logging, level.upper()))
+            return
+    handler = logging.StreamHandler()
+    handler._ia_cli_handler = True  # type: ignore[attr-defined]
+    handler.setLevel(getattr(logging, level.upper()))
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s %(message)s"
+        )
+    )
+    logger.addHandler(handler)
+
+
+def _iso_now(offset_ms: float = 0.0) -> str:
+    """ISO-8601 UTC timestamp, optionally shifted by `offset_ms`
+    (negative = in the past — telemetry spans recorded after the fact
+    backdate their start this way)."""
+    t = _dt.datetime.now(_dt.timezone.utc)
+    if offset_ms:
+        t += _dt.timedelta(milliseconds=offset_ms)
+    return t.isoformat(timespec="milliseconds").replace("+00:00", "Z")
+
 
 class ProgressWriter:
-    """Append one JSON object per event to a .jsonl file (or log only)."""
+    """Append one JSON object per event to a .jsonl file (or log only).
+
+    The file is opened ONCE, line-buffered, on the first emit and held
+    for the writer's lifetime — the original implementation reopened
+    the file per event, an O(events) syscall tax that also left no
+    single handle for consumers to tail reliably.  Line buffering
+    keeps the durability property the per-event reopen provided: every
+    event is flushed to the OS as soon as its line is written, so a
+    killed run's stream is complete up to the crash.
+    """
 
     def __init__(self, path: Optional[str] = None):
         self.path = path
         self._t0 = time.perf_counter()
+        self._f = None
 
     def emit(self, event: str, **fields) -> None:
-        rec = {"event": event, "t": round(time.perf_counter() - self._t0, 4)}
+        rec = {
+            "event": event,
+            "t": round(time.perf_counter() - self._t0, 4),
+            "ts": _iso_now(),
+        }
         rec.update(fields)
         logger.info("%s %s", event, fields)
         if self.path:
-            with open(self.path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+            if self._f is None:
+                self._f = open(self.path, "a", buffering=1)
+            self._f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self) -> "ProgressWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # best-effort: line buffering already flushed
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass

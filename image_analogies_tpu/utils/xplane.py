@@ -39,7 +39,12 @@ _WIRE_I32 = 5
 def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
     result = 0
     shift = 0
+    n = len(buf)
     while True:
+        if pos >= n:
+            raise ValueError(
+                f"truncated varint at byte {pos} (buffer of {n})"
+            )
         b = buf[pos]
         pos += 1
         result |= (b & 0x7F) << shift
@@ -51,7 +56,10 @@ def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
 def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
     """Yield (field_number, wire_type, value) over a message buffer.
     LEN fields yield the raw bytes; varints the int; fixed widths the
-    raw little-endian bytes (unused here)."""
+    raw little-endian bytes (unused here).  A buffer that ends inside a
+    field raises ValueError instead of yielding a silently-truncated
+    payload — a half-written trace must fail loudly, not decode to
+    wrong totals with exit 0."""
     pos = 0
     n = len(buf)
     while pos < n:
@@ -61,12 +69,21 @@ def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
             val, pos = _read_varint(buf, pos)
         elif wire == _WIRE_LEN:
             ln, pos = _read_varint(buf, pos)
+            if pos + ln > n:
+                raise ValueError(
+                    f"truncated length-delimited field {field}: "
+                    f"{ln} bytes declared, {n - pos} remain"
+                )
             val = buf[pos : pos + ln]
             pos += ln
         elif wire == _WIRE_I64:
+            if pos + 8 > n:
+                raise ValueError(f"truncated fixed64 field {field}")
             val = buf[pos : pos + 8]
             pos += 8
         elif wire == _WIRE_I32:
+            if pos + 4 > n:
+                raise ValueError(f"truncated fixed32 field {field}")
             val = buf[pos : pos + 4]
             pos += 4
         else:
@@ -180,6 +197,49 @@ def device_op_totals(
                     name = meta.get(mid, f"op_{mid}")
                     ops[name] = ops.get(name, 0.0) + dur_ps / 1e9
     return out
+
+
+def scope_totals(ops: Dict[str, float], tag_pattern: str
+                 ) -> Dict[str, float]:
+    """Group already-decoded per-op totals `{op_name: ms}` by the first
+    regex capture of `tag_pattern`; ops that don't match are dropped.
+
+    This is how the run-report joiner (telemetry/report.py) attributes
+    device time to pyramid levels / EM iterations / matcher phases:
+    the instrumented drivers wrap those regions in `jax.named_scope`
+    tags (`tlm_L<level>`, `tlm_em<i>`, `tlm_<phase>`), XLA threads the
+    scope path into op metadata, and the profiler surfaces it as the
+    op display name — so a scope's device cost is the sum over ops
+    whose name carries its tag.  Taking pre-decoded totals lets one
+    (slow, pure-Python) trace decode feed several groupings.
+    Best-effort by design: a backend that strips framework op names
+    (or forwards no device planes at all) yields {} and the report
+    records nulls, never guesses."""
+    import re
+
+    pat = re.compile(tag_pattern)
+    out: Dict[str, float] = {}
+    for name, ms in ops.items():
+        m = pat.search(name)
+        if m:
+            tag = m.group(1)
+            out[tag] = out.get(tag, 0.0) + ms
+    return out
+
+
+def device_scope_totals(
+    trace_dir: str, tag_pattern: str,
+    line_filter: Optional[str] = "XLA Ops",
+) -> Dict[str, float]:
+    """`scope_totals` over a trace directory's decoded op totals — the
+    one-shot convenience form; callers grouping by several patterns
+    should decode once via `device_op_totals` and call `scope_totals`
+    per pattern (telemetry/report.py does)."""
+    flat: Dict[str, float] = {}
+    for ops in device_op_totals(trace_dir, line_filter).values():
+        for name, ms in ops.items():
+            flat[name] = flat.get(name, 0.0) + ms
+    return scope_totals(flat, tag_pattern)
 
 
 def device_busy_ms(trace_dir: str) -> Optional[float]:

@@ -28,6 +28,114 @@ def test_per_level_progress_events(tmp_path, rng):
     assert level_events[0]["shape"] == [16, 16]
 
 
+def test_span_tree_and_metrics_for_two_level_run(rng):
+    """Round-6 telemetry: a 2-level run under a Tracer produces the
+    documented span hierarchy — run -> {prologue, level x2 ->
+    em_iter x em_iters -> {assemble, match, render}} — with timed
+    walls at run/prologue/level granularity, untimed annotation spans
+    for the compiled-in structure, and registry counters matching the
+    statically-known work (em_iters x levels)."""
+    from image_analogies_tpu.telemetry import MetricsRegistry, Tracer
+
+    a = rng.random((32, 32)).astype(np.float32)
+    ap = rng.random((32, 32)).astype(np.float32)
+    b = rng.random((32, 32)).astype(np.float32)
+    cfg = SynthConfig(levels=2, matcher="brute", em_iters=2)
+    registry = MetricsRegistry()  # private registry: test isolation
+    tracer = Tracer(registry=registry)
+    create_image_analogy(a, ap, b, cfg, progress=tracer)
+
+    (run,) = tracer.find("run")
+    assert run.wall_ms > 0.0
+    assert run.attrs["matcher"] == "brute" and run.attrs["levels"] == 2
+    child_names = [c.name for c in run.children]
+    assert child_names == ["prologue", "level", "level"]
+
+    levels = tracer.find("level")
+    assert [sp.attrs["level"] for sp in levels] == [1, 0]  # coarse->fine
+    for sp in levels:
+        assert sp.wall_ms > 0.0
+        assert sp.attrs["nnf_energy"] >= 0.0
+        em_iters = [c for c in sp.children if c.name == "em_iter"]
+        assert [c.attrs["em"] for c in em_iters] == [0, 1]
+        for em in em_iters:
+            # Compiled-in structure: untimed by design (the EM loop
+            # runs inside one jitted level call).
+            assert em.wall_ms is None
+            assert [p.name for p in em.children] == [
+                "assemble", "match", "render",
+            ]
+
+    # Counters are host-driven statically-known quantities.
+    assert registry.counter("ia_levels_total").value() == 2
+    assert registry.counter("ia_em_iters_total").value() == 2 * 2
+    assert registry.histogram("ia_level_wall_ms").count() == 2
+    for level in ("0", "1"):
+        energy = registry.gauge("ia_nnf_energy").value(
+            labels={"level": level}
+        )
+        assert energy is not None and energy >= 0.0
+
+
+def test_tracer_jsonl_view_matches_legacy_schema(tmp_path, rng):
+    """The tracer's sink stream is a backward-compatible view: the
+    same `level_done` records (level/shape/wall_ms/nnf_energy) the
+    ProgressWriter-only path has always produced."""
+    from image_analogies_tpu.telemetry import Tracer
+
+    path = str(tmp_path / "prog.jsonl")
+    a = rng.random((32, 32)).astype(np.float32)
+    ap = rng.random((32, 32)).astype(np.float32)
+    b = rng.random((32, 32)).astype(np.float32)
+    cfg = SynthConfig(levels=2, matcher="brute", em_iters=1)
+    create_image_analogy(
+        a, ap, b, cfg, progress=Tracer(sink=ProgressWriter(path))
+    )
+    events = [json.loads(line) for line in open(path)]
+    level_events = [e for e in events if e["event"] == "level_done"]
+    assert [e["level"] for e in level_events] == [1, 0]
+    for e in level_events:
+        assert e["wall_ms"] > 0.0
+        assert e["nnf_energy"] >= 0.0
+        assert e["shape"] in ([16, 16], [32, 32])
+        assert "ts" in e  # round-6 satellite: absolute ISO-8601 stamp
+
+
+def test_progress_writer_holds_one_handle_and_stamps_ts(tmp_path):
+    """Satellite: ProgressWriter opens its JSONL file once (no
+    per-event reopen) and each record carries both the relative `t`
+    and an absolute ISO-8601 `ts`."""
+    path = str(tmp_path / "p.jsonl")
+    w = ProgressWriter(path)
+    w.emit("start", foo=1)
+    f_first = w._f
+    assert f_first is not None
+    w.emit("done", bar=2)
+    assert w._f is f_first  # same handle, not reopened
+    w.close()
+    recs = [json.loads(line) for line in open(path)]
+    assert [r["event"] for r in recs] == ["start", "done"]
+    for r in recs:
+        assert r["t"] >= 0.0
+        # ISO-8601 UTC, e.g. 2026-08-04T12:34:56.789Z
+        assert r["ts"].endswith("Z") and "T" in r["ts"]
+
+
+def test_disabled_tracer_is_inert(rng):
+    """Zero-cost-when-disabled contract: the null tracer hands out a
+    shared no-op span and records nothing."""
+    from image_analogies_tpu.telemetry import NULL_TRACER, as_tracer
+
+    assert as_tracer(None) is NULL_TRACER
+    sp1 = NULL_TRACER.span("level", level=0)
+    sp2 = NULL_TRACER.span("level", level=1)
+    assert sp1 is sp2  # shared singleton, no allocation per call
+    with sp1 as s:
+        s.set(anything=1)
+    NULL_TRACER.emit("start")
+    assert NULL_TRACER.roots == []
+
+
 def test_device_trace_writes_trace_dir(tmp_path):
     import jax.numpy as jnp
 
@@ -46,23 +154,9 @@ def test_device_trace_noop_without_dir():
         pass
 
 
-def _tag(field: int, wire: int) -> bytes:
-    return _varint((field << 3) | wire)
-
-
-def _varint(v: int) -> bytes:
-    out = b""
-    while True:
-        b7 = v & 0x7F
-        v >>= 7
-        if v:
-            out += bytes([b7 | 0x80])
-        else:
-            return out + bytes([b7])
-
-
-def _ld(field: int, payload: bytes) -> bytes:
-    return _tag(field, 2) + _varint(len(payload)) + payload
+# Shared wire-format builders (tests/xplane_fixtures.py — one copy for
+# every xplane fixture in the suite).
+from xplane_fixtures import ld as _ld, tag as _tag, varint as _varint
 
 
 def test_xplane_decoder_on_synthetic_trace(tmp_path):

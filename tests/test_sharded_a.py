@@ -156,7 +156,9 @@ def test_sharded_a_band_search_matches_sequential(rng):
         oy, ox, d = sweep_one_band(band_planes[0], band[0])
         return oy[None], ox[None], d[None]
 
-    oy_g, ox_g, d_g = jax.shard_map(
+    from image_analogies_tpu.parallel.mesh import shard_map
+
+    oy_g, ox_g, d_g = shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P("bands"), P("bands")),

@@ -1,0 +1,351 @@
+"""Telemetry subsystem tests (round 6): metrics registry semantics +
+expositions, span/tracer contracts beyond the driver integration
+(tests/test_profiling.py), the host+device report join, the `report`
+CLI subcommand, and the tools/check_report.py validator (its pytest
+wrapper — the same rules tier-1 and the CLI tool enforce)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from check_report import validate_report  # noqa: E402 (tools/ import)
+
+from image_analogies_tpu.telemetry import (  # noqa: E402
+    MetricsRegistry,
+    Tracer,
+    build_report,
+    render_table,
+)
+from image_analogies_tpu.telemetry.report import (  # noqa: E402
+    spans_from_progress,
+)
+
+
+# ---------------------------------------------------------------- metrics
+class TestMetricsRegistry:
+    def test_counter_inc_and_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total", "help text")
+        c.inc()
+        c.inc(2)
+        c.inc(labels={"kernel": "tile_sweep"})
+        assert c.value() == 3
+        assert c.value(labels={"kernel": "tile_sweep"}) == 1
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_last_write_wins(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("g")
+        assert g.value() is None
+        g.set(1.5)
+        g.set(2.5)
+        assert g.value() == 2.5
+
+    def test_histogram_cumulative_buckets(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h_ms", buckets=(1.0, 10.0, 100.0))
+        for v in (0.5, 5.0, 50.0, 500.0):
+            h.observe(v)
+        assert h.count() == 4
+        assert h.sum() == 555.5
+        d = h.to_dict()["total"]
+        # Prometheus semantics: each bucket counts observations <= le.
+        assert d["buckets"] == {"1.0": 1, "10.0": 2, "100.0": 3}
+
+    def test_kind_collision_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x")
+
+    def test_get_or_create_returns_same_object(self):
+        reg = MetricsRegistry()
+        assert reg.counter("x") is reg.counter("x")
+
+    def test_prometheus_exposition_format(self):
+        reg = MetricsRegistry()
+        reg.counter("req_total", "requests").inc(3)
+        reg.gauge("temp").set(1.5, labels={"level": "0"})
+        reg.histogram("lat_ms", buckets=(10.0,)).observe(5.0)
+        text = reg.to_prometheus()
+        assert "# HELP req_total requests" in text
+        assert "# TYPE req_total counter" in text
+        assert "req_total 3" in text
+        assert 'temp{level="0"} 1.5' in text
+        assert 'lat_ms_bucket{le="10"} 1' in text
+        assert 'lat_ms_bucket{le="+Inf"} 1' in text
+        assert "lat_ms_sum 5" in text
+        assert "lat_ms_count 1" in text
+
+    def test_json_exposition_shape(self):
+        reg = MetricsRegistry()
+        reg.counter("c", "ch").inc()
+        d = reg.to_dict()
+        assert d["c"] == {"kind": "counter", "help": "ch",
+                          "values": {"total": 1.0}}
+
+
+# ----------------------------------------------------------------- spans
+class TestTracer:
+    def test_nesting_follows_context_stack(self):
+        tr = Tracer()
+        with tr.span("run"):
+            with tr.span("level", level=0):
+                tr.emit("resume", from_level=1)
+        (run,) = tr.roots
+        assert run.name == "run"
+        (level,) = run.children
+        assert level.name == "level"
+        assert [c.name for c in level.children] == ["resume"]
+
+    def test_legacy_event_view_on_span_close(self):
+        class Sink:
+            events = []
+
+            def emit(self, event, **fields):
+                Sink.events.append((event, fields))
+
+        Sink.events = []
+        tr = Tracer(sink=Sink())
+        with tr.span("level", level=3, shape=[8, 8]) as sp:
+            sp.set(nnf_energy=0.5)
+        (event, fields) = Sink.events[0]
+        assert event == "level_done"
+        assert fields["level"] == 3 and fields["nnf_energy"] == 0.5
+        assert fields["wall_ms"] >= 0.0
+
+    def test_record_is_timed_and_emits(self):
+        tr = Tracer()
+        sp = tr.record("prologue", 123.456)
+        assert sp.wall_ms == pytest.approx(123.456, abs=0.01)
+        assert tr.find("prologue") == [sp]
+
+    def test_to_dict_round_trips_schema(self):
+        tr = Tracer()
+        with tr.span("run"):
+            tr.annotate("em_iter", em=0)
+        d = tr.to_dict()
+        assert d["schema_version"] == 1
+        (run,) = d["spans"]
+        assert run["name"] == "run" and run["wall_ms"] is not None
+        (em,) = run["children"]
+        assert em["wall_ms"] is None  # annotations are untimed
+
+
+# ---------------------------------------------------------------- report
+def _mini_spans():
+    """A plausible 2-level host span tree (Tracer.to_dict shape)."""
+    tr = Tracer()
+    with tr.span("run", matcher="patchmatch", levels=2, shape=[32, 32]):
+        tr.record("prologue", 12.5)
+        for lvl in (1, 0):
+            with tr.span("level", level=lvl) as sp:
+                sp.set(shape=[16 * (2 - lvl), 16 * (2 - lvl)],
+                       nnf_energy=0.25)
+            tr.annotate("em_iter", parent=sp, em=0)
+    return tr.to_dict()
+
+
+def _write_device_trace(trace_dir):
+    """Synthetic xplane file: 2 ms tagged tlm_L0, 1 ms tlm_L1,
+    0.25 ms tlm_prologue, split across tlm_match/tlm_render."""
+    from xplane_fixtures import event, meta_entry, ops_line, plane
+
+    line = ops_line(
+        event(1, 1_500_000_000), event(2, 500_000_000),
+        event(3, 1_000_000_000), event(4, 250_000_000),
+    )
+    data = plane(
+        b"/device:TPU:0", line,
+        meta_entry(1, b"jit(run_level)/tlm_L0/tlm_em0/tlm_match/fusion.1"),
+        meta_entry(2, b"jit(run_level)/tlm_L0/tlm_em0/tlm_render/copy.2"),
+        meta_entry(3, b"jit(run_level)/tlm_L1/tlm_em0/tlm_match/fusion.3"),
+        meta_entry(4, b"jit(prologue)/tlm_prologue/conv.4"),
+    )
+    os.makedirs(trace_dir, exist_ok=True)
+    with open(os.path.join(trace_dir, "t.xplane.pb"), "wb") as f:
+        f.write(data)
+
+
+class TestBuildReport:
+    def test_host_only_report(self, tmp_path):
+        report = build_report(spans=_mini_spans())
+        assert report["schema_version"] == 1
+        assert [lv["level"] for lv in report["levels"]] == [1, 0]
+        for lv in report["levels"]:
+            assert lv["wall_ms"] > 0.0
+            assert lv["device_busy_ms"] is None  # no trace -> null
+        assert report["prologue"]["wall_ms"] == pytest.approx(12.5, 0.01)
+        assert validate_report(report) == []
+
+    def test_device_join_attributes_per_level(self, tmp_path):
+        d = str(tmp_path / "trace")
+        _write_device_trace(d)
+        report = build_report(trace_dir=d, spans=_mini_spans())
+        by_level = {lv["level"]: lv for lv in report["levels"]}
+        assert by_level[0]["device_busy_ms"] == pytest.approx(2.0)
+        assert by_level[1]["device_busy_ms"] == pytest.approx(1.0)
+        # Per-EM attribution via the nested tlm_L<l>/tlm_em<i> scopes.
+        assert by_level[0]["em_device_busy_ms"] == {"0": 2.0}
+        assert by_level[1]["em_device_busy_ms"] == {"0": 1.0}
+        assert report["prologue"]["device_busy_ms"] == pytest.approx(0.25)
+        assert report["device"]["total_busy_ms"] == pytest.approx(3.25)
+        assert report["phases"]["match"] == pytest.approx(2.5)
+        assert report["phases"]["render"] == pytest.approx(0.5)
+        assert validate_report(report) == []
+        # Table renders every level row without crashing.
+        table = render_table(report)
+        assert "level" in table and "device" in table
+
+    def test_spans_file_in_trace_dir(self, tmp_path):
+        d = str(tmp_path / "trace")
+        os.makedirs(d)
+        with open(os.path.join(d, "host_spans.json"), "w") as f:
+            json.dump(_mini_spans(), f)
+        report = build_report(trace_dir=d)
+        assert report["host_spans"] is True
+        assert len(report["levels"]) == 2
+
+    def test_progress_jsonl_fallback(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        with open(path, "w") as f:
+            for rec in (
+                {"event": "start", "t": 0.0, "shape": [32, 32]},
+                {"event": "level_done", "t": 1.0, "level": 1,
+                 "shape": [16, 16], "wall_ms": 10.0, "nnf_energy": 0.1},
+                {"event": "level_done", "t": 2.0, "level": 0,
+                 "shape": [32, 32], "wall_ms": 20.0, "nnf_energy": 0.2},
+                {"event": "done", "t": 3.0, "wall_s": 3.0},
+            ):
+                f.write(json.dumps(rec) + "\n")
+        spans = spans_from_progress(path)
+        report = build_report(spans=spans)
+        assert [lv["level"] for lv in report["levels"]] == [1, 0]
+        assert report["run"]["wall_ms"] == pytest.approx(3000.0)
+        # No prologue event in the stream -> validator flags it unless
+        # relaxed (the check_report --no-prologue path).
+        assert validate_report(report, require_prologue=False) == []
+        assert any("prologue" in e for e in validate_report(report))
+
+    def test_no_host_source_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            build_report(trace_dir=str(tmp_path))
+
+    def test_corrupt_trace_degrades_to_host_only(self, tmp_path):
+        """A truncated xplane file (killed profiler — the crash
+        telemetry_session still writes host spans for) must not take
+        the report down: device fields go null and the error is
+        stated."""
+        d = str(tmp_path / "trace")
+        os.makedirs(d)
+        with open(os.path.join(d, "t.xplane.pb"), "wb") as f:
+            f.write(b"\x0a\xff")  # LEN field promising 255 absent bytes
+        report = build_report(trace_dir=d, spans=_mini_spans())
+        assert report["device"]["total_busy_ms"] is None
+        assert "truncated" in report["device"]["error"]
+        for lv in report["levels"]:
+            assert lv["wall_ms"] > 0.0
+            assert lv["device_busy_ms"] is None
+        assert validate_report(report) == []
+
+
+# ----------------------------------------------------------- check_report
+class TestCheckReport:
+    def _valid(self):
+        return build_report(spans=_mini_spans())
+
+    def test_valid_report_passes(self):
+        assert validate_report(self._valid()) == []
+
+    def test_missing_levels_fails(self):
+        report = self._valid()
+        report["levels"] = []
+        assert any("levels" in e for e in validate_report(report))
+
+    def test_level_gap_fails(self):
+        report = self._valid()
+        report["levels"] = [lv for lv in report["levels"]
+                            if lv["level"] != 0]
+        assert any("contiguous" in e for e in validate_report(report))
+
+    def test_missing_wall_ms_fails(self):
+        report = self._valid()
+        del report["levels"][0]["wall_ms"]
+        assert any("wall_ms" in e for e in validate_report(report))
+
+    def test_wrong_schema_version_fails(self):
+        report = self._valid()
+        report["schema_version"] = 99
+        assert any("schema_version" in e for e in validate_report(report))
+
+    def test_cli_tool_exit_codes(self, tmp_path):
+        from check_report import main as check_main
+
+        good = str(tmp_path / "good.json")
+        with open(good, "w") as f:
+            json.dump(self._valid(), f)
+        assert check_main([good]) == 0
+
+        bad_report = self._valid()
+        bad_report["levels"] = []
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            json.dump(bad_report, f)
+        assert check_main([bad]) == 1
+        assert check_main([str(tmp_path / "absent.json")]) == 2
+
+
+# ------------------------------------------------------------- CLI report
+class TestReportSubcommand:
+    def test_synth_trace_then_report(self, tmp_path, rng):
+        """Acceptance flow: a CPU `synth --progress ... --trace-dir ...`
+        followed by `report` produces a validating report.json whose
+        level entries all carry wall_ms (device_busy_ms null on the
+        CPU backend — no accelerator planes, stated not imputed)."""
+        from PIL import Image
+
+        from image_analogies_tpu import cli
+
+        d = str(tmp_path / "assets")
+        cli.main(["examples", "--out", d, "--size", "32"])
+        trace = str(tmp_path / "trace")
+        prog = str(tmp_path / "run.jsonl")
+        out = str(tmp_path / "bp.png")
+        cli.main([
+            "synth",
+            "--a", os.path.join(d, "texture_by_numbers_A.png"),
+            "--ap", os.path.join(d, "texture_by_numbers_Ap.png"),
+            "--b", os.path.join(d, "texture_by_numbers_B.png"),
+            "--out", out, "--levels", "2", "--matcher", "brute",
+            "--em-iters", "1", "--device", "cpu",
+            "--progress", prog, "--trace-dir", trace,
+            "--log-level", "warning",
+        ])
+        assert Image.open(out).size == (32, 32)
+        # The telemetry session left the self-contained trace layout.
+        assert os.path.isfile(os.path.join(trace, "host_spans.json"))
+        assert os.path.isfile(os.path.join(trace, "metrics.json"))
+        assert os.path.isfile(os.path.join(trace, "metrics.prom"))
+
+        cli.main(["report", "--trace-dir", trace])
+        with open(os.path.join(trace, "report.json")) as f:
+            report = json.load(f)
+        assert validate_report(report) == []
+        assert [lv["level"] for lv in report["levels"]] == [1, 0]
+        for lv in report["levels"]:
+            assert lv["wall_ms"] > 0.0
+        # Legacy JSONL stream written alongside, same consumers intact.
+        events = [json.loads(line) for line in open(prog)]
+        assert [e["event"] for e in events].count("level_done") == 2
+
+    def test_report_without_inputs_exits_nonzero(self, tmp_path):
+        from image_analogies_tpu import cli
+
+        with pytest.raises(SystemExit):
+            cli.main(["report", "--trace-dir", str(tmp_path)])

@@ -1,0 +1,186 @@
+"""Direct unit tests for the XPlane wire-format parser (SURVEY.md §5;
+round-6 satellite): the varint/field decoding layer that every
+trace-derived timing figure rests on, exercised against hand-encoded
+fixtures — including the failure modes (truncated buffers, unsupported
+wire types) a half-written trace file produces."""
+
+import pytest
+
+from image_analogies_tpu.utils.xplane import (
+    _fields,
+    _read_varint,
+    device_busy_ms,
+    device_op_totals,
+    device_scope_totals,
+    parse_xspace,
+)
+from xplane_fixtures import ld as _ld, tag as _tag, varint as _varint
+
+
+class TestVarint:
+    def test_single_byte_values(self):
+        assert _read_varint(b"\x00", 0) == (0, 1)
+        assert _read_varint(b"\x7f", 0) == (127, 1)
+
+    def test_multi_byte_value(self):
+        # 300 = 0b100101100 -> 0xAC 0x02
+        assert _read_varint(b"\xac\x02", 0) == (300, 2)
+
+    def test_round_trip_various_widths(self):
+        for v in (0, 1, 127, 128, 16384, 2**32, 2**63 - 1):
+            buf = _varint(v)
+            assert _read_varint(buf, 0) == (v, len(buf))
+
+    def test_mid_buffer_position(self):
+        buf = b"\xff" + _varint(300)
+        assert _read_varint(buf, 1) == (300, 3)
+
+    def test_truncated_varint_raises(self):
+        # Continuation bit set on the final byte: the value never ends.
+        with pytest.raises(ValueError, match="truncated varint"):
+            _read_varint(b"\xac", 0)
+
+    def test_empty_buffer_raises(self):
+        with pytest.raises(ValueError, match="truncated varint"):
+            _read_varint(b"", 0)
+
+
+class TestFields:
+    def test_mixed_wire_types(self):
+        buf = (
+            _tag(1, 0) + _varint(42)           # varint field
+            + _ld(2, b"hi")                     # length-delimited
+            + _tag(3, 1) + b"\x01" * 8          # fixed64
+            + _tag(4, 5) + b"\x02" * 4          # fixed32
+        )
+        out = list(_fields(buf))
+        assert out[0] == (1, 0, 42)
+        assert out[1] == (2, 2, b"hi")
+        assert out[2] == (3, 1, b"\x01" * 8)
+        assert out[3] == (4, 5, b"\x02" * 4)
+
+    def test_unknown_fields_are_skipped_not_fatal(self):
+        # High field numbers with known wire types just flow through —
+        # schema additions must be harmless (module docstring).
+        buf = _tag(999, 0) + _varint(7) + _ld(1000, b"x")
+        assert [(f, w) for f, w, _ in _fields(buf)] == [
+            (999, 0), (1000, 2),
+        ]
+
+    def test_truncated_len_payload_raises(self):
+        # Declares 10 payload bytes, provides 2.
+        buf = _tag(1, 2) + _varint(10) + b"ab"
+        with pytest.raises(ValueError, match="truncated length-delimited"):
+            list(_fields(buf))
+
+    def test_truncated_fixed_width_raises(self):
+        with pytest.raises(ValueError, match="truncated fixed64"):
+            list(_fields(_tag(1, 1) + b"\x00" * 3))
+        with pytest.raises(ValueError, match="truncated fixed32"):
+            list(_fields(_tag(1, 5) + b"\x00"))
+
+    def test_unsupported_wire_type_raises(self):
+        # Wire type 3 (deprecated group) is not decodable here.
+        with pytest.raises(ValueError, match="unsupported wire type 3"):
+            list(_fields(_tag(1, 3)))
+
+
+from xplane_fixtures import (  # noqa: E402 (after the parser imports)
+    event as _event,
+    meta_entry as _meta_entry,
+    ops_line as _ops_line,
+    plane as _plane,
+)
+
+
+class TestMultiPlane:
+    def test_two_device_planes_in_one_file_sum_independently(self, tmp_path):
+        """An XSpace with several planes (multi-core trace) must keep
+        per-plane totals separate while device_busy_ms sums them."""
+        p0 = _plane(
+            b"/device:TPU:0",
+            _ops_line(_event(1, 2_000_000_000)),
+            _meta_entry(1, b"fusion.1"),
+        )
+        p1 = _plane(
+            b"/device:TPU:1",
+            _ops_line(_event(1, 1_000_000_000), _event(2, 500_000_000)),
+            _meta_entry(1, b"fusion.1"),
+            _meta_entry(2, b"copy.2"),
+        )
+        host = _plane(b"/host:CPU", _ops_line(_event(1, 9_000_000_000)))
+        path = tmp_path / "multi.xplane.pb"
+        path.write_bytes(p0 + p1 + host)
+
+        planes = parse_xspace(str(path))
+        assert [p[0] for p in planes] == [
+            "/device:TPU:0", "/device:TPU:1", "/host:CPU",
+        ]
+        totals = device_op_totals(str(tmp_path))
+        assert set(totals) == {"/device:TPU:0", "/device:TPU:1"}
+        assert abs(totals["/device:TPU:0"]["fusion.1"] - 2.0) < 1e-9
+        assert abs(totals["/device:TPU:1"]["fusion.1"] - 1.0) < 1e-9
+        assert abs(totals["/device:TPU:1"]["copy.2"] - 0.5) < 1e-9
+        assert abs(device_busy_ms(str(tmp_path)) - 3.5) < 1e-9
+
+    def test_planes_split_across_files_aggregate(self, tmp_path):
+        """device_op_totals spans every *.xplane.pb under the dir (a
+        multi-host trace writes one file per host)."""
+        (tmp_path / "a.xplane.pb").write_bytes(_plane(
+            b"/device:TPU:0",
+            _ops_line(_event(1, 1_000_000_000)),
+            _meta_entry(1, b"fusion.1"),
+        ))
+        (tmp_path / "b.xplane.pb").write_bytes(_plane(
+            b"/device:TPU:0",
+            _ops_line(_event(1, 3_000_000_000)),
+            _meta_entry(1, b"fusion.1"),
+        ))
+        totals = device_op_totals(str(tmp_path))
+        assert abs(totals["/device:TPU:0"]["fusion.1"] - 4.0) < 1e-9
+
+    def test_truncated_trace_file_raises(self, tmp_path):
+        """A half-written xplane.pb (killed profiler) fails loudly
+        instead of decoding to silently-wrong totals."""
+        good = _plane(
+            b"/device:TPU:0",
+            _ops_line(_event(1, 1_000_000_000)),
+            _meta_entry(1, b"fusion.1"),
+        )
+        (tmp_path / "t.xplane.pb").write_bytes(good[: len(good) - 3])
+        with pytest.raises(ValueError, match="truncated"):
+            device_op_totals(str(tmp_path))
+
+
+class TestScopeTotals:
+    def test_scope_tags_group_op_time(self, tmp_path):
+        """device_scope_totals recovers per-level device time from the
+        tlm_L<level> named-scope tags threaded into op names — the join
+        the run report's device_busy_ms columns rest on."""
+        plane = _plane(
+            b"/device:TPU:0",
+            _ops_line(
+                _event(1, 2_000_000_000),
+                _event(2, 1_000_000_000),
+                _event(3, 250_000_000),
+            ),
+            _meta_entry(1, b"jit(run_level)/tlm_L0/tlm_em0/fusion.1"),
+            _meta_entry(2, b"jit(run_level)/tlm_L1/tlm_em0/fusion.1"),
+            _meta_entry(3, b"jit(run_level)/tlm_L0/tlm_em1/copy.2"),
+        )
+        (tmp_path / "t.xplane.pb").write_bytes(plane)
+        by_level = device_scope_totals(str(tmp_path), r"tlm_L(\d+)")
+        assert abs(by_level["0"] - 2.25) < 1e-9
+        assert abs(by_level["1"] - 1.0) < 1e-9
+        by_em = device_scope_totals(str(tmp_path), r"tlm_(em\d+)")
+        assert abs(by_em["em0"] - 3.0) < 1e-9
+        assert abs(by_em["em1"] - 0.25) < 1e-9
+
+    def test_unmatched_ops_are_dropped(self, tmp_path):
+        plane = _plane(
+            b"/device:TPU:0",
+            _ops_line(_event(1, 1_000_000_000)),
+            _meta_entry(1, b"untagged_fusion.9"),
+        )
+        (tmp_path / "t.xplane.pb").write_bytes(plane)
+        assert device_scope_totals(str(tmp_path), r"tlm_L(\d+)") == {}

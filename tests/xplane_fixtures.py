@@ -1,0 +1,45 @@
+"""Shared hand-encoders for XSpace wire-format test fixtures
+(tests/test_xplane.py, tests/test_telemetry.py, tests/test_profiling.py)
+— one copy of the protobuf byte builders so a schema tweak cannot leave
+one file encoding stale fixtures."""
+
+
+def varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b7 = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def tag(field: int, wire: int) -> bytes:
+    return varint((field << 3) | wire)
+
+
+def ld(field: int, payload: bytes) -> bytes:
+    """Length-delimited field."""
+    return tag(field, 2) + varint(len(payload)) + payload
+
+
+def event(mid: int, dur_ps: int) -> bytes:
+    """XEvent with metadata_id `mid` and duration `dur_ps`."""
+    return ld(4, tag(1, 0) + varint(mid) + tag(3, 0) + varint(dur_ps))
+
+
+def meta_entry(mid: int, name: bytes) -> bytes:
+    """event_metadata map entry: id -> XEventMetadata{id, name}."""
+    inner = tag(1, 0) + varint(mid) + ld(2, name)
+    return ld(4, tag(1, 0) + varint(mid) + ld(2, inner))
+
+
+def ops_line(*events: bytes) -> bytes:
+    """XLine named (display_name) "XLA Ops" carrying `events`."""
+    return ld(3, ld(11, b"XLA Ops") + b"".join(events))
+
+
+def plane(name: bytes, *parts: bytes) -> bytes:
+    """XPlane with `name` and already-encoded lines/metadata parts."""
+    return ld(1, ld(2, name) + b"".join(parts))

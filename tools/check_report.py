@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Validate a telemetry `report.json` (telemetry/report.py schema).
+
+Fast, dependency-free smoke check for traced runs: exits nonzero when
+the report is structurally broken or missing phases — an unknown
+schema version, no `levels`, a level without `wall_ms`/`shape`/
+`nnf_energy`, a gap in the level sequence, or a missing `prologue`
+phase.  `device_busy_ms` may be null (a CPU/tunnelled backend forwards
+no accelerator planes) but the KEY must exist: the report's contract
+is to state what it measured, never to omit the question.
+
+Usage:
+    python tools/check_report.py path/to/report.json
+    python tools/check_report.py --no-prologue report.json  # resumed
+        runs skip the prologue span; relax that requirement only
+
+Runs under pytest too (tests/test_telemetry.py wraps `validate_report`)
+so tier-1 exercises the same rules the CLI tool enforces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+SCHEMA_VERSION = 1
+
+_LEVEL_REQUIRED = ("level", "shape", "wall_ms", "nnf_energy",
+                   "device_busy_ms")
+
+
+def validate_report(report: dict, require_prologue: bool = True
+                    ) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(report, dict):
+        return ["report is not a JSON object"]
+    if report.get("schema_version") != SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {report.get('schema_version')!r} != "
+            f"{SCHEMA_VERSION}"
+        )
+
+    levels = report.get("levels")
+    if not isinstance(levels, list) or not levels:
+        errs.append("levels: missing or empty")
+        levels = []
+    seen = []
+    for i, lv in enumerate(levels):
+        if not isinstance(lv, dict):
+            errs.append(f"levels[{i}]: not an object")
+            continue
+        for key in _LEVEL_REQUIRED:
+            if key not in lv:
+                errs.append(f"levels[{i}]: missing key {key!r}")
+        if not isinstance(lv.get("level"), int):
+            errs.append(f"levels[{i}]: level is not an int")
+            continue
+        seen.append(lv["level"])
+        wall = lv.get("wall_ms")
+        if not isinstance(wall, (int, float)) or wall <= 0:
+            errs.append(
+                f"levels[{i}] (level {lv['level']}): wall_ms {wall!r} "
+                "is not a positive number"
+            )
+        shape = lv.get("shape")
+        if shape is not None and (
+            not isinstance(shape, list) or len(shape) != 2
+        ):
+            errs.append(
+                f"levels[{i}] (level {lv['level']}): shape {shape!r} "
+                "is not [h, w]"
+            )
+        dev = lv.get("device_busy_ms")
+        if dev is not None and not isinstance(dev, (int, float)):
+            errs.append(
+                f"levels[{i}] (level {lv['level']}): device_busy_ms "
+                f"{dev!r} is neither null nor a number"
+            )
+    if seen:
+        # The pyramid runs coarse -> fine and ends at level 0; any gap
+        # means a phase's span was dropped on the floor.
+        expected = list(range(max(seen), -1, -1))
+        if seen != expected:
+            errs.append(
+                f"levels: indices {seen} are not the contiguous "
+                f"coarse-to-fine sequence {expected}"
+            )
+
+    prologue = report.get("prologue")
+    if require_prologue:
+        if not isinstance(prologue, dict):
+            errs.append("prologue: missing phase")
+        else:
+            if not isinstance(prologue.get("wall_ms"), (int, float)):
+                errs.append("prologue: wall_ms is not a number")
+            if "device_busy_ms" not in prologue:
+                errs.append("prologue: missing key 'device_busy_ms'")
+
+    run = report.get("run")
+    if run is not None and not isinstance(run, dict):
+        errs.append("run: not an object")
+
+    device = report.get("device")
+    if not isinstance(device, dict):
+        errs.append("device: missing section")
+    elif "total_busy_ms" not in device:
+        errs.append("device: missing key 'total_busy_ms'")
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("report", help="path to report.json")
+    ap.add_argument(
+        "--no-prologue", action="store_true",
+        help="don't require the prologue phase (resumed runs skip it)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        with open(args.report) as f:
+            report = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"check_report: cannot read {args.report}: {e}",
+              file=sys.stderr)
+        return 2
+    errs = validate_report(report, require_prologue=not args.no_prologue)
+    if errs:
+        for e in errs:
+            print(f"check_report: {e}", file=sys.stderr)
+        print(
+            f"check_report: FAIL — {len(errs)} violation(s) in "
+            f"{args.report}", file=sys.stderr,
+        )
+        return 1
+    n = len(report.get("levels", []))
+    print(f"check_report: OK — {n} level(s), schema v{SCHEMA_VERSION}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
