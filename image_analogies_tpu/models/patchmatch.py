@@ -56,9 +56,10 @@ class RawPlanes(NamedTuple):
     flt_b: jnp.ndarray
     src_b_coarse: Optional[jnp.ndarray]
     flt_b_coarse: Optional[jnp.ndarray]
-    # Tuple of A row-band arrays, each (C, rows+2P+pad, Wq, 128) f32
-    # (kernels.patchmatch_tile.prepare_a_planes); one entry when A fits
-    # VMEM, several to stream a larger A side band by band.
+    # Tuple of A row-band arrays from prepare_a_planes — packed layout
+    # (rows, Wq-1, 2C, 128) f32 by default, the legacy (rows, Wq, C,
+    # 128) behind packed=False; one entry on single-device plans,
+    # several when A ownership is split into bands (sharded-A).
     a_planes: tuple
 
 # Propagation neighborhood: left, right, up, down.

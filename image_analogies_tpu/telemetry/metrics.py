@@ -266,6 +266,30 @@ def reset_registry() -> None:
     _global_registry.reset()
 
 
+def count_candidate_dma_bytes(useful: float, padded: float) -> None:
+    """Record one traced tile_sweep's candidate-window DMA bytes, split
+    into the window content the kernel consumes (`kind="useful"`) and
+    the sublane pad the fetch moves alongside it (`kind="padded"`) —
+    the observable form of the layout-efficiency claim (round 6: the
+    packed A-plane layout's padded share is 0 at the headline's 4
+    channels vs ~50 % for the round-5 layout).  Byte math lives in
+    kernels.patchmatch_tile.candidate_dma_bytes_per_fetch, the same
+    model bench.py's roofline accounting uses.
+
+    TRACE-TIME count (module docstring's jit caveat), like the launch
+    counter below: one bump per tile_sweep call site traced into a
+    compilation, all K_TOTAL fetches counted (the runtime pl.when(ok)
+    skip makes the padded+useful total an upper bound for production
+    sweeps)."""
+    c = get_registry().counter(
+        "ia_candidate_dma_bytes_total",
+        "candidate-window DMA bytes per traced tile_sweep, split "
+        "useful vs padded (trace-time static count)",
+    )
+    c.inc(useful, labels={"kind": "useful"})
+    c.inc(padded, labels={"kind": "padded"})
+
+
 def count_kernel_launch(kernel: str) -> None:
     """Bump the shared Pallas-kernel launch counter — called at the
     top of each kernel wrapper (kernels/patchmatch_tile.tile_sweep,

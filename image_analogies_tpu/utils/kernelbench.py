@@ -35,6 +35,7 @@ def sweep_setup(cfg, size: int):
         band_bounds,
         plan_channels,
         prepare_a_planes,
+        resolve_packed,
         sample_candidates,
         tile_geometry,
         tile_sweep,
@@ -54,7 +55,9 @@ def sweep_setup(cfg, size: int):
         mk(size // 2, size // 2) if use_coarse else None,
         specs, n_bands=n_bands,
     )
-    n_chan = int(a_planes[0].shape[2])
+    # True channel count from the plan (the packed A layout's sublane
+    # axis is 2C, so a_planes.shape[2] is layout-dependent).
+    n_chan = len(specs)
     b_blocked = jnp.stack(
         [to_blocked(mk(size, size), geom) for _ in range(n_chan)]
     )
@@ -84,6 +87,10 @@ def sweep_setup(cfg, size: int):
         "n_bands": n_bands,
         "a_planes": a_planes,
         "n_chan": n_chan,
+        # The layout this setup prepared and sweeps under — bench.py's
+        # byte model reads it so the published traffic matches what the
+        # timed kernel actually moved.
+        "packed": resolve_packed(),
     }
     return one_iter, (oy, ox, d), meta
 

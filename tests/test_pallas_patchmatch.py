@@ -182,6 +182,135 @@ class TestKernelMetric:
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+class TestPackedLayout:
+    """Round-7 packed A-plane layout (channel x adjacent-lane-block
+    interleave on the sublane axis): the zero-pad candidate DMA must be
+    a pure RE-PACKING — same window content, bit-identical sweep — with
+    the round-4/5 layout kept alive behind packed=False as the measured
+    fallback.  Interpret mode OOB-checks every slice, so these tests
+    also cover the new slot shapes/DMA indexing at clamped extremes."""
+
+    def test_packed_entries_mirror_unpacked_blocks(self, rng):
+        """Layout relation: packed[:, q, 2c+b, :] == unpacked[:, q+b, c, :]
+        for every entry/channel/block — the definition the kernel's
+        unpack assumes."""
+        cfg = SynthConfig()
+        specs = _specs(cfg, has_coarse=True)
+        mk = lambda *s: jnp.asarray(  # noqa: E731
+            rng.standard_normal(s).astype(np.float32)
+        )
+        args = (mk(192, 160), mk(192, 160), mk(96, 80), mk(96, 80))
+        (unp,) = prepare_a_planes(*args, specs, packed=False)
+        (pk,) = prepare_a_planes(*args, specs, packed=True)
+        n_chan = len(specs)
+        assert pk.shape == (
+            unp.shape[0], unp.shape[1] - 1, 2 * n_chan, LANE
+        )
+        unp = np.asarray(unp)
+        pk = np.asarray(pk)
+        for c in range(n_chan):
+            for b in range(2):
+                np.testing.assert_array_equal(
+                    pk[:, :, 2 * c + b, :],
+                    unp[:, b : unp.shape[1] - 1 + b, c, :],
+                )
+
+    @pytest.mark.parametrize("n_bands", [1, 2])
+    def test_sweep_bit_identical_across_layouts(self, rng, n_bands):
+        """One full sweep over random candidate tables (including
+        offsets far outside A, so the sy/sx clamps and the packed
+        layout's right-edge entry are exercised under interpret-mode
+        OOB checking) must be BIT-identical between layouts —
+        n_bands=2 re-pins the band-ownership contract (the sharded-A
+        runner's kernel substrate, tests/test_sharded_a.py) against
+        the packed layout."""
+        from image_analogies_tpu.kernels.patchmatch_tile import band_bounds
+
+        cfg = SynthConfig()
+        specs = _specs(cfg)
+        h = w = ha = wa = 128
+        geom = tile_geometry(h, w, specs)
+        mk = lambda *s: jnp.asarray(  # noqa: E731
+            rng.random(s, np.float32)
+        )
+        src_a, flt_a = mk(ha, wa), mk(ha, wa)
+        src_b, flt_b = mk(h, w), mk(h, w)
+        b_blocked = jnp.stack(
+            [to_blocked(c, geom) for c in (src_b, flt_b)]
+        )
+        cand_y, cand_x, cand_valid = sample_candidates(
+            jnp.asarray(rng.integers(-2 * ha, 2 * ha, (h, w), np.int32)),
+            jnp.asarray(rng.integers(-2 * wa, 2 * wa, (h, w), np.int32)),
+            jax.random.PRNGKey(7), geom, ha, wa,
+        )
+        thp = geom.thp
+        z = jnp.zeros((geom.n_ty * thp, geom.n_tx * LANE), jnp.int32)
+        d0 = jnp.full(
+            (geom.n_ty * thp, geom.n_tx * LANE), np.inf, jnp.float32
+        )
+        bounds = band_bounds(ha, n_bands)
+
+        def run(packed):
+            bands = prepare_a_planes(
+                src_a, flt_a, None, None, specs, n_bands=n_bands,
+                packed=packed,
+            )
+            oy, ox, d = z, z, d0
+            for band_planes, band in zip(bands, bounds):
+                oy, ox, d = tile_sweep(
+                    band_planes, b_blocked, cand_y, cand_x, oy, ox, d,
+                    band, cand_valid,
+                    specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=1.0,
+                    interpret=True, packed=packed,
+                )
+            return np.asarray(oy), np.asarray(ox), np.asarray(d)
+
+        for got, want in zip(run(True), run(False)):
+            np.testing.assert_array_equal(got, want)
+
+    def test_full_matcher_path_parity(self, rng, monkeypatch):
+        """Whole kernel-path matcher (sweeps + exact-metric merge +
+        polish) bit-identical between layouts — the packed layout is
+        invisible to the XLA-twin output contract the existing oracle
+        tests pin (TestKernelMatcherPath/TestEndToEnd run the packed
+        default against the exact oracle)."""
+        from image_analogies_tpu.kernels import patchmatch_tile as pt
+
+        cfg = SynthConfig(
+            matcher="patchmatch", pallas_mode="interpret", levels=1,
+            pm_iters=2,
+        )
+        h = w = ha = wa = 128
+        src_b = jnp.asarray(rng.random((h, w)).astype(np.float32))
+        flt_b = jnp.asarray(rng.random((h, w)).astype(np.float32))
+        src_a = jnp.asarray(rng.random((ha, wa)).astype(np.float32))
+        flt_a = jnp.asarray(rng.random((ha, wa)).astype(np.float32))
+        f_b = assemble_features(src_b, flt_b, cfg, None, None)
+        f_a = assemble_features(src_a, flt_a, cfg, None, None)
+        specs = _specs(cfg)
+        m = get_matcher("patchmatch")
+
+        def run(packed):
+            # The module default drives BOTH prepare and sweep inside
+            # the matcher path, the contract callers rely on.
+            monkeypatch.setattr(pt, "_PACKED_DEFAULT", packed)
+            a_planes = prepare_a_planes(src_a, flt_a, None, None, specs)
+            assert a_planes[0].shape[2] == (
+                2 * len(specs) if packed else len(specs)
+            )
+            raw = RawPlanes(src_b, flt_b, None, None, a_planes)
+            nnf, dist = m.match(
+                f_b, f_a, jnp.zeros((h, w, 2), jnp.int32),
+                key=jax.random.PRNGKey(0), level=0, cfg=cfg, raw=raw,
+            )
+            return np.asarray(nnf), np.asarray(dist)
+
+        nnf_p, d_p = run(True)
+        nnf_u, d_u = run(False)
+        np.testing.assert_array_equal(nnf_p, nnf_u)
+        np.testing.assert_array_equal(d_p, d_u)
+
+
 class TestCandidateSampling:
     def test_shapes_and_split(self, rng):
         specs = _specs()

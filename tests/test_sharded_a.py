@@ -149,7 +149,7 @@ def test_sharded_a_band_search_matches_sequential(rng):
     # Sharded: each device owns one band; shard_map runs the kernel
     # per device; outputs gather on the band axis and argmin-merge.
     mesh = make_mesh(n_dev, axis_names=("bands",))
-    a_stacked = jnp.stack(bands)           # (n_dev, rows, Wq, C, LANE)
+    a_stacked = jnp.stack(bands)       # (n_dev, rows, Wq-1, 2C, LANE)
     b_stacked = jnp.stack(bounds)          # (n_dev, 2)
 
     def per_device(band_planes, band):

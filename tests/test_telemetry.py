@@ -90,6 +90,79 @@ class TestMetricsRegistry:
         assert d["c"] == {"kind": "counter", "help": "ch",
                           "values": {"total": 1.0}}
 
+    def test_candidate_dma_byte_counters_from_tile_sweep(self, rng):
+        """Round-6 observability satellite: a traced tile_sweep must
+        record its candidate-DMA bytes split useful vs padded, with
+        values matching `candidate_dma_bytes_per_fetch` exactly (the
+        same model bench.py publishes) — the layout-efficiency claim
+        as counters, visible in report.json's metrics section.  A
+        unique A-height keeps the jit key fresh so the trace-time bump
+        actually fires in this process."""
+        import jax
+        import jax.numpy as jnp
+
+        from image_analogies_tpu.config import SynthConfig
+        from image_analogies_tpu.kernels.patchmatch_tile import (
+            K_TOTAL,
+            LANE,
+            candidate_dma_bytes_per_fetch,
+            channel_specs,
+            prepare_a_planes,
+            sample_candidates,
+            tile_geometry,
+            tile_sweep,
+            to_blocked,
+        )
+        from image_analogies_tpu.telemetry.metrics import set_registry
+
+        cfg = SynthConfig()
+        specs = channel_specs(1, 1, cfg, False)
+        h = w = wa = 128
+        ha = 136  # unique geometry => fresh trace => counters fire
+        geom = tile_geometry(h, w, specs)
+        mk = lambda *s: jnp.asarray(rng.random(s, np.float32))  # noqa: E731
+        (a_planes,) = prepare_a_planes(
+            mk(ha, wa), mk(ha, wa), None, None, specs, packed=True
+        )
+        b_blocked = jnp.stack(
+            [to_blocked(mk(h, w), geom) for _ in range(2)]
+        )
+        cand = sample_candidates(
+            jnp.zeros((h, w), jnp.int32), jnp.zeros((h, w), jnp.int32),
+            jax.random.PRNGKey(0), geom, ha, wa,
+        )
+        thp = geom.thp
+        z = jnp.zeros((geom.n_ty * thp, geom.n_tx * LANE), jnp.int32)
+        d0 = jnp.full(
+            (geom.n_ty * thp, geom.n_tx * LANE), np.inf, jnp.float32
+        )
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        try:
+            tile_sweep(
+                a_planes, b_blocked, cand[0], cand[1], z, z, d0,
+                cand_valid=cand[2],
+                specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=1.0,
+                interpret=True, packed=True,
+            )
+        finally:
+            set_registry(prev)
+        c = reg.counter("ia_candidate_dma_bytes_total")
+        moved, useful = candidate_dma_bytes_per_fetch(
+            len(specs), thp, True
+        )
+        n_fetch = geom.n_ty * geom.n_tx * K_TOTAL
+        assert c.value(labels={"kind": "useful"}) == n_fetch * useful
+        assert c.value(labels={"kind": "padded"}) == n_fetch * (
+            moved - useful
+        )
+        # Fine-only = 2 channels: the packed fetch still pads 4 -> 8
+        # sublanes (efficiency 0.5, vs 0.25 unpacked); at the
+        # headline's 4 channels the padded series is exactly 0 —
+        # asserted on the model directly.
+        m4, u4 = candidate_dma_bytes_per_fetch(4, thp, True)
+        assert m4 == u4
+
 
 # ----------------------------------------------------------------- spans
 class TestTracer:

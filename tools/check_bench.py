@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Validate a bench.py JSON record (the north-star benchmark line).
+
+Fast, dependency-free smoke check mirroring tools/check_report.py, so a
+structurally broken or silently-degraded bench artifact fails loudly
+instead of shipping: missing headline fields, a physically impossible
+roofline fraction (> 1 — the r4 incident this family of guards exists
+for), a kernel section without the round-7 byte-efficiency fields
+(useful vs padded candidate-DMA bytes), a missing in-file ranking of
+the three `kernel_sweep_ms*` instruments (VERDICT r5 weak 6), or a
+config-1 row without its cross-backend correctness cell (VERDICT r5
+item 7).
+
+Accepts either the raw record bench.py prints or the driver's capture
+wrapper (`{"n": ..., "parsed": {...}}`).  Kernel-utilization fields are
+required only on TPU records (`device == "tpu"`): the CPU fallback
+publishes no kernel section by design and is validated on the headline
+fields alone.
+
+Usage:
+    python bench.py | tail -1 > bench.json
+    python tools/check_bench.py bench.json
+
+Runs under pytest too (tests/test_check_bench.py wraps
+`validate_bench` against the real bench field builders) so tier-1
+enforces the same rules the CLI tool does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+_ROOFLINE_FIELDS = (
+    "kernel_hbm_roofline_frac",
+    "kernel_vpu_roofline_frac",
+    "kernel_mxu_roofline_frac",
+)
+_KERNEL_REQUIRED = _ROOFLINE_FIELDS + (
+    "kernel_bytes_per_sweep",
+    "kernel_bytes_per_sweep_useful",
+    "kernel_candidate_dma_efficiency",
+    "kernel_a_layout",
+    "kernel_sweep_ms",
+    "kernel_sweep_ms_loop",
+    "kernel_sweep_ms_trace",
+    "kernel_sweep_ms_ranking",
+)
+_SWEEP_MS_FIELDS = ("kernel_sweep_ms_trace", "kernel_sweep_ms_loop")
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_bench(record: dict) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if isinstance(record.get("parsed"), dict):
+        record = record["parsed"]
+
+    # Headline fields (every device).
+    if not isinstance(record.get("metric"), str):
+        errs.append("metric: missing or not a string")
+    if not (_num(record.get("value")) and record.get("value", 0) > 0):
+        errs.append(f"value {record.get('value')!r} is not a positive number")
+    if record.get("unit") != "s":
+        errs.append(f"unit {record.get('unit')!r} != 's'")
+    if record.get("device") not in ("tpu", "cpu-fallback"):
+        errs.append(f"device {record.get('device')!r} unknown")
+    if not _num(record.get("psnr_vs_cpu_ref_db")):
+        errs.append("psnr_vs_cpu_ref_db: missing or not a number")
+
+    configs = record.get("acceptance_configs")
+    if not isinstance(configs, list) or not configs:
+        errs.append("acceptance_configs: missing or empty")
+        configs = []
+    for i, row in enumerate(configs):
+        if not isinstance(row, dict) or not isinstance(
+            row.get("config"), str
+        ):
+            errs.append(f"acceptance_configs[{i}]: not a config row")
+            continue
+        if not (_num(row.get("wall_s")) and row["wall_s"] > 0):
+            errs.append(
+                f"acceptance_configs[{i}] ({row['config']}): wall_s "
+                f"{row.get('wall_s')!r} is not a positive number"
+            )
+        if row["config"].startswith("1:"):
+            # Config 1's correctness cell: brute is its own oracle, so
+            # the cell must be the cross-backend bit-identity boolean,
+            # not a vacuous PSNR-vs-itself.
+            cb = row.get("cross_backend")
+            if not isinstance(cb, dict) or not isinstance(
+                cb.get("bit_identical"), bool
+            ):
+                errs.append(
+                    f"acceptance_configs[{i}] ({row['config']}): missing "
+                    "cross_backend.bit_identical boolean"
+                )
+
+    if record.get("device") != "tpu":
+        return errs
+
+    # Kernel-utilization section (TPU records).
+    for key in _KERNEL_REQUIRED:
+        if key not in record:
+            errs.append(f"missing kernel field {key!r}")
+    for key in _ROOFLINE_FIELDS:
+        frac = record.get(key)
+        if frac is None:
+            continue  # already reported missing
+        if not _num(frac) or frac < 0 or frac > 1.0:
+            errs.append(
+                f"{key}={frac!r} outside [0, 1] — impossible "
+                "(under-measured time or over-counted model)"
+            )
+    total = record.get("kernel_bytes_per_sweep")
+    useful = record.get("kernel_bytes_per_sweep_useful")
+    if _num(total) and _num(useful):
+        if not 0 < useful <= total:
+            errs.append(
+                f"kernel_bytes_per_sweep_useful {useful} not in "
+                f"(0, {total}]"
+            )
+        eff = record.get("kernel_candidate_dma_efficiency")
+        if not (_num(eff) and 0.0 < eff <= 1.0):
+            errs.append(
+                f"kernel_candidate_dma_efficiency {eff!r} not in (0, 1]"
+            )
+    ranking = record.get("kernel_sweep_ms_ranking")
+    if ranking is not None:
+        if not isinstance(ranking, dict):
+            errs.append("kernel_sweep_ms_ranking: not an object")
+        else:
+            auth = ranking.get("authoritative")
+            if auth not in _SWEEP_MS_FIELDS:
+                errs.append(
+                    f"kernel_sweep_ms_ranking.authoritative {auth!r} "
+                    f"names none of {_SWEEP_MS_FIELDS}"
+                )
+            elif _num(record.get(auth)) and _num(
+                record.get("kernel_sweep_ms")
+            ):
+                # The published figure must BE the authoritative one —
+                # the ranking is a statement about the record, and a
+                # drift here means the record contradicts itself.
+                if record["kernel_sweep_ms"] != record[auth]:
+                    errs.append(
+                        f"kernel_sweep_ms {record['kernel_sweep_ms']} != "
+                        f"authoritative {auth} {record[auth]}"
+                    )
+            if not isinstance(ranking.get("diagnostic_only"), list):
+                errs.append(
+                    "kernel_sweep_ms_ranking.diagnostic_only: missing list"
+                )
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "record",
+        help="path to a bench JSON record (raw line or driver capture)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        with open(args.record) as f:
+            record = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"check_bench: cannot read {args.record}: {e}",
+              file=sys.stderr)
+        return 2
+    errs = validate_bench(record)
+    if errs:
+        for e in errs:
+            print(f"check_bench: {e}", file=sys.stderr)
+        print(
+            f"check_bench: FAIL — {len(errs)} violation(s) in "
+            f"{args.record}", file=sys.stderr,
+        )
+        return 1
+    device = record.get("parsed", record).get("device")
+    print(f"check_bench: OK — device={device}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
