@@ -234,7 +234,9 @@ def _kernel_utilization(cfg, size: int, iters: int = 16):
             ms = traced[0]
     except Exception:  # noqa: BLE001 - trace support is best-effort
         pass
-    return _kernel_util_fields(ms, ms_loop, ms_trace, meta)
+    fields = _kernel_util_fields(ms, ms_loop, ms_trace, meta)
+    fields.update(_polish_fields(cfg, size))
+    return fields
 
 
 def _kernel_util_fields(ms: float, ms_loop, ms_trace, meta):
@@ -336,6 +338,45 @@ def _kernel_util_fields(ms: float, ms_loop, ms_trace, meta):
         },
         "kernel_n_bands": n_bands,
         "kernel_spec_groups": len(spec_groups(tuple(specs))),
+    }
+
+
+def _polish_fields(cfg, size: int):
+    """Published polish-phase fields (round 8): the byte model of the
+    final-EM per-pixel polish at the headline level-0 geometry, from
+    the SAME `polish_dma_bytes_per_fetch` / `polish_eval_rows` model
+    the `ia_polish_dma_bytes_total` telemetry counters use
+    (kernels/polish_stream.py) — so the published polish-traffic claim
+    and the observable counters cannot drift (the round-7 discipline,
+    extended to the polish phase).  `kernel_bytes_per_polish` counts
+    MOVED bytes (the 128-lane-padded row each fetch transfers —
+    identical for XLA's gather and the streamed DMA; the stream arm
+    changes the rate, not the bytes); the efficiency field is the
+    unpadded-feature-width fraction.  Schema enforced by
+    tools/check_bench.py; the builder is exercised on CPU by
+    tests/test_check_bench.py."""
+    from image_analogies_tpu.kernels.polish_stream import (
+        polish_dma_bytes_per_fetch,
+        polish_eval_rows,
+    )
+    from image_analogies_tpu.models.patchmatch import (
+        _POLISH_MODE,
+        _polish_schedule_for,
+    )
+
+    # Headline feature width: luminance src+flt fine windows plus the
+    # coarse context block (level 0 always has a coarser level).
+    d_feat = 2 * cfg.patch_size**2 + 2 * cfg.coarse_patch_size**2
+    iters, n_random = _polish_schedule_for(cfg, size, size)
+    moved, useful = polish_dma_bytes_per_fetch(d_feat)
+    rows = polish_eval_rows(size * size, iters, n_random)
+    return {
+        "polish_mode": _POLISH_MODE,
+        "kernel_bytes_per_polish": rows * moved,
+        "kernel_bytes_per_polish_useful": rows * useful,
+        "kernel_polish_dma_efficiency": round(useful / moved, 3),
+        "kernel_polish_eval_rows": rows,
+        "kernel_polish_schedule": {"iters": iters, "n_random": n_random},
     }
 
 

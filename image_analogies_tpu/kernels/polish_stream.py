@@ -1,0 +1,255 @@
+"""DMA-streamed candidate-row gather for the per-pixel polish
+(VERDICT r5 next-round 5 — the probe this round's ISSUE makes the
+tentpole).
+
+Why a kernel at all
+-------------------
+The polish pass (models/patchmatch.py: the sequential 12-gather
+cascade after the tile kernel's bulk search) is bound by XLA's per-row
+gather lowering: random (128-lane-padded) bf16 feature rows move at a
+measured 16-19 GB/s regardless of index distribution — sorted, iota,
+and coherent-field index sets all sit at the same floor
+(tools/profile_gather.py, 2026-07-31), so the cost is per-row issue
+overhead in the lowering, not HBM physics.  At 4096^2 the polish is
+~61 % of the 8.17 s level-0 wall (SCALE_r05).  The one hardware path
+that floor cannot bind is the DMA engines: the sweep kernel
+(patchmatch_tile.py) already streams its candidate windows as explicit
+HBM->VMEM `make_async_copy`s and its fetches run at an achieved
+~570 GB/s aggregate.  This module points the same machinery at the
+polish's 256 B rows.
+
+Why the kernel is ONLY the gather
+---------------------------------
+The polish's output contract is argmin-tie-equality with the pure-XLA
+cascade (the oracle twin the PSNR gates rest on).  Distances must
+therefore be BITWISE equal between the two paths — accept tests
+compare with `<` and `==`, so any reassociated f32 sum flips accepts.
+Measured on this toolchain (2026-08-04): `jnp.sum` over a zero-padded
+128-lane row is NOT bitwise equal to the sum over the unpadded
+feature width (XLA regroups the tree reduction), so a kernel that
+re-implemented the distance math could never pin bit-identity.  The
+kernel therefore does pure DATA MOVEMENT — fetch row idx[q] of the
+padded A table into the output block — and the distance arithmetic
+stays in the SAME `candidate_dist{,_lean}` code the cascade runs (a
+`gather_fn` hook swaps `jnp.take` for this kernel; see
+models/matcher.py).  Row fetch is bitwise-exact by construction, so
+streamed-vs-cascade bit-identity reduces to "the kernel returns
+exactly the table rows" — pinned directly by
+tests/test_polish_stream.py.
+
+Structure (per grid step, `_ROWS_PER_BLOCK` query rows):
+  - candidate indices arrive as SMEM scalars (8-row blocked like the
+    sweep kernel's candidate tables);
+  - each row is ONE (1, LANE) DMA from the HBM-resident padded table
+    into a VMEM slot row, issued back-to-back with a semaphore ring of
+    depth `_PREFETCH_DEPTH` (4 GB/s per in-flight fetch at the sweep
+    kernel's measured ~3.5 us DMA service time needs ~depth-16 to
+    clear the XLA gather floor; 32 gives 2x margin and costs nothing —
+    the slots are the output block itself, the ring is just
+    semaphores);
+  - one vector copy hands the landed block to the Pallas output
+    pipeline.
+
+Hardware risks, pre-recorded (no accelerator was reachable this round
+— POLISH_r08.json carries the recipe):
+  - bf16 dynamic sublane slicing is broken for VECTOR loads on this
+    toolchain (patchmatch_tile.py module header); whether the DMA
+    path shares the restriction is unverified.  Fallback, plan B: the
+    table rows bitcast-pack to (Na, 64) f32 pairs on the XLA side
+    (same bytes, f32 row DMA — the op class the sweep kernel ships)
+    and unpack with two shift/bitcast VPU ops in the consumer.
+  - per-row DMA issue rate: 256 B rows mean the fetch is
+    issue-bound, not bandwidth-bound.  The kill criterion is stated
+    on the RATE (tools/polish_stream_ab.py): the streamed polish
+    ships only if its measured level-0 polish beats the cascade's.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+
+# Semaphore-ring depth: how many row fetches are in flight at once.
+# ≫ the sweep kernel's 6 on purpose — its fetches were 288 KB (DMA
+# service time amortized over a large payload); these are 256 B, so
+# only queue depth amortizes the per-DMA fixed cost.
+_PREFETCH_DEPTH = 32
+
+# Query rows gathered per grid step (one SMEM index row, one output
+# block).  The per-step unrolled issue loop is `rows` long, so this
+# also bounds kernel code size.
+_ROWS_PER_BLOCK = 256
+
+
+def prepare_polish_table(f_a_tab: jnp.ndarray) -> jnp.ndarray:
+    """(Na, D<=LANE) table -> (Na, LANE) zero-col-padded copy the
+    kernel DMAs whole rows from.  Zero pad columns are sliced back off
+    by `candidate_dist{,_lean}` after the gather (their existing
+    wider-A-than-B rule), so distances are bitwise unchanged.  The
+    gathered row is LANE lanes either way — XLA's gather also moves
+    the 128-lane-padded row — so padding here changes residency
+    (~2x at the headline's D=68), not fetch bytes; the trade is
+    recorded in POLISH_r08.json."""
+    na, d = f_a_tab.shape
+    if d == LANE:
+        return f_a_tab
+    if d > LANE:
+        raise ValueError(f"feature width {d} > {LANE} lanes")
+    return jnp.pad(f_a_tab, ((0, 0), (0, LANE - d)))
+
+
+def polish_dma_bytes_per_fetch(
+    d_useful: int, itemsize: int = 2
+) -> Tuple[int, int]:
+    """(moved, useful) HBM bytes of ONE candidate-row fetch.
+
+    `moved` is the whole 128-lane padded row every fetch transfers —
+    identical for the streamed DMA and for XLA's gather lowering (both
+    move the padded row; the streamed path changes the RATE, not the
+    bytes).  `useful` is the unpadded feature width the distance sum
+    consumes.  The ONE byte model shared by the kernel's telemetry
+    counter (`ia_polish_dma_bytes_total`), bench.py's
+    `kernel_bytes_per_polish*` fields, and tools/check_polish.py —
+    same discipline as `candidate_dma_bytes_per_fetch` (round 7)."""
+    if not 0 < d_useful <= LANE:
+        raise ValueError(f"d_useful {d_useful} outside (0, {LANE}]")
+    return LANE * itemsize, d_useful * itemsize
+
+
+def polish_eval_rows(
+    n_queries: int, iters: int, n_random: int
+) -> int:
+    """Candidate-row evaluations of one polish call: the entry
+    re-evaluation plus, per sweep, 4 shifted + 4 unshifted propagation
+    candidates and `n_random` shrinking-radius probes — the sequential
+    cascade's exact gather count (models/patchmatch.py
+    patchmatch_sweeps{,_lean}), which the streamed path reproduces
+    fetch-for-fetch (same candidates, same order)."""
+    return n_queries * (1 + iters * (8 + n_random))
+
+
+def _make_gather_kernel(rows: int, depth: int):
+    """Row-gather kernel body: `rows` single-row DMAs from the HBM
+    table into the VMEM slot block, issued through a depth-`depth`
+    semaphore ring (fetch q waits on fetch q-depth before reusing its
+    semaphore — at most `depth` in flight, exactly the sweep kernel's
+    slot discipline with the slot buffer replaced by distinct output
+    rows, so no fetch ever overwrites an unconsumed one)."""
+
+    def kernel(idx_ref, a_ref, out_ref, slots_ref, sems_ref):
+        i = pl.program_id(0)
+        row = i % 8  # 8-row SMEM blocking, as in the sweep kernel
+
+        def copy_for(q):
+            r = idx_ref[row, q]
+            return pltpu.make_async_copy(
+                a_ref.at[pl.ds(r, 1), :],
+                slots_ref.at[pl.ds(q, 1), :],
+                sems_ref.at[q % depth],
+            )
+
+        for q in range(rows):
+            if q >= depth:
+                # The ring slot comes free when fetch q-depth lands
+                # ((q-depth) % depth == q % depth); its target row is
+                # distinct from ours, so waiting here only sequences
+                # the SEMAPHORE, not the data.
+                copy_for(q - depth).wait()
+            copy_for(q).start()
+        for q in range(max(0, rows - depth), rows):
+            copy_for(q).wait()
+        out_ref[:] = slots_ref[:]
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("rows", "interpret")
+)
+def _gather_rows_jit(f_a_pad, idx2, *, rows: int, interpret: bool):
+    n_blocks = idx2.shape[0]
+    pad8 = (-n_blocks) % 8
+    if pad8:
+        idx2 = jnp.pad(idx2, ((0, pad8), (0, 0)))
+    kernel = _make_gather_kernel(rows, _PREFETCH_DEPTH)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            # Index rows in SMEM, blocked 8 grid steps at a time (the
+            # sweep kernel's candidate-table pattern: Mosaic wants
+            # equal-dividing SMEM blocks, and 8 rows keeps the window
+            # tiny at any M).
+            pl.BlockSpec(
+                (8, rows), lambda i: (i // 8, 0),
+                memory_space=pltpu.SMEM,
+            ),
+            # The padded table stays in HBM; every fetch is an
+            # explicit row DMA from it.
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_blocks * rows, LANE), f_a_pad.dtype
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, LANE), f_a_pad.dtype),
+            pltpu.SemaphoreType.DMA((_PREFETCH_DEPTH,)),
+        ],
+        interpret=interpret,
+    )(idx2, f_a_pad)
+
+
+def gather_rows(
+    f_a_pad: jnp.ndarray,
+    idx: jnp.ndarray,
+    *,
+    interpret: bool = False,
+    useful_width: Optional[int] = None,
+    rows_per_block: Optional[int] = None,
+) -> jnp.ndarray:
+    """DMA-streamed row gather: rows `idx` (any shape, flattened) of
+    the (Na, LANE) padded table, returned as (idx.size, LANE) in
+    `idx` order — the drop-in replacement for
+    `jnp.take(f_a_pad, idx.reshape(-1), axis=0)` behind the
+    `gather_fn` hook of models/matcher.candidate_dist{,_lean}.
+
+    `useful_width` (the unpadded feature width) feeds the trace-time
+    `ia_polish_dma_bytes_total` counter; None counts the whole row as
+    useful.  Out-of-range indices are clamped (callers already clip —
+    this mirrors jnp.take's TPU clamp semantics defensively)."""
+    from ..telemetry.metrics import count_polish_dma_bytes
+
+    if f_a_pad.shape[1] != LANE:
+        raise ValueError(
+            f"table must be LANE-padded (got {f_a_pad.shape}); "
+            "run prepare_polish_table first"
+        )
+    flat = idx.reshape(-1).astype(jnp.int32)
+    m = flat.shape[0]
+    rows = rows_per_block or _ROWS_PER_BLOCK
+    rows = min(rows, max(8, m))
+    n_blocks = -(-m // rows)
+    moved_b, useful_b = polish_dma_bytes_per_fetch(
+        useful_width if useful_width is not None else LANE,
+        jnp.dtype(f_a_pad.dtype).itemsize,
+    )
+    count_polish_dma_bytes(
+        useful=m * useful_b, padded=m * (moved_b - useful_b)
+    )
+    pad = n_blocks * rows - m
+    if pad:
+        flat = jnp.pad(flat, (0, pad))  # row 0: harmless, sliced off
+    flat = jnp.clip(flat, 0, f_a_pad.shape[0] - 1)
+    out = _gather_rows_jit(
+        f_a_pad, flat.reshape(n_blocks, rows), rows=rows,
+        interpret=interpret,
+    )
+    return out[:m] if pad else out

@@ -1011,9 +1011,16 @@ def _record_level_telemetry(tracer, cfg: SynthConfig, level: int,
     quantities (see telemetry/metrics.py on the jit trace-time caveat):
     em_iters per executed level, one level per level.
     """
+    from . import patchmatch as _pm_mod
+
     for em in range(cfg.em_iters):
+        # polish_mode: which polish engine the matcher compiled in
+        # (models/patchmatch._POLISH_MODE — sequential cascade, jump
+        # flood, or the round-8 DMA stream); recorded per em_iter so a
+        # report from an A/B run says which arm it measured.
         em_sp = tracer.annotate(
-            "em_iter", parent=lvl_span, em=em, fused=plan.fuse
+            "em_iter", parent=lvl_span, em=em, fused=plan.fuse,
+            polish_mode=_pm_mod._POLISH_MODE,
         )
         for phase in ("assemble", "match", "render"):
             tracer.annotate(phase, parent=em_sp)

@@ -68,6 +68,7 @@ def candidate_dist(
     f_b_flat: jnp.ndarray,
     f_a_flat: jnp.ndarray,
     idx: jnp.ndarray,
+    gather_fn=None,
 ) -> jnp.ndarray:
     """Distance between each query row and A-row `idx[q]`; (N,).
 
@@ -76,8 +77,20 @@ def candidate_dist(
     traffic — a (N, D<=128) table gathers 128-lane-padded rows, so the
     bytes depend only on the dtype, and the random-row access pattern
     runs at ~16-19 GB/s (profiled 2026-07-31), which makes these
-    gathers the polish pass's whole cost."""
-    rows = jnp.take(f_a_flat, idx, axis=0).astype(jnp.float32)
+    gathers the polish pass's whole cost.
+
+    `gather_fn(table, flat_idx) -> rows` swaps the gather engine while
+    keeping the distance arithmetic BITWISE identical (the streamed
+    polish passes the Pallas DMA row gather,
+    kernels/polish_stream.gather_rows, closed over its LANE-padded
+    table copy — rows wider than the B side are sliced back to the
+    feature width, which drops only zero pad columns)."""
+    take = gather_fn or (lambda tab, ix: jnp.take(tab, ix, axis=0))
+    rows = take(f_a_flat, idx)
+    d = f_b_flat.shape[-1]
+    if rows.shape[-1] != d:
+        rows = jax.lax.slice(rows, (0, 0), (rows.shape[0], d))
+    rows = rows.astype(jnp.float32)
     diff = f_b_flat.astype(jnp.float32) - rows
     return jnp.sum(diff * diff, axis=-1)
 
@@ -99,6 +112,7 @@ def candidate_dist_lean(
     f_a_tab: jnp.ndarray,
     idx: jnp.ndarray,
     chunk: int = 1 << 20,
+    gather_fn=None,
 ) -> jnp.ndarray:
     """`candidate_dist` for the lean path: bf16 tables, evaluated in
     pixel chunks so the gathered-rows temp never reaches field size
@@ -114,6 +128,13 @@ def candidate_dist_lean(
     tools/profile_gather.py — the gather floor is per-call, not
     per-byte-pattern).
 
+    `gather_fn(table, flat_idx) -> rows` swaps the per-chunk gather
+    engine (same hook as `candidate_dist`): the streamed polish passes
+    the Pallas DMA row gather closed over a LANE-padded table copy,
+    and the existing wider-rows slice below restores the exact feature
+    width, so every distance stays bitwise identical to the jnp.take
+    path.
+
     Chunking is a static Python unroll over `lax.slice`s, NOT
     `lax.map`: the map formulation carried (n_chunks, chunk) operands
     whose per-step (1, chunk) slices were laid out lane-minor on the
@@ -123,6 +144,7 @@ def candidate_dist_lean(
     idx[..., i]), so the B side is a slice, not a gather — only the A
     side pays gather cost.  Distances accumulate in f32 regardless of
     table dtype."""
+    take = gather_fn or (lambda tab, ix: jnp.take(tab, ix, axis=0))
     lead = idx.shape[:-1]
     n = idx.shape[-1]
     n_lead = int(np.prod(lead)) if lead else 1
@@ -159,7 +181,7 @@ def candidate_dist_lean(
             ix = jnp.pad(ix, ((0, 0), (0, m_pad - m)))
             rows_b = jnp.pad(rows_b, ((0, m_pad - m), (0, 0)))
         rows2 = m_pad // LANES
-        a_rows = jnp.take(f_a_tab, ix.reshape(-1), axis=0)
+        a_rows = take(f_a_tab, ix.reshape(-1))
         if a_rows.shape[1] != d_feat:
             a_rows = jax.lax.slice(
                 a_rows, (0, 0), (a_rows.shape[0], d_feat)

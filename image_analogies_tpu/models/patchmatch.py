@@ -29,6 +29,7 @@ Python steps].
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -93,19 +94,31 @@ def patchmatch_sweeps(
     iters: int,
     n_random: int,
     coh_factor: float,
+    gather_fn=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Run `iters` propagate+random-search sweeps; returns (nnf, dist).
 
     `coh_factor` >= 1 biases acceptance toward coherent (propagation)
     candidates: random candidates must satisfy d * coh_factor < d_current.
+
+    `gather_fn` swaps the candidate-row gather engine inside
+    `candidate_dist` (matcher.py) while keeping every distance — and
+    therefore every accept/tie decision — bitwise identical: the
+    streamed polish (`_POLISH_MODE == "stream"`) passes the Pallas DMA
+    row gather here, so the streamed path IS this cascade with only
+    the fetch mechanism replaced.  None keeps the XLA `jnp.take`
+    lowering (the default path, bit-for-bit the historical behavior).
     """
     h, w, d = f_b.shape
     ha, wa = f_a.shape[:2]
     f_b_flat = f_b.reshape(-1, d)
     f_a_flat = f_a.reshape(-1, d)
+    d_fn = lambda idx: candidate_dist(  # noqa: E731
+        f_b_flat, f_a_flat, idx, gather_fn=gather_fn
+    )
 
     nnf = clamp_nnf(nnf, ha, wa)
-    dist = nnf_dist(f_b, f_a_flat, nnf, wa)
+    dist = d_fn(nnf_to_flat(nnf, wa)).reshape(h, w)
 
     # Exponential random-search radii: max dim, halving per scale (Barnes
     # alpha = 0.5), floored at 1 px.
@@ -116,7 +129,7 @@ def patchmatch_sweeps(
         nnf_cur, dist_cur = state
         cand = clamp_nnf(cand, ha, wa)
         idx = nnf_to_flat(cand, wa)
-        d_cand = candidate_dist(f_b_flat, f_a_flat, idx).reshape(h, w)
+        d_cand = d_fn(idx).reshape(h, w)
         # Exact ties break toward the lower flat index — the same canonical
         # representative `jnp.argmin` picks in the brute-force oracle.  In
         # flat feature regions (ubiquitous in texture-by-numbers label maps)
@@ -217,8 +230,9 @@ def tile_patchmatch(
     geom = tile_geometry(h, w, specs)
     coh = kappa_factor(cfg.kappa, level)
     pm_iters = _pm_iters_for(cfg, ha, wa)
-    if polish_iters is None:
-        polish_iters = cfg.pm_polish_iters
+    polish_iters, polish_random = _polish_schedule_for(
+        cfg, ha, wa, polish_iters
+    )
     # bf16 accept-metric tables (see docstring); candidate_dist does its
     # math in f32 after the gather, so only quantization enters.
     f_b16 = f_b.astype(jnp.bfloat16)
@@ -290,17 +304,25 @@ def tile_patchmatch(
     # the bf16 accept metric, then one exact f32 re-rank of the final
     # correspondences (the output contract's dist).  Default: the
     # sequential cascade (_POLISH_MODE — the A/B at the selector's
-    # definition); d_m is already in the accept metric, so no entry
-    # re-evaluation.
-    if _POLISH_MODE == "sequential":
+    # definition); "stream" is the SAME cascade with the row fetches
+    # routed through the Pallas DMA gather (bit-identical output;
+    # only the engine differs); random-probe count comes from the
+    # scale-aware schedule above.
+    if _POLISH_MODE in ("sequential", "stream"):
+        gf = (
+            _stream_gather_fn(f_a16_flat, f_a16.shape[-1], interpret)
+            if _POLISH_MODE == "stream"
+            else None
+        )
         nnf_p, d_p = patchmatch_sweeps(
             f_b16,
             f_a16,
             nnf_m,
             jax.random.fold_in(key, pm_iters),
             iters=polish_iters,
-            n_random=cfg.pm_polish_random,
+            n_random=polish_random,
             coh_factor=coh,
+            gather_fn=gf,
         )
     else:
         nnf_p, d_p = polish_sweeps(
@@ -310,7 +332,7 @@ def tile_patchmatch(
             d_m,
             jax.random.fold_in(key, pm_iters),
             iters=polish_iters,
-            n_random=cfg.pm_polish_random,
+            n_random=polish_random,
             coh_factor=coh,
         )
     if cfg.kappa > 0.0:
@@ -452,16 +474,71 @@ def _pm_iters_for(cfg: SynthConfig, ha: int, wa: int) -> int:
 
 # Polish implementation selector (module-level, not a config knob: the
 # choice is a measured performance decision, not user surface).
-# "jump": batched jump-flooding polish (polish_sweeps_planes) — 3
-# gathers per sweep.  "sequential": the chained per-candidate cascade
-# (patchmatch_sweeps/_lean) — 12 gathers per sweep.  The TPU headline
-# A/B picked the default (tools/polish_ab.py, 1024^2, 2026-08-01):
-# sequential 0.551 s / 35.56 dB min-over-seeds vs jump 0.725 s /
-# 35.34 dB — the microbenched 1.8x-per-candidate batched gather did
-# NOT compose into a faster level 0 (the jump candidate set + tie
-# flood cost more than the chain's 12 gathers), so sequential wins on
-# BOTH axes and stays the default.  Tests may mock.patch either path.
-_POLISH_MODE = "sequential"
+# "sequential": the chained per-candidate cascade
+# (patchmatch_sweeps/_lean) — 12 XLA gathers per sweep.  "jump":
+# batched jump-flooding polish (polish_sweeps_planes) — 3 gathers per
+# sweep; REJECTED by its own TPU A/B (tools/polish_ab.py, 1024^2,
+# 2026-08-01: jump 0.725 s / 35.34 dB vs sequential 0.551 s /
+# 35.56 dB min-over-seeds — the 1.8x-per-candidate batched gather did
+# not compose into a faster level 0), kept selectable as the recorded
+# negative.  "stream" (round 8): the SAME sequential cascade with the
+# candidate-row fetches routed through the Pallas DMA row gather
+# (kernels/polish_stream.py) instead of XLA's 16-19 GB/s per-row
+# gather lowering — identical candidates, accept rules, and PRNG
+# streams, so streamed output is BIT-IDENTICAL to sequential
+# (tests/test_polish_stream.py pins it in interpret mode); only the
+# fetch engine differs.  Default stays "sequential": no accelerator
+# was reachable in round 8, so the stream arm's rate claim is modeled,
+# not measured — tools/polish_stream_ab.py carries the hardware A/B
+# recipe and its pre-stated kill criterion (POLISH_r08.json), and the
+# env override IA_POLISH_MODE lets that A/B flip modes without a code
+# edit.  Tests may mock.patch any mode.
+_POLISH_MODE = os.environ.get("IA_POLISH_MODE", "sequential")
+
+# Scale-aware polish budget (round 8, the other half of VERDICT r5
+# task 4): the polish's shrinking-radius random probes re-search
+# globally at 12-gather prices, duplicating work the kernel's bulk
+# sweeps already do MORE of at large sizes (_PM_ITERS_BOOST adds 2
+# sweeps past the same area bound).  Above _POLISH_TRIM_AREA the
+# random-probe count is capped at _POLISH_RANDOM_LARGE; propagation
+# and tie canonicalization — the polish's actual job on a
+# kernel-converged field — are untouched.  Same threshold and
+# call-level placement as _pm_iters_for, so every runner inherits the
+# rule as a pure function of (cfg, A shape) and published families at
+# <= 2048^2 (area == the bound, not above it) are bit-unchanged; the
+# 4096^2 effect is recorded as a projection + small-scale PSNR
+# measurement in POLISH_r08.json, hardware confirmation owed.
+_POLISH_TRIM_AREA = _PM_BOOST_AREA
+_POLISH_RANDOM_LARGE = 2
+
+
+def _polish_schedule_for(
+    cfg: SynthConfig, ha: int, wa: int, polish_iters=None
+) -> Tuple[int, int]:
+    """(iters, n_random) of the per-pixel polish at this A domain:
+    cfg values (with the driver's polish_iters override) below
+    _POLISH_TRIM_AREA, random probes capped above it."""
+    iters = cfg.pm_polish_iters if polish_iters is None else polish_iters
+    n_random = cfg.pm_polish_random
+    if ha * wa > _POLISH_TRIM_AREA:
+        n_random = min(n_random, _POLISH_RANDOM_LARGE)
+    return iters, n_random
+
+
+def _stream_gather_fn(f_a_tab: jnp.ndarray, d_useful: int,
+                      interpret: bool):
+    """`gather_fn` for the streamed polish: the Pallas DMA row gather
+    closed over a LANE-padded copy of the table (built once per polish
+    call, outside the per-candidate loop).  The returned rows are
+    LANE wide; candidate_dist{,_lean} slice them back to the feature
+    width, which drops only zero pad — distances stay bitwise equal
+    to the jnp.take path."""
+    from ..kernels.polish_stream import gather_rows, prepare_polish_table
+
+    f_a_pad = prepare_polish_table(f_a_tab)
+    return lambda _tab, ix: gather_rows(
+        f_a_pad, ix, interpret=interpret, useful_width=d_useful
+    )
 
 
 def _lex_min(d: jnp.ndarray, idx: jnp.ndarray):
@@ -711,9 +788,16 @@ def tile_patchmatch_lean(
     geom = tile_geometry(h, w, specs)
     coh = kappa_factor(cfg.kappa, level)
     pm_iters = _pm_iters_for(cfg, ha, wa)
-    if polish_iters is None:
-        polish_iters = cfg.pm_polish_iters
-    if dist_fn is None:
+    polish_iters, polish_random = _polish_schedule_for(
+        cfg, ha, wa, polish_iters
+    )
+    # Stream-mode polish only replaces the DEFAULT local gather: a
+    # caller-supplied dist_fn (the band-sharded masked-pmin hook) owns
+    # its own fetch path, and streaming a shard's local gather is a
+    # separate (unprobed) composition — those callers keep the XLA
+    # cascade.
+    default_dist = dist_fn is None
+    if default_dist:
         dist_fn = lambda idx: candidate_dist_lean(  # noqa: E731
             f_b_tab, f_a_tab, idx
         )
@@ -771,13 +855,24 @@ def tile_patchmatch_lean(
     if polish_iters == 0:
         return py_m, px_m, d_m
     # Per-pixel polish under _POLISH_MODE: the sequential cascade by
-    # default (the A/B at the selector's definition), the batched
-    # jump-flooding variant (3 dist_fn calls per sweep,
-    # polish_sweeps_planes) selectable; d_m is already in the accept
-    # metric, so no entry re-evaluation is needed.  The sharded
-    # dist_fn hook works unchanged: candidate indices arrive (K, N)
-    # with query rows pairing along the last axis.
-    if _POLISH_MODE == "sequential":
+    # default (the A/B at the selector's definition), "stream" the
+    # same cascade with the default gather routed through the Pallas
+    # DMA row gather (bit-identical; sharded callers keep their own
+    # dist_fn — see `default_dist` above), the batched jump-flooding
+    # variant (3 dist_fn calls per sweep, polish_sweeps_planes)
+    # selectable; d_m is already in the accept metric, so no entry
+    # re-evaluation is needed.  The sharded dist_fn hook works
+    # unchanged: candidate indices arrive (K, N) with query rows
+    # pairing along the last axis.
+    if _POLISH_MODE in ("sequential", "stream"):
+        polish_dist = dist_fn
+        if _POLISH_MODE == "stream" and default_dist:
+            gf = _stream_gather_fn(
+                f_a_tab, f_b_tab.shape[1], interpret
+            )
+            polish_dist = lambda idx: candidate_dist_lean(  # noqa: E731
+                f_b_tab, f_a_tab, idx, gather_fn=gf
+            )
         py_p, px_p, d_p = patchmatch_sweeps_lean(
             f_b_tab,
             f_a_tab,
@@ -787,9 +882,9 @@ def tile_patchmatch_lean(
             ha=ha,
             wa=wa,
             iters=polish_iters,
-            n_random=cfg.pm_polish_random,
+            n_random=polish_random,
             coh_factor=coh,
-            dist_fn=dist_fn,
+            dist_fn=polish_dist,
         )
     else:
         py_p, px_p, d_p = polish_sweeps_planes(
@@ -800,7 +895,7 @@ def tile_patchmatch_lean(
             ha=ha,
             wa=wa,
             iters=polish_iters,
-            n_random=cfg.pm_polish_random,
+            n_random=polish_random,
             coh_factor=coh,
             dist_fn=dist_fn,
         )

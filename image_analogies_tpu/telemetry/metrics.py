@@ -290,6 +290,37 @@ def count_candidate_dma_bytes(useful: float, padded: float) -> None:
     c.inc(padded, labels={"kind": "padded"})
 
 
+def count_polish_dma_bytes(useful: float, padded: float) -> None:
+    """Record one traced polish row-gather's DMA bytes
+    (kernels/polish_stream.gather_rows), split into the unpadded
+    feature width the distance sum consumes (`kind="useful"`) and the
+    lane pad the 128-lane row fetch moves alongside it
+    (`kind="padded"`) — the polish twin of
+    `count_candidate_dma_bytes`: the PER-FETCH byte math is the one
+    shared model (kernels.polish_stream.polish_dma_bytes_per_fetch,
+    the same function bench.py's `kernel_bytes_per_polish*` fields
+    use).
+
+    TRACE-TIME count per call SITE (module docstring's jit caveat),
+    with a scan subtlety the candidate-DMA counter does not have: the
+    polish's sweep loop is a `jax.lax.scan`, whose body traces ONCE
+    regardless of the runtime sweep count, so a traced polish
+    compilation bumps this counter at 1 entry + (8 + n_random)
+    per-sweep sites — NOT 1 + iters*(8+n_random).  Totals here are
+    therefore per-compilation site counts; bench's
+    `kernel_bytes_per_polish` multiplies the same per-fetch model by
+    the RUNTIME schedule (`polish_eval_rows`), so the two agree on
+    bytes-per-fetch and rows-per-sweep but deliberately differ by the
+    sweep-count factor."""
+    c = get_registry().counter(
+        "ia_polish_dma_bytes_total",
+        "polish candidate-row DMA bytes per traced gather_rows call, "
+        "split useful vs padded (trace-time static count)",
+    )
+    c.inc(useful, labels={"kind": "useful"})
+    c.inc(padded, labels={"kind": "padded"})
+
+
 def count_kernel_launch(kernel: str) -> None:
     """Bump the shared Pallas-kernel launch counter — called at the
     top of each kernel wrapper (kernels/patchmatch_tile.tile_sweep,

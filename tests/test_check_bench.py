@@ -119,10 +119,52 @@ class TestKernelUtilFields:
         assert util["kernel_sweep_ms_ranking"]["diagnostic_only"] == []
 
 
+_HEADLINE_CFG = SynthConfig(
+    levels=5, matcher="patchmatch", em_iters=2, pm_iters=6,
+    pm_polish_iters=1,
+)
+
+
+class TestPolishFields:
+    """Round-8 polish byte model, pinned where the bench reads it
+    (bench._polish_fields shares kernels/polish_stream.py's model with
+    the ia_polish_dma_bytes_total counters)."""
+
+    def test_headline_fields(self):
+        f = bench._polish_fields(_HEADLINE_CFG, 1024)
+        # D=68 at the headline -> 136 useful of 256 moved per fetch.
+        assert f["kernel_polish_dma_efficiency"] == round(136 / 256, 3)
+        # 1 polish sweep, 4 random probes: 1 + 1*(8+4) = 13 rows/query.
+        assert f["kernel_polish_eval_rows"] == 1024 * 1024 * 13
+        assert (
+            f["kernel_bytes_per_polish"]
+            == f["kernel_polish_eval_rows"] * 256
+        )
+        assert (
+            f["kernel_bytes_per_polish_useful"]
+            == f["kernel_polish_eval_rows"] * 136
+        )
+        assert f["kernel_polish_schedule"] == {"iters": 1, "n_random": 4}
+        assert f["polish_mode"] in ("sequential", "jump", "stream")
+
+    def test_scale_aware_trim_above_area_bound(self):
+        """The scale-aware budget enters the published schedule: at
+        4096^2 the random probes cap at 2, cutting modeled polish
+        traffic by (8+2+1)/(8+4+1) per sweep-count."""
+        f1 = bench._polish_fields(_HEADLINE_CFG, 1024)
+        f4 = bench._polish_fields(_HEADLINE_CFG, 4096)
+        assert f4["kernel_polish_schedule"]["n_random"] == 2
+        assert f4["kernel_polish_eval_rows"] == 4096 * 4096 * 11
+        assert f1["kernel_polish_schedule"]["n_random"] == 4
+
+
 class TestValidateBench:
     def _valid(self):
         return _tpu_record(
-            bench._kernel_util_fields(5.0, 5.5, 5.0, _meta(True))
+            {
+                **bench._kernel_util_fields(5.0, 5.5, 5.0, _meta(True)),
+                **bench._polish_fields(_HEADLINE_CFG, 1024),
+            }
         )
 
     def test_real_builder_record_validates(self):
@@ -174,6 +216,89 @@ class TestValidateBench:
         rec["value"] = 0
         assert any("value" in e for e in validate_bench(rec))
 
+class TestCheckPolish:
+    """tools/check_polish.py wrapper: tier-1 enforces the round-8
+    polish artifact's schema — the acceptance criteria (bit-identity
+    booleans, byte model, pre-stated kill criterion, hardware recipe)
+    as validator rules, run against the COMMITTED POLISH_r08.json."""
+
+    def _artifact(self):
+        import json
+
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "POLISH_r08.json"
+        )
+        with open(path) as f:
+            return json.load(f)
+
+    def test_committed_artifact_validates(self):
+        from check_polish import validate_polish
+
+        assert validate_polish(self._artifact()) == []
+
+    def test_violations_detected(self):
+        from check_polish import validate_polish
+
+        base = self._artifact()
+
+        rec = copy.deepcopy(base)
+        rec["decision"]["kill_criterion_prestated"] = ""
+        assert any("kill_criterion" in e for e in validate_polish(rec))
+
+        rec = copy.deepcopy(base)
+        rec["measured_this_round"][
+            "stream_bit_identical_standard_path"
+        ] = False
+        assert any("bit-identity" in e for e in validate_polish(rec))
+
+        rec = copy.deepcopy(base)
+        pf = rec["byte_model"]["per_fetch_bytes"]
+        pf["useful"] = pf["moved"] + 1
+        assert any("per_fetch_bytes" in e for e in validate_polish(rec))
+
+        rec = copy.deepcopy(base)
+        del rec["projection_modeled_not_measured"]
+        assert any("projection" in e for e in validate_polish(rec))
+
+        rec = copy.deepcopy(base)
+        del rec["hardware_recipe"]
+        assert any("hardware_recipe" in e for e in validate_polish(rec))
+
+    def test_byte_model_consistency_with_kernel(self):
+        """The committed artifact's per-fetch bytes must BE the
+        kernel model's numbers — not a hand-typed copy that can
+        drift."""
+        from image_analogies_tpu.kernels.polish_stream import (
+            polish_dma_bytes_per_fetch,
+        )
+
+        art = self._artifact()
+        moved, useful = polish_dma_bytes_per_fetch(
+            art["byte_model"]["d_feat"]
+        )
+        assert art["byte_model"]["per_fetch_bytes"] == {
+            "moved": moved, "useful": useful
+        }
+
+    def test_cli_exit_codes(self, tmp_path):
+        import json
+
+        from check_polish import main as check_main
+
+        good = str(tmp_path / "good.json")
+        with open(good, "w") as f:
+            json.dump(self._artifact(), f)
+        assert check_main([good]) == 0
+        bad = self._artifact()
+        del bad["decision"]
+        badp = str(tmp_path / "bad.json")
+        with open(badp, "w") as f:
+            json.dump(bad, f)
+        assert check_main([badp]) == 1
+        assert check_main([str(tmp_path / "absent.json")]) == 2
+
+
+class TestValidateBenchProbes:
     def test_cross_backend_identity_probe(self):
         """The bench's own config-1 cell builder, CPU form: interpret
         Pallas vs XLA exact NN must be argmin-bit-equal on the

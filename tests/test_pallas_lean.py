@@ -33,6 +33,7 @@ class TestLeanPath:
         b = np.ascontiguousarray(a[:, ::-1], np.float32)
         return a, ap, b
 
+    @pytest.mark.slow
     def test_lean_uses_chunked_tables_and_tracks_oracle(self, rng):
         from unittest import mock
 
@@ -110,6 +111,7 @@ class TestLeanPath:
             bf16 = want.astype(jnp.bfloat16).astype(np.float32)
             np.testing.assert_array_equal(got, bf16)
 
+    @pytest.mark.slow
     def test_default_budget_keeps_small_levels_exact(self, rng):
         """128^2 levels are far below the default budget: the normal
         (exact-metric) path must still be selected."""
@@ -182,6 +184,7 @@ class TestLeanPath:
             np.asarray(dist_l), np.asarray(dist_s), rtol=1e-6
         )
 
+    @pytest.mark.slow
     def test_lean_kappa_increases_coherence(self, rng):
         """kappa=5 through the FORCED-LEAN path (feature_bytes_budget=1)
         must make the synthesized s-map measurably more coherent than
@@ -218,6 +221,7 @@ class TestLeanPath:
 
 
 class TestBatchedKernelPath:
+    @pytest.mark.slow
     def test_batch_runner_uses_kernel_under_vmap(self, rng):
         """The tile kernel must batch under vmap + mesh sharding (the
         frame axis becomes a leading grid dim), matching the single-image
@@ -267,6 +271,7 @@ class TestBatchedKernelPath:
 
 
 class TestBatchLeanPath:
+    @pytest.mark.slow
     def test_batch_runner_composes_with_lean_path(self, rng):
         """Batch x lean composition (round-3 VERDICT task 4): with a
         forced-tiny feature_bytes_budget the batch runner must take the

@@ -1,0 +1,333 @@
+"""DMA-streamed polish tests (round 8 tentpole): the Pallas row-gather
+engine (kernels/polish_stream.py) must return exactly the table rows,
+and the streamed polish (`_POLISH_MODE == "stream"`) must be
+argmin-tie-equal — in fact bit-identical — to the sequential 12-gather
+cascade in interpret mode, on both the standard and the lean matcher
+paths.  Plus the scale-aware polish schedule and the shared byte model.
+Interpreter mode on the CPU backend (OOB-checked, SURVEY.md §5).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from image_analogies_tpu.config import SynthConfig
+from image_analogies_tpu.kernels.polish_stream import (
+    LANE,
+    gather_rows,
+    polish_dma_bytes_per_fetch,
+    polish_eval_rows,
+    prepare_polish_table,
+)
+from image_analogies_tpu.models.matcher import (
+    candidate_dist,
+    candidate_dist_lean,
+)
+
+
+def _table(rng, na=300, d=68, dtype=jnp.bfloat16):
+    return jnp.asarray(
+        rng.random((na, d), np.float32), jnp.float32
+    ).astype(dtype)
+
+
+class TestGatherRows:
+    def test_rows_match_take_exactly(self, rng):
+        """The kernel is pure data movement: every fetched row must be
+        bitwise the table row — the whole streamed-polish bit-identity
+        contract reduces to this (module docstring)."""
+        tab = prepare_polish_table(_table(rng))
+        idx = jnp.asarray(
+            rng.integers(0, tab.shape[0], 1000, dtype=np.int32)
+        )
+        out = gather_rows(tab, idx, interpret=True)
+        ref = jnp.take(tab, idx, axis=0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_multi_block_and_ragged(self, rng):
+        """Grid blocking, the 8-group SMEM padding, and the ragged
+        final block must be invisible: force tiny blocks so one call
+        crosses all three paths."""
+        tab = prepare_polish_table(_table(rng, na=97))
+        idx = jnp.asarray(rng.integers(0, 97, 203, dtype=np.int32))
+        out = gather_rows(tab, idx, interpret=True, rows_per_block=16)
+        ref = jnp.take(tab, idx, axis=0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_leading_axes_flatten_in_order(self, rng):
+        tab = prepare_polish_table(_table(rng, na=50))
+        idx = jnp.asarray(
+            rng.integers(0, 50, (3, 40), dtype=np.int32)
+        )
+        out = gather_rows(tab, idx, interpret=True, rows_per_block=32)
+        ref = jnp.take(tab, idx.reshape(-1), axis=0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_out_of_range_clamps(self, rng):
+        tab = prepare_polish_table(_table(rng, na=40))
+        idx = jnp.asarray([0, 39, 40, 1000, -3], jnp.int32)
+        out = np.asarray(gather_rows(tab, idx, interpret=True))
+        ref = np.asarray(
+            jnp.take(tab, jnp.clip(idx, 0, 39), axis=0)
+        )
+        np.testing.assert_array_equal(out, ref)
+
+    def test_rejects_unpadded_table(self, rng):
+        with pytest.raises(ValueError, match="LANE-padded"):
+            gather_rows(
+                _table(rng), jnp.zeros((4,), jnp.int32), interpret=True
+            )
+
+    def test_prepare_table_pads_with_zeros(self, rng):
+        tab = _table(rng, d=68)
+        pad = prepare_polish_table(tab)
+        assert pad.shape == (tab.shape[0], LANE)
+        np.testing.assert_array_equal(
+            np.asarray(pad[:, :68]), np.asarray(tab)
+        )
+        assert (np.asarray(pad[:, 68:], np.float32) == 0).all()
+        # Already-padded tables pass through untouched.
+        assert prepare_polish_table(pad) is pad
+
+
+class TestStreamDist:
+    """The gather_fn hook: streamed distances must be BITWISE equal to
+    the jnp.take path (accept tests compare with < and ==, so anything
+    weaker would let the polish paths diverge on ties)."""
+
+    def _gf(self, tab, d):
+        pad = prepare_polish_table(tab)
+        return lambda _t, ix: gather_rows(
+            pad, ix, interpret=True, useful_width=d
+        )
+
+    def test_candidate_dist_bitwise(self, rng):
+        f_a = _table(rng, na=256)
+        f_b = _table(rng, na=256)
+        idx = jnp.asarray(rng.integers(0, 256, 256, dtype=np.int32))
+        ref = candidate_dist(f_b, f_a, idx)
+        out = candidate_dist(f_b, f_a, idx, gather_fn=self._gf(f_a, 68))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_candidate_dist_lean_bitwise_with_lead_axes(self, rng):
+        f_a = _table(rng, na=512)
+        f_b = _table(rng, na=384)
+        idx = jnp.asarray(
+            rng.integers(0, 512, (5, 384), dtype=np.int32)
+        )
+        ref = candidate_dist_lean(f_b, f_a, idx)
+        out = candidate_dist_lean(
+            f_b, f_a, idx, gather_fn=self._gf(f_a, 68)
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_sweeps_bit_identical_under_gather_hook(self, rng):
+        """patchmatch_sweeps with the streamed gather: same PRNG, same
+        candidates, same accepts — field and dist bitwise equal."""
+        from image_analogies_tpu.models.patchmatch import (
+            patchmatch_sweeps,
+        )
+
+        h = w = 16
+        f_b = jnp.asarray(
+            rng.random((h, w, 4), np.float32)
+        ).astype(jnp.bfloat16)
+        f_a = jnp.asarray(
+            rng.random((h, w, 4), np.float32)
+        ).astype(jnp.bfloat16)
+        nnf0 = jnp.zeros((h, w, 2), jnp.int32)
+        kw = dict(iters=2, n_random=2, coh_factor=1.0)
+        key = jax.random.PRNGKey(3)
+        nnf_s, d_s = patchmatch_sweeps(f_b, f_a, nnf0, key, **kw)
+        gf = self._gf(f_a.reshape(-1, 4), 4)
+        nnf_t, d_t = patchmatch_sweeps(
+            f_b, f_a, nnf0, key, gather_fn=gf, **kw
+        )
+        np.testing.assert_array_equal(np.asarray(nnf_s), np.asarray(nnf_t))
+        np.testing.assert_array_equal(np.asarray(d_s), np.asarray(d_t))
+
+
+def _pair(rng, h=128, w=128):
+    a = rng.random((h, w)).astype(np.float32)
+    ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
+    b = np.ascontiguousarray(a[:, ::-1], np.float32)
+    return a, ap, b
+
+
+def _run_mode(monkeypatch, mode, a, ap, b, cfg):
+    from image_analogies_tpu import create_image_analogy
+    import image_analogies_tpu.models.analogy as an
+    import image_analogies_tpu.models.patchmatch as pm
+
+    monkeypatch.setattr(pm, "_POLISH_MODE", mode)
+    # The mode is read at TRACE time inside cached level functions —
+    # flip requires fresh compilations (tools/polish_ab.py discipline).
+    an._level_fn.cache_clear()
+    an._em_step_fn.cache_clear()
+    out = create_image_analogy(a, ap, b, cfg, return_aux=True)
+    an._level_fn.cache_clear()
+    an._em_step_fn.cache_clear()
+    return out
+
+
+class TestStreamPolishPaths:
+    """Full matcher-path bit-identity: streamed vs sequential polish
+    through create_image_analogy in interpret mode — the acceptance
+    criterion's argmin-tie-equal gate, pinned as exact field equality
+    (strictly stronger)."""
+
+    def test_standard_path_bit_identical(self, rng, monkeypatch):
+        a, ap, b = _pair(rng)
+        cfg = SynthConfig(
+            levels=1, matcher="patchmatch", pallas_mode="interpret",
+            em_iters=1, pm_iters=2, pm_polish_iters=1,
+        )
+        seq = _run_mode(monkeypatch, "sequential", a, ap, b, cfg)
+        stm = _run_mode(monkeypatch, "stream", a, ap, b, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(seq["nnf"][0]), np.asarray(stm["nnf"][0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(seq["dist"][0]), np.asarray(stm["dist"][0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(seq["bp"]), np.asarray(stm["bp"])
+        )
+
+    @pytest.mark.slow
+    def test_lean_path_bit_identical(self, rng, monkeypatch):
+        a, ap, b = _pair(rng)
+        cfg = SynthConfig(
+            levels=1, matcher="patchmatch", pallas_mode="interpret",
+            em_iters=1, pm_iters=2, pm_polish_iters=1,
+            feature_bytes_budget=1,  # force the lean step
+        )
+        seq = _run_mode(monkeypatch, "sequential", a, ap, b, cfg)
+        stm = _run_mode(monkeypatch, "stream", a, ap, b, cfg)
+        py_s, px_s = seq["nnf"][0]
+        py_t, px_t = stm["nnf"][0]
+        np.testing.assert_array_equal(np.asarray(py_s), np.asarray(py_t))
+        np.testing.assert_array_equal(np.asarray(px_s), np.asarray(px_t))
+        np.testing.assert_array_equal(
+            np.asarray(seq["bp"]), np.asarray(stm["bp"])
+        )
+
+    def test_custom_dist_fn_keeps_cascade(self, rng, monkeypatch):
+        """Sharded callers pass their own dist_fn; stream mode must
+        NOT reroute it through the local row gather (the masked-pmin
+        fetch path is the shard contract)."""
+        import image_analogies_tpu.kernels.polish_stream as ps
+        import image_analogies_tpu.models.patchmatch as pm
+        from image_analogies_tpu.kernels.patchmatch_tile import (
+            plan_channels,
+            prepare_a_planes,
+        )
+        from image_analogies_tpu.models.patchmatch import (
+            RawPlanes,
+            tile_patchmatch_lean,
+        )
+
+        monkeypatch.setattr(pm, "_POLISH_MODE", "stream")
+        calls = []
+        real = ps.gather_rows
+
+        def spy(*args, **kw):
+            calls.append(1)
+            return real(*args, **kw)
+
+        monkeypatch.setattr(ps, "gather_rows", spy)
+
+        h = w = ha = wa = 128
+        cfg = SynthConfig(
+            levels=1, matcher="patchmatch", pallas_mode="interpret",
+            em_iters=1, pm_iters=1, pm_polish_iters=1,
+        )
+        src_b = jnp.asarray(rng.random((h, w), np.float32))
+        flt_b = jnp.asarray(rng.random((h, w), np.float32))
+        src_a = jnp.asarray(rng.random((ha, wa), np.float32))
+        flt_a = jnp.asarray(rng.random((ha, wa), np.float32))
+        from image_analogies_tpu.models.analogy import (
+            assemble_features_lean,
+        )
+
+        f_b_tab = assemble_features_lean(src_b, flt_b, cfg, None, None)
+        f_a_tab = assemble_features_lean(src_a, flt_a, cfg, None, None)
+        plan = plan_channels(1, 1, cfg, False, h, w, ha, wa)
+        a_planes = prepare_a_planes(
+            src_a, flt_a, None, None, plan[0]
+        )
+        raw = RawPlanes(src_b, flt_b, None, None, a_planes)
+        py0 = jnp.zeros((h, w), jnp.int32)
+        custom = lambda idx: candidate_dist_lean(  # noqa: E731
+            f_b_tab, f_a_tab, idx
+        )
+        tile_patchmatch_lean(
+            f_b_tab, f_a_tab, py0, py0, jax.random.PRNGKey(0),
+            raw=raw, cfg=cfg, level=0, interpret=True, plan=plan,
+            ha=ha, wa=wa, dist_fn=custom,
+        )
+        assert not calls, "streamed gather engaged on a custom dist_fn"
+
+
+class TestPolishSchedule:
+    """Scale-aware polish budget: pure function of (cfg, A shape),
+    cfg values below the area bound, random probes capped above it."""
+
+    def test_below_threshold_unchanged(self):
+        from image_analogies_tpu.models.patchmatch import (
+            _polish_schedule_for,
+        )
+
+        cfg = SynthConfig()
+        assert _polish_schedule_for(cfg, 2048, 2048) == (
+            cfg.pm_polish_iters, cfg.pm_polish_random
+        )
+
+    def test_above_threshold_caps_random(self):
+        from image_analogies_tpu.models.patchmatch import (
+            _POLISH_RANDOM_LARGE,
+            _polish_schedule_for,
+        )
+
+        cfg = SynthConfig()
+        iters, n_random = _polish_schedule_for(cfg, 4096, 4096)
+        assert iters == cfg.pm_polish_iters
+        assert n_random == _POLISH_RANDOM_LARGE
+
+    def test_driver_override_wins(self):
+        from image_analogies_tpu.models.patchmatch import (
+            _polish_schedule_for,
+        )
+
+        cfg = SynthConfig()
+        assert _polish_schedule_for(cfg, 4096, 4096, 0)[0] == 0
+
+    def test_matches_pm_boost_threshold(self):
+        """One area bound for both size-aware rules — the sweep boost
+        and the polish trim engage at the same scale."""
+        from image_analogies_tpu.models.patchmatch import (
+            _PM_BOOST_AREA,
+            _POLISH_TRIM_AREA,
+        )
+
+        assert _POLISH_TRIM_AREA == _PM_BOOST_AREA
+
+
+class TestByteModel:
+    def test_per_fetch_model(self):
+        moved, useful = polish_dma_bytes_per_fetch(68)
+        assert moved == LANE * 2
+        assert useful == 68 * 2
+        assert polish_dma_bytes_per_fetch(LANE) == (
+            LANE * 2, LANE * 2
+        )
+        with pytest.raises(ValueError):
+            polish_dma_bytes_per_fetch(0)
+        with pytest.raises(ValueError):
+            polish_dma_bytes_per_fetch(LANE + 1)
+
+    def test_eval_rows_formula(self):
+        # Entry re-evaluation + iters * (8 propagation + n_random).
+        assert polish_eval_rows(100, 1, 4) == 100 * 13
+        assert polish_eval_rows(100, 2, 2) == 100 * 21

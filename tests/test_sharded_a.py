@@ -12,12 +12,14 @@ import os
 
 import numpy as np
 import jax
+import pytest
 
 from image_analogies_tpu.config import SynthConfig
 from image_analogies_tpu.models.analogy import create_image_analogy
 from image_analogies_tpu.parallel.mesh import make_mesh
 
 
+@pytest.mark.slow
 def test_sharded_a_runner_bit_identical_to_single_device(rng):
     """Full band-sharded-A synthesis (parallel/sharded_a.py, round-3
     VERDICT task 7's 'full runner'): with the A-side lean tables and

@@ -34,6 +34,7 @@ class TestEndToEnd:
         # color should be well above zero somewhere.
         assert bp.std() > 0.05
 
+    @pytest.mark.slow
     def test_config2_artistic_filter_patchmatch_kappa(self):
         """Config 2 at reduced size: PatchMatch + kappa coherence."""
         a, ap, b = artistic_filter(64)
@@ -44,6 +45,7 @@ class TestEndToEnd:
         assert bp.shape == b.shape
         assert np.isfinite(bp).all()
 
+    @pytest.mark.slow
     def test_config3_super_resolution_psnr_vs_oracle(self):
         """Config 3 at reduced size: the PSNR-vs-CPU-ref acceptance gate."""
         a, ap, b = super_resolution(64)
@@ -52,6 +54,7 @@ class TestEndToEnd:
         bp_pm = _run(a, ap, b, matcher="patchmatch", pm_iters=10, **kw)
         assert psnr(bp_pm, bp_oracle) >= 33.0
 
+    @pytest.mark.slow
     def test_config4_steerable_luminance_only(self):
         """Config 4 at reduced size: steerable features, luminance-only."""
         a, ap, b = artistic_filter(64)
@@ -62,6 +65,7 @@ class TestEndToEnd:
         assert bp.shape == b.shape
         assert np.isfinite(bp).all()
 
+    @pytest.mark.slow
     def test_texture_transfer(self):
         """Hertzmann §4.4 texture transfer: A == A' (identity filter),
         B arbitrary — B' must be built out of the texture's pixels (its
@@ -128,6 +132,7 @@ class TestEndToEnd:
         )
         assert bp.shape == b.shape
 
+    @pytest.mark.slow
     def test_deterministic_given_seed(self):
         a, ap, b = artistic_filter(32)
         kw = dict(levels=2, matcher="patchmatch", em_iters=2, pm_iters=4, seed=3)
@@ -164,6 +169,7 @@ class TestEndToEnd:
         assert r["nnf"][0].shape == (32, 32, 2)
         assert float(r["dist"][0].min()) >= 0.0
 
+    @pytest.mark.slow
     def test_unfused_brute_levels_match_fused(self):
         """Brute levels past _SAFE_EXEC_DIST_ELEMS run the level
         function EAGERLY (separate device executions — the TPU worker
@@ -336,6 +342,7 @@ class TestLeanBrute:
         bp_k = _run(a, ap, b, pallas_mode="interpret", **kw)
         np.testing.assert_array_equal(bp_xla, bp_k)
 
+    @pytest.mark.slow
     def test_kappa_coherence_applies_on_lean_path(self):
         """The registered 'brute' matcher is CoherenceWrapper(brute):
         kappa>0 must bias the LEAN oracle too (round-4 review finding —

@@ -163,6 +163,48 @@ class TestMetricsRegistry:
         m4, u4 = candidate_dma_bytes_per_fetch(4, thp, True)
         assert m4 == u4
 
+    def test_polish_dma_byte_counters_from_gather_rows(self, rng):
+        """Round-8 polish twin of the candidate-DMA assertion: a
+        traced streamed-polish row gather must record its DMA bytes
+        split useful (unpadded feature width) vs padded (the 128-lane
+        row pad), with values matching `polish_dma_bytes_per_fetch`
+        exactly — the same model bench.py's `kernel_bytes_per_polish*`
+        fields publish, so the counter and the published claim cannot
+        drift."""
+        import jax.numpy as jnp
+
+        from image_analogies_tpu.kernels.polish_stream import (
+            gather_rows,
+            polish_dma_bytes_per_fetch,
+            prepare_polish_table,
+        )
+        from image_analogies_tpu.telemetry.metrics import set_registry
+
+        d_feat = 68
+        tab = prepare_polish_table(
+            jnp.asarray(
+                rng.random((64, d_feat), np.float32)
+            ).astype(jnp.bfloat16)
+        )
+        idx = jnp.asarray(
+            rng.integers(0, 64, 500, dtype=np.int32)
+        )
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        try:
+            gather_rows(
+                tab, idx, interpret=True, useful_width=d_feat
+            )
+        finally:
+            set_registry(prev)
+        c = reg.counter("ia_polish_dma_bytes_total")
+        moved, useful = polish_dma_bytes_per_fetch(d_feat)
+        assert moved == 128 * 2 and useful == d_feat * 2
+        assert c.value(labels={"kind": "useful"}) == 500 * useful
+        assert c.value(labels={"kind": "padded"}) == 500 * (
+            moved - useful
+        )
+
 
 # ----------------------------------------------------------------- spans
 class TestTracer:

@@ -7,9 +7,12 @@ instead of shipping: missing headline fields, a physically impossible
 roofline fraction (> 1 — the r4 incident this family of guards exists
 for), a kernel section without the round-7 byte-efficiency fields
 (useful vs padded candidate-DMA bytes), a missing in-file ranking of
-the three `kernel_sweep_ms*` instruments (VERDICT r5 weak 6), or a
+the three `kernel_sweep_ms*` instruments (VERDICT r5 weak 6), a
 config-1 row without its cross-backend correctness cell (VERDICT r5
-item 7).
+item 7), or — round 8 — a kernel section without the polish-phase
+byte fields (`kernel_bytes_per_polish*`, `polish_mode`,
+`kernel_polish_dma_efficiency`; see POLISH_r08.json and
+tools/check_polish.py for the round-8 artifact's own validator).
 
 Accepts either the raw record bench.py prints or the driver's capture
 wrapper (`{"n": ..., "parsed": {...}}`).  Kernel-utilization fields are
@@ -47,8 +50,15 @@ _KERNEL_REQUIRED = _ROOFLINE_FIELDS + (
     "kernel_sweep_ms_loop",
     "kernel_sweep_ms_trace",
     "kernel_sweep_ms_ranking",
+    # Round-8 polish-phase fields (bench.py _polish_fields): the byte
+    # model of the final-EM polish plus the active _POLISH_MODE.
+    "polish_mode",
+    "kernel_bytes_per_polish",
+    "kernel_bytes_per_polish_useful",
+    "kernel_polish_dma_efficiency",
 )
 _SWEEP_MS_FIELDS = ("kernel_sweep_ms_trace", "kernel_sweep_ms_loop")
+_POLISH_MODES = ("sequential", "jump", "stream")
 
 
 def _num(v) -> bool:
@@ -131,6 +141,24 @@ def validate_bench(record: dict) -> List[str]:
         if not (_num(eff) and 0.0 < eff <= 1.0):
             errs.append(
                 f"kernel_candidate_dma_efficiency {eff!r} not in (0, 1]"
+            )
+    mode = record.get("polish_mode")
+    if mode is not None and mode not in _POLISH_MODES:
+        errs.append(
+            f"polish_mode {mode!r} names none of {_POLISH_MODES}"
+        )
+    p_total = record.get("kernel_bytes_per_polish")
+    p_useful = record.get("kernel_bytes_per_polish_useful")
+    if _num(p_total) and _num(p_useful):
+        if not 0 < p_useful <= p_total:
+            errs.append(
+                f"kernel_bytes_per_polish_useful {p_useful} not in "
+                f"(0, {p_total}]"
+            )
+        p_eff = record.get("kernel_polish_dma_efficiency")
+        if not (_num(p_eff) and 0.0 < p_eff <= 1.0):
+            errs.append(
+                f"kernel_polish_dma_efficiency {p_eff!r} not in (0, 1]"
             )
     ranking = record.get("kernel_sweep_ms_ranking")
     if ranking is not None:

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Validate a POLISH_r08.json round artifact (the DMA-streamed polish
+probe record) — the tools/check_bench.py discipline applied to the
+round-8 decision artifact, so the acceptance criteria ("a measured
+interpret/XLA-oracle bit-identity result, the modeled bytes/roofline
+vs the gather floor, a pre-stated kill criterion, and the hardware A/B
+recipe") are enforced by a validator instead of trusted to prose.
+
+Usage:
+    python tools/check_polish.py POLISH_r08.json
+
+Runs under pytest too (tests/test_check_bench.py TestCheckPolish
+validates the COMMITTED artifact) so tier-1 fails if the record is
+missing, truncated, or structurally degraded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+_POLISH_MODES = ("sequential", "jump", "stream")
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_polish(record: dict) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+
+    dec = record.get("decision")
+    if not isinstance(dec, dict):
+        errs.append("decision: missing object")
+        dec = {}
+    if not isinstance(dec.get("default_mode"), str) or (
+        dec.get("default_mode") not in _POLISH_MODES
+    ):
+        errs.append(
+            f"decision.default_mode {dec.get('default_mode')!r} names "
+            f"none of {_POLISH_MODES}"
+        )
+    if not isinstance(dec.get("kill_criterion_prestated"), str) or not (
+        dec.get("kill_criterion_prestated") or ""
+    ).strip():
+        errs.append("decision.kill_criterion_prestated: missing/empty")
+
+    meas = record.get("measured_this_round")
+    if not isinstance(meas, dict):
+        errs.append("measured_this_round: missing object")
+        meas = {}
+    for key in (
+        "stream_bit_identical_standard_path",
+        "stream_bit_identical_lean_path",
+    ):
+        if not isinstance(meas.get(key), bool):
+            errs.append(f"measured_this_round.{key}: missing boolean")
+        elif meas[key] is not True:
+            errs.append(
+                f"measured_this_round.{key} is false — the streamed "
+                "polish must not ship without bit-identity"
+            )
+    if not isinstance(meas.get("bit_identity_backend"), str):
+        errs.append("measured_this_round.bit_identity_backend: missing")
+
+    bm = record.get("byte_model")
+    if not isinstance(bm, dict):
+        errs.append("byte_model: missing object")
+        bm = {}
+    pf = bm.get("per_fetch_bytes")
+    if not isinstance(pf, dict):
+        errs.append("byte_model.per_fetch_bytes: missing object")
+    else:
+        moved, useful = pf.get("moved"), pf.get("useful")
+        if not (_num(moved) and _num(useful) and 0 < useful <= moved):
+            errs.append(
+                f"byte_model.per_fetch_bytes moved={moved!r} "
+                f"useful={useful!r} violate 0 < useful <= moved"
+            )
+
+    proj = record.get("projection_modeled_not_measured")
+    if not isinstance(proj, dict):
+        errs.append("projection_modeled_not_measured: missing object")
+        proj = {}
+    wall = proj.get("projected_wall_4096_s")
+    if not (_num(wall) and wall > 0):
+        errs.append(
+            f"projection.projected_wall_4096_s {wall!r} is not a "
+            "positive number"
+        )
+    if not isinstance(proj.get("gap_attribution"), dict):
+        errs.append("projection.gap_attribution: missing object")
+
+    recipe = record.get("hardware_recipe")
+    if not isinstance(recipe, dict) or not isinstance(
+        recipe.get("tool"), str
+    ):
+        errs.append("hardware_recipe.tool: missing")
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record", help="path to POLISH_r08.json")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.record) as f:
+            record = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"check_polish: cannot read {args.record}: {e}",
+              file=sys.stderr)
+        return 2
+    errs = validate_polish(record)
+    if errs:
+        for e in errs:
+            print(f"check_polish: {e}", file=sys.stderr)
+        print(
+            f"check_polish: FAIL — {len(errs)} violation(s) in "
+            f"{args.record}", file=sys.stderr,
+        )
+        return 1
+    print(
+        "check_polish: OK — default_mode="
+        f"{record['decision']['default_mode']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

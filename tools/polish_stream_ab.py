@@ -1,0 +1,161 @@
+"""Headline A/B: DMA-streamed polish vs the sequential XLA cascade
+(`models/patchmatch._POLISH_MODE` "stream" vs "sequential") — the
+round-8 decision gate, in the tools/polish_ab.py discipline.
+
+KILL CRITERION, pre-stated: "stream" becomes the default iff, on
+hardware at the 1024^2 headline schedule, (a) its median wall beats
+sequential's, and (b) min-over-seeds PSNR-vs-oracle is unchanged —
+which bit-identity guarantees a priori, so (b) is a harness sanity
+check, and the decision rides on (a) alone: the DMA engines' per-row
+issue rate either clears XLA's measured 16-19 GB/s gather floor
+(>= ~75 M rows/s effective at 256 B rows) or it does not.  A loss is
+recorded as a polish_ab-style negative and sequential stays; there is
+no quality arm to trade because the two modes are bit-identical
+(tests/test_polish_stream.py).
+
+No accelerator was reachable in round 8, so this tool is the HARDWARE
+RECIPE (run it on the next TPU session; POLISH_r08.json carries the
+modeled projection it will confirm or kill).  On CPU it still runs the
+`--verify` arm: interpret-mode bit-identity of the full matcher path
+across modes — the measured correctness cell POLISH_r08.json quotes.
+
+    python tools/polish_stream_ab.py [size]          # TPU A/B
+    python tools/polish_stream_ab.py --verify [size] # CPU bit-identity
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax.numpy as jnp
+
+from image_analogies_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+from image_analogies_tpu import SynthConfig, create_image_analogy, psnr
+from image_analogies_tpu.utils.examples import super_resolution
+from image_analogies_tpu.utils.kernelbench import sync as _sync
+
+
+def _set_mode(mode: str):
+    import image_analogies_tpu.models.analogy as an
+    import image_analogies_tpu.models.patchmatch as pm
+
+    pm._POLISH_MODE = mode
+    an._level_fn.cache_clear()
+    an._em_step_fn.cache_clear()
+
+
+def verify(size: int) -> dict:
+    """Interpret-mode bit-identity of the WHOLE matcher path across
+    modes (CPU-runnable) — the same contract
+    tests/test_polish_stream.py pins, re-measured here so the round
+    artifact quotes a tool run, not only a test name."""
+    a, ap, b = super_resolution(size)
+    a, ap, b = (jnp.asarray(x, jnp.float32) for x in (a, ap, b))
+    cfg = SynthConfig(
+        levels=2, matcher="patchmatch", pallas_mode="interpret",
+        em_iters=1, pm_iters=2, pm_polish_iters=1,
+    )
+    outs = {}
+    for mode in ("sequential", "stream"):
+        _set_mode(mode)
+        aux = create_image_analogy(a, ap, b, cfg, return_aux=True)
+        outs[mode] = (
+            np.asarray(aux["bp"]),
+            np.asarray(aux["dist"][0]),
+        )
+    _set_mode(os.environ.get("IA_POLISH_MODE", "sequential"))
+    bp_eq = bool((outs["sequential"][0] == outs["stream"][0]).all())
+    d_eq = bool((outs["sequential"][1] == outs["stream"][1]).all())
+    return {
+        "arm": "verify",
+        "size": size,
+        "backend": "cpu-interpret",
+        "bp_bit_identical": bp_eq,
+        "dist_bit_identical": d_eq,
+    }
+
+
+def measure(mode: str, a, ap, b) -> dict:
+    _set_mode(mode)
+    cfg = SynthConfig(
+        levels=5, matcher="patchmatch", em_iters=2, pm_iters=6,
+        pm_polish_iters=1,
+    )
+    run = lambda: create_image_analogy(a, ap, b, cfg)  # noqa: E731
+    _sync(run())  # compile
+    walls, out = [], None
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = run()
+        _sync(out)
+        walls.append(round(time.perf_counter() - t0, 4))
+    seeds_psnr = []
+    for seed in (0, 1, 2):
+        cfg_s = SynthConfig(
+            levels=5, matcher="patchmatch", em_iters=2, pm_iters=6,
+            pm_polish_iters=1, seed=seed,
+        )
+        o = np.asarray(create_image_analogy(a, ap, b, cfg_s))
+        seeds_psnr.append(round(psnr(o, _ORACLE), 2))
+    return {
+        "mode": mode,
+        "wall_median_s": statistics.median(walls),
+        "wall_runs_s": walls,
+        "psnr_seeds_db": seeds_psnr,
+        "psnr_min_db": min(seeds_psnr),
+    }
+
+
+def main():
+    args = [x for x in sys.argv[1:] if x != "--verify"]
+    size = int(args[0]) if args else 1024
+    if "--verify" in sys.argv:
+        print(json.dumps(verify(min(size, 128))), flush=True)
+        return
+    global _ORACLE
+    a, ap, b = super_resolution(size)
+    a, ap, b = (jnp.asarray(x, jnp.float32) for x in (a, ap, b))
+    for x in (a, ap, b):
+        _sync(x)
+    opath = os.path.join(
+        os.path.dirname(__file__), "_oracle_out", f"oracle_f32_{size}.npy"
+    )
+    if os.path.exists(opath):
+        _ORACLE = np.load(opath)
+    else:
+        _ORACLE = np.asarray(create_image_analogy(
+            a, ap, b, SynthConfig(levels=5, matcher="brute", em_iters=2)
+        ))
+    res = {
+        "size": size,
+        "sequential": measure("sequential", a, ap, b),
+        "stream": measure("stream", a, ap, b),
+        "kill_criterion": (
+            "stream ships iff wall_median(stream) < wall_median("
+            "sequential) at the 1024^2 headline; PSNR is bit-pinned "
+            "equal, so the decision is wall-only"
+        ),
+    }
+    s, t = res["sequential"], res["stream"]
+    res["delta"] = {
+        "wall_s": round(t["wall_median_s"] - s["wall_median_s"], 4),
+        "psnr_min_db": round(t["psnr_min_db"] - s["psnr_min_db"], 2),
+    }
+    res["decision"] = (
+        "stream" if t["wall_median_s"] < s["wall_median_s"]
+        else "sequential"
+    )
+    _set_mode(os.environ.get("IA_POLISH_MODE", "sequential"))
+    print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()
