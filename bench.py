@@ -130,13 +130,27 @@ def _phase_breakdown(a, ap, b, cfg):
     Round-6 revision: consumes the telemetry subsystem directly (an
     in-memory Tracer + the same span tree `report.json` is built from)
     instead of round-tripping a tempfile JSONL — the bench and the
-    report now read one instrumentation source by construction."""
-    from image_analogies_tpu import create_image_analogy
-    from image_analogies_tpu.telemetry import Tracer
+    report now read one instrumentation source by construction.
 
-    tracer = Tracer()
+    Round-9 revision: the instrumented run records into its OWN
+    metrics registry (installed as the process default for its
+    duration, the telemetry_session discipline) and the tracer is
+    returned so the run sentinel can join spans + counters against the
+    analytic models — every bench record ships its health verdict."""
+    from image_analogies_tpu import create_image_analogy
+    from image_analogies_tpu.telemetry import MetricsRegistry, Tracer
+    from image_analogies_tpu.telemetry.metrics import set_registry
+
+    reg = MetricsRegistry()
+    tracer = Tracer(registry=reg)
+    prev = set_registry(reg)
     t0 = time.perf_counter()
-    _warm(lambda: create_image_analogy(a, ap, b, cfg, progress=tracer))
+    try:
+        _warm(
+            lambda: create_image_analogy(a, ap, b, cfg, progress=tracer)
+        )
+    finally:
+        set_registry(prev)
     instrumented_wall_s = round(time.perf_counter() - t0, 4)
     # Last occurrence wins: _warm may run twice on the tunnel's
     # remote-compile flake, and the retry's spans are the clean ones.
@@ -149,6 +163,26 @@ def _phase_breakdown(a, ap, b, cfg):
         prologue_ms,
         [walls[lvl] for lvl in sorted(walls)],
         instrumented_wall_s,
+        tracer,
+    )
+
+
+def _bench_health(rec: dict, tracer) -> dict:
+    """The run sentinel's verdict for this bench execution: the
+    instrumented run's span tree + metrics registry joined against the
+    analytic models, plus the record-level instrument-drift check —
+    embedded in the printed record (so every future BENCH_r*.json
+    carries its own verdict) and written to health.json beside it."""
+    from image_analogies_tpu.telemetry.sentinel import evaluate_health
+
+    return evaluate_health(
+        spans=tracer.to_dict(),
+        metrics=(
+            tracer.registry.to_dict()
+            if tracer.registry is not None else None
+        ),
+        bench_record=rec,
+        context="bench",
     )
 
 
@@ -650,8 +684,8 @@ def main() -> None:
         a, ap, b, levels, em_iters
     )
 
-    prologue_ms, level_wall_ms, instrumented_wall_s = _phase_breakdown(
-        a, ap, b, cfg
+    prologue_ms, level_wall_ms, instrumented_wall_s, tracer = (
+        _phase_breakdown(a, ap, b, cfg)
     )
     util = _kernel_utilization(cfg, size) if on_tpu else None
     config_rows = _acceptance_configs(on_tpu)
@@ -685,6 +719,20 @@ def main() -> None:
     }
     if util:
         rec.update(util)
+    # Run sentinel: every bench record ships its own verdict (the
+    # embedded form is what tools/check_{bench,trajectory}.py read),
+    # and the standalone verdict file is written too — to $IA_BENCH_HEALTH
+    # when set, else ./health.json (gitignored; override when the
+    # working directory already holds another run's verdict).
+    import os
+
+    from image_analogies_tpu.telemetry.sentinel import write_health
+
+    health = _bench_health(rec, tracer)
+    rec["health"] = health
+    write_health(
+        health, os.environ.get("IA_BENCH_HEALTH", "health.json")
+    )
     print(json.dumps(rec))
 
 

@@ -101,6 +101,14 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
         "--progress also asks for it).  Use --trace-dir for the full "
         "telemetry layout",
     )
+    p.add_argument(
+        "--health", action="store_true",
+        help="evaluate the run sentinel at the end of the run "
+        "(expected-vs-observed model checks + span/energy invariants, "
+        "telemetry/sentinel.py), print the verdict, and write "
+        "health.json beside the other --trace-dir artifacts.  Implies "
+        "instrumentation (one host sync per level)",
+    )
 
 
 def _config_from(args) -> "SynthConfig":
@@ -131,6 +139,30 @@ def _config_from(args) -> "SynthConfig":
         pallas_mode=args.pallas_mode,
         save_level_artifacts=args.save_level_artifacts,
     )
+
+
+def _emit_health(tracer, trace_dir, context: str) -> None:
+    """Run the sentinel over the finished run's tracer/registry, print
+    the verdict, and (when a telemetry dir exists) write health.json
+    beside the other artifacts — the synth/batch `--health` epilogue."""
+    from .telemetry.sentinel import (
+        HEALTH_FILE,
+        evaluate_health,
+        render_health,
+        write_health,
+    )
+
+    health = evaluate_health(
+        spans=tracer.to_dict() if tracer.enabled else None,
+        metrics=(
+            tracer.registry.to_dict()
+            if tracer.registry is not None else None
+        ),
+        context=context,
+    )
+    if trace_dir:
+        write_health(health, os.path.join(trace_dir, HEALTH_FILE))
+    print(render_health(health))
 
 
 def _select_device(device: str | None) -> None:
@@ -177,7 +209,7 @@ def cmd_synth(args) -> int:
     # minimal host syncs).  The historic --profile keeps its original
     # meaning — a device trace of the UN-instrumented run — so it does
     # NOT enable spans; --trace-dir (the telemetry layout) does.
-    instrument = bool(args.progress or args.trace_dir)
+    instrument = bool(args.progress or args.trace_dir or args.health)
     if args.bands > 1 and not args.spatial:
         raise SystemExit(
             "--bands requires --spatial (it names the A-band axis of "
@@ -243,6 +275,10 @@ def cmd_synth(args) -> int:
         events.emit("done", wall_s=round(time.perf_counter() - t0, 3))
     save_image(args.out, bp)
     print(f"wrote {args.out} ({time.perf_counter() - t0:.2f}s)")
+    # Sentinel epilogue runs AFTER the output is saved: a verdict/IO
+    # failure must never discard a finished synthesis.
+    if args.health:
+        _emit_health(tracer, args.trace_dir, "synth")
     return 0
 
 
@@ -269,9 +305,9 @@ def cmd_batch(args) -> int:
     t0 = time.perf_counter()
 
     # --profile keeps its historic un-instrumented-trace meaning (see
-    # cmd_synth); only --progress / --trace-dir enable spans, and
-    # telemetry artifacts land only in --trace-dir.
-    instrument = bool(args.progress or args.trace_dir)
+    # cmd_synth); only --progress / --trace-dir / --health enable
+    # spans, and telemetry artifacts land only in --trace-dir.
+    instrument = bool(args.progress or args.trace_dir or args.health)
     with telemetry_session(
         args.trace_dir or args.profile, sink=progress,
         enabled=instrument, artifact_dir=args.trace_dir,
@@ -291,6 +327,9 @@ def cmd_batch(args) -> int:
         f"wrote {len(names)} frames to {args.out} "
         f"({time.perf_counter() - t0:.2f}s on {mesh.devices.size} devices)"
     )
+    # Sentinel epilogue after the frames are on disk (see cmd_synth).
+    if args.health:
+        _emit_health(tracer, args.trace_dir, "batch")
     return 0
 
 
@@ -345,6 +384,36 @@ def cmd_report(args) -> int:
         print(render_table(report))
     print(f"wrote {out}")
     return 0
+
+
+def cmd_health(args) -> int:
+    """Offline run sentinel: evaluate a traced run's telemetry
+    directory (host_spans.json + metrics.json) against the analytic
+    models and invariants, write health.json beside them, and exit
+    nonzero on a violated verdict (telemetry/sentinel.py)."""
+    import json
+
+    from .telemetry.sentinel import (
+        HEALTH_FILE,
+        health_from_trace_dir,
+        render_health,
+        write_health,
+    )
+
+    try:
+        health = health_from_trace_dir(args.trace_dir)
+    except (FileNotFoundError, ValueError) as e:
+        # ValueError: corrupt metrics.json (unparseable label keys) —
+        # a clean message + exit code, not a traceback.
+        raise SystemExit(f"health: {e}")
+    out = args.out or os.path.join(args.trace_dir, HEALTH_FILE)
+    write_health(health, out)
+    if args.format == "json":
+        print(json.dumps(health, indent=1))
+    else:
+        print(render_health(health))
+    print(f"wrote {out}")
+    return 1 if health["verdict"] == "violated" else 0
 
 
 def main(argv=None) -> int:
@@ -424,6 +493,25 @@ def main(argv=None) -> int:
     )
     p.add_argument("--format", default="table", choices=["table", "json"])
     p.set_defaults(fn=cmd_report)
+
+    p = sub.add_parser(
+        "health",
+        help="run sentinel over a traced run's telemetry directory: "
+        "expected-vs-observed model checks + run invariants -> "
+        "health.json (exit 1 on a violated verdict)",
+    )
+    _add_common_flags(p)
+    p.add_argument(
+        "--trace-dir", required=True, metavar="DIR",
+        help="telemetry directory a traced run wrote "
+        "(host_spans.json / metrics.json)",
+    )
+    p.add_argument(
+        "--out", default=None,
+        help="health path (default: <trace-dir>/health.json)",
+    )
+    p.add_argument("--format", default="table", choices=["table", "json"])
+    p.set_defaults(fn=cmd_health)
 
     args = parser.parse_args(argv)
     from .utils.progress import configure_logging

@@ -972,6 +972,7 @@ def _tile_sweep_jit(
 ):
     from ..telemetry.metrics import (
         count_candidate_dma_bytes,
+        count_candidate_dma_fetches,
         count_kernel_launch,
     )
 
@@ -991,6 +992,13 @@ def _tile_sweep_jit(
     count_candidate_dma_bytes(
         useful=n_ty * n_tx * K_TOTAL * useful_b,
         padded=n_ty * n_tx * K_TOTAL * (moved_b - useful_b),
+    )
+    # Structural twin of the byte counter: the fetch count plus the
+    # geometry that prices a fetch, so the run sentinel can recompute
+    # the expected bytes from the shared model and hold the two series
+    # together (telemetry/sentinel.py candidate-DMA check).
+    count_candidate_dma_fetches(
+        n_ty * n_tx * K_TOTAL, n_chan, thp, resolve_packed(packed)
     )
     if band is None:
         band = jnp.asarray([0, ha], jnp.int32)

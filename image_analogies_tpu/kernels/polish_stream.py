@@ -225,7 +225,10 @@ def gather_rows(
     `ia_polish_dma_bytes_total` counter; None counts the whole row as
     useful.  Out-of-range indices are clamped (callers already clip —
     this mirrors jnp.take's TPU clamp semantics defensively)."""
-    from ..telemetry.metrics import count_polish_dma_bytes
+    from ..telemetry.metrics import (
+        count_polish_dma_bytes,
+        count_polish_dma_rows,
+    )
 
     if f_a_pad.shape[1] != LANE:
         raise ValueError(
@@ -243,6 +246,14 @@ def gather_rows(
     )
     count_polish_dma_bytes(
         useful=m * useful_b, padded=m * (moved_b - useful_b)
+    )
+    # Structural twin: row count + fetch pricing, so the run sentinel
+    # can recompute the expected bytes from the shared model
+    # (telemetry/sentinel.py polish-DMA check).
+    count_polish_dma_rows(
+        m,
+        useful_width if useful_width is not None else LANE,
+        jnp.dtype(f_a_pad.dtype).itemsize,
     )
     pad = n_blocks * rows - m
     if pad:

@@ -998,6 +998,30 @@ def record_prologue(tracer, pyr_raw_b, levels: int, t0: float) -> None:
     )
 
 
+def record_level_span(tracer, cfg: SynthConfig, level_t0: float,
+                      level: int, h, w, nnf_energy: float, **attrs):
+    """Timed `level` span + declared em_iter children — the shared
+    form for the parallel runners (batch/spatial/sharded-A), whose
+    level wall is clocked around one already-synced runner call.  The
+    single-device driver records the same structure through its
+    context-managed span + `_record_level_telemetry` instead.  The
+    `em_iters` declaration and matching untimed children are what the
+    run sentinel's span-tree completeness check holds every runner
+    to."""
+    sp = tracer.record(
+        "level",
+        round((time.perf_counter() - level_t0) * 1000, 3),
+        level=level,
+        shape=[int(h), int(w)],
+        nnf_energy=nnf_energy,
+        em_iters=cfg.em_iters,
+        **attrs,
+    )
+    for em in range(cfg.em_iters):
+        tracer.annotate("em_iter", parent=sp, em=em)
+    return sp
+
+
 def _record_level_telemetry(tracer, cfg: SynthConfig, level: int,
                             lvl_span, plan: LevelPlan) -> None:
     """Span-tree structure + metrics-registry updates for one finished
@@ -1013,6 +1037,10 @@ def _record_level_telemetry(tracer, cfg: SynthConfig, level: int,
     """
     from . import patchmatch as _pm_mod
 
+    # Declare the expected EM-child count on the span itself so the
+    # run sentinel's span-tree completeness check (telemetry/sentinel)
+    # can hold children == declaration without knowing the config.
+    lvl_span.set(em_iters=cfg.em_iters)
     for em in range(cfg.em_iters):
         # polish_mode: which polish engine the matcher compiled in
         # (models/patchmatch._POLISH_MODE — sequential cascade, jump

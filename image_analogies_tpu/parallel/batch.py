@@ -548,12 +548,10 @@ def synthesize_batch(
             # Sync first (nnf_energy readback), then record the timed
             # `level` span — its emitted view is the legacy
             # `level_done` event, which now also carries wall_ms.
-            nnf_energy = float(dist.mean())
-            tracer.record(
-                "level",
-                round((time.perf_counter() - level_t0) * 1000, 3),
-                level=level, shape=[int(h), int(w)],
-                nnf_energy=nnf_energy,
+            from ..models.analogy import record_level_span
+
+            record_level_span(
+                tracer, cfg, level_t0, level, h, w, float(dist.mean())
             )
         if cfg.save_level_artifacts:
             # Whole-batch per-level state through the single-image writer:

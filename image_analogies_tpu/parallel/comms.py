@@ -105,7 +105,15 @@ def sharded_a_allreduce_count(
       + 2            entry dist0 + exact-metric merge d_k
                      (1 `_sharded_dist` pmin each)
       + polish       `_polish_dist_calls` pmins
-      + 8 if kappa>0 coherence adoption (2 sweeps x 4 neighbors)
+      + 8 if kappa>0 coherence adoption (2 sweeps x 4 neighbors) —
+                     ONLY on EM iterations whose polish is engaged:
+                     `tile_patchmatch_lean` returns before the
+                     Ashikhmin pass when that EM's polish_iters is 0
+                     (non-final iterations under pm_polish_final_only),
+                     so a mid-EM contributes no coherence collectives.
+                     (Round-9 fix — the model previously booked the 8
+                     on every EM; the run sentinel's expected-vs-
+                     observed ledger is what surfaced it.)
     """
     from ..models.patchmatch import _pm_iters_for
 
@@ -114,10 +122,54 @@ def sharded_a_allreduce_count(
     total = 0
     for em in range(ems):
         final = per_em or em == cfg.em_iters - 1
+        polish = _polish_dist_calls(cfg, ha, wa, final)
         total += 4 * pm_iters + 2
-        total += _polish_dist_calls(cfg, ha, wa, final)
-        if cfg.kappa > 0.0:
+        total += polish
+        if cfg.kappa > 0.0 and polish > 0:
             total += 2 * 4
+    return total
+
+
+def sharded_a_allreduce_sites(
+    cfg: SynthConfig, ha: int, wa: int, *, per_em: bool = False,
+    polish_iters=None,
+) -> int:
+    """Traced collective call SITES of one band-sharded level call (or
+    one `_banded_lean_step_fn` EM step with that runner's explicit
+    `polish_iters` override) — the unit a Python-side trace-time
+    counter observes (telemetry/metrics.py's jit caveat), and the
+    expected side of the run sentinel's comms assertion.
+
+    Identical to `sharded_a_allreduce_count` except the polish term:
+    the polish's sweep loop is a `jax.lax.scan` whose body traces
+    ONCE, so an engaged polish contributes `1 + (8 + n_random)` sites
+    regardless of its iteration count, where the runtime count is
+    `1 + iters * (8 + n_random)`.  Every other term is a Python-level
+    loop (pm iterations, coherence sweeps), where sites == runtime
+    collectives.  The two formulas coincide at pm_polish_iters == 1 —
+    which is why the HLO-count test and a site ledger can both be
+    exact."""
+    from ..models.patchmatch import _pm_iters_for, _polish_schedule_for
+
+    pm_iters = _pm_iters_for(cfg, ha, wa)
+    ems = 1 if per_em else cfg.em_iters
+    total = 0
+    for em in range(ems):
+        if per_em:
+            iters, n_random = _polish_schedule_for(
+                cfg, ha, wa, polish_iters
+            )
+        else:
+            final = em == cfg.em_iters - 1
+            override = (
+                None if (final or not cfg.pm_polish_final_only) else 0
+            )
+            iters, n_random = _polish_schedule_for(cfg, ha, wa, override)
+        total += 4 * pm_iters + 2
+        if iters > 0:
+            total += 1 + 8 + n_random  # scan body: one trace per sweep set
+            if cfg.kappa > 0.0:
+                total += 2 * 4  # Ashikhmin pass, Python-unrolled
     return total
 
 

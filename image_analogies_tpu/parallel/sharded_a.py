@@ -105,6 +105,12 @@ def _band_merge(oy, ox, d):
     carry.  Order-equivalent at kappa=0 (strict-improvement accepts);
     at kappa>0 the raw-distance pmin slightly weakens the cross-band
     coherence bias (module docstring, 'Equivalence')."""
+    from ..telemetry.metrics import count_collectives
+
+    # OBSERVED side of the sentinel's comms ledger: this site traces 4
+    # all-reduces (2 pmin + 2 psum).  Trace-time count, like every
+    # counter inside jitted code (telemetry/metrics.py caveat).
+    count_collectives(4, _AXIS)
     i = jax.lax.axis_index(_AXIS)
     d_min = jax.lax.pmin(d, _AXIS)
     mine = jnp.where(d == d_min, i, jnp.iinfo(jnp.int32).max)
@@ -119,8 +125,11 @@ def _sharded_dist(f_b_tab, f_a_shard, row_lo_flat, idx):
     """Masked local-shard candidate distances merged by pmin: each flat
     A index has exactly one owning band, so the merge reproduces the
     single-table `candidate_dist_lean` value bit-for-bit."""
-    from ..telemetry.metrics import get_registry
+    from ..telemetry.metrics import count_collectives, get_registry
 
+    # OBSERVED side of the sentinel's comms ledger: one pmin all-reduce
+    # per distance-evaluation site (trace-time count).
+    count_collectives(1, _AXIS)
     n_loc = f_a_shard.shape[0]
     # Per-device bytes the masked local gather moves for this candidate
     # batch (idx rows x one bf16 feature row each).  TRACE-TIME count
@@ -242,10 +251,20 @@ def _sharded_level_fn(cfg: SynthConfig, level: int, has_coarse: bool,
                   raw_b_l, copy_a_l, p_py, p_px, prev_bp, level_key):
         def body(f_a_shard, a_band, band, src_b_l, src_b_c, raw_b_l,
                  copy_a_l, p_py, p_px, prev_bp, level_key):
+            from ..telemetry.metrics import count_expected_collectives
+            from .comms import sharded_a_allreduce_sites
+
             a_band, band = a_band[0], band[0]
             h, w = src_b_l.shape[:2]
             ha, wa = copy_a_l.shape[:2]
             row_lo_flat = band[0] * wa
+            # EXPECTED side of the sentinel's comms ledger, booked at
+            # trace time inside the same traced body that contains the
+            # observed sites — the two series skip together on a jit
+            # cache hit, so observed == expected holds per session.
+            count_expected_collectives(
+                sharded_a_allreduce_sites(cfg, ha, wa), _AXIS
+            )
 
             if has_coarse:
                 py, px = upsample_nnf_planes(p_py, p_px, (h, w), ha, wa)
@@ -516,13 +535,10 @@ def synthesize_sharded_a(
             # then record a timed `level` span — the legacy
             # `level_done` event is the span's emitted view
             # (telemetry/spans.py).
-            nnf_energy = float(dist.mean())
-            tracer.record(
-                "level",
-                round((time.perf_counter() - level_t0) * 1000, 3),
-                level=level,
-                shape=[int(h), int(w)],
-                nnf_energy=nnf_energy,
+            from ..models.analogy import record_level_span
+
+            record_level_span(
+                tracer, cfg, level_t0, level, h, w, float(dist.mean())
             )
         if cfg.save_level_artifacts:
             nnf_save = nnf

@@ -179,12 +179,24 @@ def _banded_lean_step_fn(cfg: SynthConfig, level: int, has_coarse: bool,
              src_b_c_s, flt_c_s, copy_a, py_s, px_s, keys):
         def body(f_a_shard, a_band, band, src_b, flt_b, src_b_c, flt_b_c,
                  copy_a, py, px, key):
+            from ..telemetry.metrics import count_expected_collectives
+            from .comms import sharded_a_allreduce_sites
+
             a_band, band = a_band[0], band[0]
             src_b, flt_b = src_b[0], flt_b[0]
             src_b_c, flt_b_c = src_b_c[0], flt_b_c[0]
             py, px, key = py[0], px[0], key[0]
-            wa = copy_a.shape[1]
+            ha, wa = copy_a.shape[:2]
             row_lo_flat = band[0] * wa
+            # EXPECTED side of the sentinel's comms ledger for this EM
+            # step's bands-axis collectives, booked in the same traced
+            # body as the observed sites (see parallel/sharded_a.py).
+            count_expected_collectives(
+                sharded_a_allreduce_sites(
+                    cfg, ha, wa, per_em=True, polish_iters=polish_iters
+                ),
+                _BANDS_AXIS,
+            )
             (py, px), dist, bp = lean_em_step(
                 cfg, level, has_coarse, polish_iters,
                 src_b, flt_b, src_b_c, flt_b_c,
@@ -587,12 +599,11 @@ def synthesize_spatial(
             # Sync first (nnf_energy readback), then record the timed
             # `level` span whose emitted view is the legacy
             # `level_done` event — which now also carries wall_ms.
-            nnf_energy = float(dist.mean())
-            tracer.record(
-                "level",
-                round((time.perf_counter() - level_t0) * 1000, 3),
-                level=level, shape=[int(h), int(w)],
-                nnf_energy=nnf_energy, spatial_slabs=n_slabs,
+            from ..models.analogy import record_level_span
+
+            record_level_span(
+                tracer, cfg, level_t0, level, h, w, float(dist.mean()),
+                spatial_slabs=n_slabs,
             )
         if cfg.save_level_artifacts:
             nnf_save = nnf

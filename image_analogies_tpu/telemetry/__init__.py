@@ -11,11 +11,14 @@ One coherent layer over what used to be three disconnected fragments
   Prometheus-text exposition (`MetricsRegistry`, `get_registry`);
 - `report`  — merged run reports joining host spans with
   device-trace op totals (`build_report`, the `report` CLI
-  subcommand's engine).
+  subcommand's engine);
+- `sentinel` — end-of-run expected-vs-observed health verdicts
+  (`evaluate_health` -> health.json) joining the live registry
+  against the analytic byte/comms models (round 9).
 
 Every future perf PR reports against this layer: instrument with
 spans + named-scope tags, count with the registry, publish with the
-report.
+report, and ship the sentinel's verdict beside it.
 """
 
 from .metrics import (
@@ -27,6 +30,13 @@ from .metrics import (
     reset_registry,
 )
 from .report import build_report, render_table, write_report
+from .sentinel import (
+    HEALTH_FILE,
+    evaluate_health,
+    health_from_trace_dir,
+    render_health,
+    write_health,
+)
 from .spans import NULL_TRACER, SCHEMA_VERSION, Span, Tracer, as_tracer
 
 __all__ = [
@@ -39,6 +49,11 @@ __all__ = [
     "build_report",
     "render_table",
     "write_report",
+    "HEALTH_FILE",
+    "evaluate_health",
+    "health_from_trace_dir",
+    "render_health",
+    "write_health",
     "NULL_TRACER",
     "SCHEMA_VERSION",
     "Span",

@@ -38,10 +38,82 @@ def _label_key(labels: Optional[Dict[str, str]]) -> _LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus text-exposition label-value escaping (format 0.0.4):
+    backslash, double quote, and line feed — in that order, so the
+    escapes themselves are never re-escaped."""
+    return (
+        v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def unescape_label_value(v: str) -> str:
+    """Inverse of `escape_label_value` — a real unescape pass (left to
+    right, one escape consumed at a time), not chained str.replace,
+    which would corrupt values like `\\\\n` (an escaped backslash
+    followed by a literal n)."""
+    out = []
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            n = v[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(n, c + n))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
 def _label_str(key: _LabelKey) -> str:
     if not key:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+    return "{" + ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in key
+    ) + "}"
+
+
+def parse_label_str(s: str) -> Dict[str, str]:
+    """Parse a `_label_str` rendering back to a label dict — the
+    exposition round-trip the sentinel (telemetry/sentinel.py) relies
+    on to recompute model expectations from a serialized metrics.json,
+    and the hostile-label test's inverse.  Accepts "" and the JSON
+    exposition's "total"/"value" placeholder keys as label-free."""
+    if s in ("", "total", "value"):
+        return {}
+    if not (s.startswith("{") and s.endswith("}")):
+        raise ValueError(f"not a label string: {s!r}")
+    body = s[1:-1]
+    labels: Dict[str, str] = {}
+    i = 0
+    try:
+        while i < len(body):
+            eq = body.index("=", i)
+            name = body[i:eq]
+            if body[eq + 1] != '"':
+                raise ValueError(f"unquoted label value in {s!r}")
+            j = eq + 2
+            raw = []
+            while body[j] != '"':
+                if body[j] == "\\":
+                    raw.append(body[j:j + 2])
+                    j += 2
+                else:
+                    raw.append(body[j])
+                    j += 1
+            labels[name] = unescape_label_value("".join(raw))
+            i = j + 1
+            if i < len(body):
+                if body[i] != ",":
+                    raise ValueError(f"malformed label string: {s!r}")
+                i += 1
+    except IndexError:
+        # An unterminated quote / truncated tail must surface as the
+        # documented ValueError, not a raw IndexError traceback (the
+        # offline sentinel parses hand-editable metrics.json files).
+        raise ValueError(f"truncated label string: {s!r}") from None
+    return labels
 
 
 class Counter:
@@ -225,11 +297,19 @@ class MetricsRegistry:
         }
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4.  Per family (one
+        registry entry = one family): the `# HELP` line (backslash and
+        line-feed escaped, per the format's HELP rules) and exactly ONE
+        `# TYPE` line, followed by every labeled child series — a
+        histogram's `_bucket`/`_sum`/`_count` children all sit under
+        the single family TYPE line."""
         lines: List[str] = []
         for name, m in sorted(self._metrics.items()):
             if m.help:
-                lines.append(f"# HELP {name} {m.help}")
+                help_text = m.help.replace("\\", "\\\\").replace(
+                    "\n", "\\n"
+                )
+                lines.append(f"# HELP {name} {help_text}")
             lines.append(f"# TYPE {name} {m.kind}")
             lines.extend(m.expose())
         return "\n".join(lines) + "\n"
@@ -319,6 +399,87 @@ def count_polish_dma_bytes(useful: float, padded: float) -> None:
     )
     c.inc(useful, labels={"kind": "useful"})
     c.inc(padded, labels={"kind": "padded"})
+
+
+def count_candidate_dma_fetches(
+    n_fetch: int, n_chan: int, thp: int, packed: bool
+) -> None:
+    """Record one traced tile_sweep's candidate-window FETCH COUNT with
+    the geometry that prices a fetch ({chan, thp, packed} labels) —
+    the structural half of the expected-vs-observed DMA assertion.
+
+    The byte counter above (`count_candidate_dma_bytes`) is the
+    OBSERVED series; this counter lets the run sentinel
+    (telemetry/sentinel.py) recompute the EXPECTED series from
+    `kernels.patchmatch_tile.candidate_dma_bytes_per_fetch` at
+    check time, so a call site whose byte arithmetic drifts from the
+    shared model fails the end-of-run health verdict instead of
+    shipping quietly.  TRACE-TIME count, same caveat as the byte
+    counter it prices."""
+    get_registry().counter(
+        "ia_candidate_dma_fetches_total",
+        "candidate-window DMA fetches per traced tile_sweep, labeled "
+        "by the {chan, thp, packed} geometry that prices one fetch "
+        "(trace-time static count; sentinel joins this against "
+        "candidate_dma_bytes_per_fetch)",
+    ).inc(n_fetch, labels={
+        "chan": str(n_chan), "thp": str(thp),
+        "packed": "1" if packed else "0",
+    })
+
+
+def count_polish_dma_rows(
+    n_rows: int, d_useful: int, itemsize: int
+) -> None:
+    """Record one traced polish row-gather's ROW COUNT with the
+    {d_useful, itemsize} labels that price a row fetch — the polish
+    twin of `count_candidate_dma_fetches`: the sentinel recomputes the
+    expected byte series from
+    `kernels.polish_stream.polish_dma_bytes_per_fetch` and holds the
+    observed `ia_polish_dma_bytes_total` series to it.  TRACE-TIME
+    count per call site (the byte counter's scan subtlety applies
+    identically, so the two series stay joinable)."""
+    get_registry().counter(
+        "ia_polish_dma_rows_total",
+        "candidate rows fetched per traced gather_rows call, labeled "
+        "by the {d_useful, itemsize} fetch pricing (trace-time static "
+        "count; sentinel joins this against polish_dma_bytes_per_fetch)",
+    ).inc(n_rows, labels={
+        "d_useful": str(d_useful), "itemsize": str(itemsize),
+    })
+
+
+def count_collectives(n: int, axis: str, kind: str = "all_reduce") -> None:
+    """Bump the OBSERVED collective-site ledger: called at the actual
+    `lax.pmin`/`lax.psum` call sites of the sharded runners
+    (parallel/sharded_a.py `_band_merge`, `_sharded_dist`) with the
+    number of collectives that site traces.
+
+    TRACE-TIME count per call SITE (module docstring's jit caveat):
+    a site inside a `lax.scan` body bumps once per compilation however
+    many times the loop executes — which is exactly the unit
+    `parallel.comms.sharded_a_allreduce_sites` (the expected side of
+    the sentinel's comms assertion) predicts."""
+    get_registry().counter(
+        "ia_collectives_total",
+        "cross-device collective ops traced into compilations, by "
+        "{axis, kind} (trace-time site count; sentinel holds this to "
+        "the parallel/comms.py site model)",
+    ).inc(n, labels={"axis": axis, "kind": kind})
+
+
+def count_expected_collectives(n: int, axis: str) -> None:
+    """Record the comms model's PREDICTION for a traced sharded level
+    or EM step: the runner's traced body calls this once with
+    `parallel.comms.sharded_a_allreduce_sites(...)` so the expectation
+    is booked if-and-only-if the corresponding sites trace (both
+    series skip together when a jit cache hit skips tracing).  The
+    sentinel's comms check is observed == expected, exactly."""
+    get_registry().counter(
+        "ia_collectives_expected_total",
+        "collective sites the parallel/comms.py model predicts for "
+        "the traced sharded compilations, by {axis} (trace-time count)",
+    ).inc(n, labels={"axis": axis})
 
 
 def count_kernel_launch(kernel: str) -> None:

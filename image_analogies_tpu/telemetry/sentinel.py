@@ -1,0 +1,487 @@
+"""Run sentinel — expected-vs-observed health verdicts for every run.
+
+The repo carries three analytic cost models as code — the candidate-DMA
+byte model (`kernels.patchmatch_tile.candidate_dma_bytes_per_fetch`),
+the polish byte model (`kernels.polish_stream.polish_dma_bytes_per_fetch`)
+and the ICI comms model (`parallel/comms.py`) — and a live metrics
+registry every instrumented run fills.  This module JOINS them at the
+end of a run: each check recomputes the model's expectation from the
+structural counters the instrumented sites record (fetch counts with
+their pricing geometry, collective-site ledgers) and holds the observed
+series to it, so a call site whose accounting drifts from the shared
+model — or a refactor that adds a collective without updating
+`parallel/comms.py` — fails a machine-readable verdict instead of
+waiting for a human to reread JSON.
+
+Checks (each -> ok | degraded | violated | skipped):
+
+  candidate_dma_model   ia_candidate_dma_bytes_total{kind} ==
+                        Σ fetches(chan,thp,packed) x
+                          candidate_dma_bytes_per_fetch(...), exactly
+  polish_dma_model      ia_polish_dma_bytes_total{kind} ==
+                        Σ rows(d_useful,itemsize) x
+                          polish_dma_bytes_per_fetch(...), exactly
+  comms_model           ia_collectives_total{axis} ==
+                        ia_collectives_expected_total{axis} (the
+                        parallel/comms.py site model, booked inside
+                        the same traced bodies), exactly per axis
+  energy_series         no NaN/Inf/negative in the per-level NNF
+                        energy series (spans + ia_nnf_energy gauge);
+                        values above the declared ENERGY_MAX envelope
+                        degrade the verdict.  (The dist-ratio envelope
+                        needs an exact-NN oracle and therefore lives
+                        in the TRAJECTORY checker over SCALE artifacts
+                        — tools/check_trajectory.py — not here.)
+  span_tree             every opened span closed; every level span
+                        carries exactly its declared em_iter children
+  telemetry_overhead    the measured ia_telemetry_overhead_frac gauge
+                        (tests/test_sentinel.py publishes it) within
+                        OVERHEAD_BUDGET_FRAC
+  instrument_drift      bench records only: |loop - trace| sweep-time
+                        divergence beyond INSTRUMENT_DRIFT_FRAC is
+                        flagged (VERDICT r5 weak 6, now enforced —
+                        tools/check_bench.py rejects loop-without-trace
+                        outright)
+
+Verdict aggregation: violated > degraded > ok; skipped checks are
+listed but never improve or worsen the verdict.  Every check carries a
+`provenance` field ("measured" | "carried" | "modeled") so a verdict
+computed over carried/projected cells says so — the same provenance
+discipline tools/check_trajectory.py applies to the BENCH/SCALE
+history (a carried cell can never improve a trajectory).
+
+Schema (validated by tools/check_report.py `validate_health`):
+
+    {"schema_version": 1, "kind": "health", "context": str|null,
+     "verdict": "ok"|"degraded"|"violated",
+     "counts": {"ok": n, "degraded": n, "violated": n, "skipped": n},
+     "checks": [{"name": str, "status": str, "provenance": str,
+                 "detail": str, "expected": any, "observed": any}, ...]}
+
+(`expected`/`observed` present on every non-skipped check.)
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Dict, List, Optional
+
+from .metrics import parse_label_str
+
+HEALTH_FILE = "health.json"
+HEALTH_SCHEMA_VERSION = 1
+
+# Declared NNF-energy envelope: the per-level mean match distance is a
+# convergence monitor, not a bounded metric — at the published scales
+# it sits around 4e-4 (SCALE_r*.json nnf_energy_level0), small CPU
+# probes reach O(1e-1).  The envelope is a blow-up guard (a diverging
+# EM loop or a broken metric shows up orders of magnitude out), so it
+# is deliberately loose; NaN/Inf/negative are violations regardless.
+ENERGY_MAX = 10.0
+
+# Loop-vs-trace sweep-time divergence beyond this fraction is
+# instrument drift (VERDICT r5 weak 6: the host-differenced loop
+# figure moved 5.54 -> 7.93 ms under tunnel completion-polling while
+# the trace figure reproduced exactly).
+INSTRUMENT_DRIFT_FRAC = 0.25
+
+# Measured span+metrics overhead budget (tier-1-pinned by
+# tests/test_sentinel.py, which publishes the measured ratio as the
+# ia_telemetry_overhead_frac gauge this sentinel watches).
+OVERHEAD_BUDGET_FRAC = 0.02
+
+_SEVERITY = {"skipped": 0, "ok": 0, "degraded": 1, "violated": 2}
+PROVENANCES = ("measured", "carried", "modeled")
+
+
+def _check(name: str, status: str, expected=None, observed=None,
+           detail: str = "", provenance: str = "measured") -> Dict:
+    rec: Dict[str, Any] = {
+        "name": name, "status": status, "provenance": provenance,
+        "detail": detail,
+    }
+    if status != "skipped":
+        rec["expected"] = expected
+        rec["observed"] = observed
+    return rec
+
+
+def _counter_values(metrics: Optional[dict], name: str) -> Dict:
+    """{frozen label dict -> value} for one metric of a serialized
+    registry (MetricsRegistry.to_dict form) — the exposition round-trip
+    `parse_label_str` exists for."""
+    m = (metrics or {}).get(name)
+    if not isinstance(m, dict):
+        return {}
+    out = {}
+    for label_str, v in (m.get("values") or {}).items():
+        try:
+            out[tuple(sorted(parse_label_str(label_str).items()))] = v
+        except ValueError as e:
+            raise ValueError(
+                f"metric {name!r}: unparseable label key "
+                f"{label_str!r} ({e}) — corrupt metrics exposition"
+            ) from None
+    return out
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+# ---------------------------------------------------------------- checks
+def check_candidate_dma(metrics: Optional[dict]) -> Dict:
+    """Observed candidate-DMA bytes vs the byte model priced over the
+    recorded fetch counts — exact equality (both sides are integral
+    trace-time sums)."""
+    from ..kernels.patchmatch_tile import candidate_dma_bytes_per_fetch
+
+    bytes_v = _counter_values(metrics, "ia_candidate_dma_bytes_total")
+    fetches = _counter_values(metrics, "ia_candidate_dma_fetches_total")
+    if not bytes_v and not fetches:
+        return _check(
+            "candidate_dma_model", "skipped",
+            detail="no candidate-DMA traffic recorded (no tile_sweep "
+            "traced in this session)",
+        )
+    if bytes_v and not fetches:
+        # A byte series with no structural twin is a pre-round-9
+        # artifact (the fetch counter is new): the expectation cannot
+        # be recomputed, which is an information gap, not a drift.
+        # (Current code always books the two together — the live-run
+        # tests pin that — so this arm only fires on old metrics.json.)
+        return _check(
+            "candidate_dma_model", "skipped",
+            detail="byte series present but no fetch counter — "
+            "pre-round-9 trace artifact; expectation unavailable",
+        )
+    exp_useful = exp_moved = 0.0
+    for key, n in fetches.items():
+        lab = dict(key)
+        try:
+            moved, useful = candidate_dma_bytes_per_fetch(
+                int(lab["chan"]), int(lab["thp"]), lab["packed"] == "1"
+            )
+        except (KeyError, ValueError):
+            return _check(
+                "candidate_dma_model", "violated",
+                expected="{chan, thp, packed} fetch labels",
+                observed=lab,
+                detail="fetch counter carries unpriceable labels",
+            )
+        exp_moved += n * moved
+        exp_useful += n * useful
+    obs_useful = bytes_v.get((("kind", "useful"),), 0.0)
+    obs_padded = bytes_v.get((("kind", "padded"),), 0.0)
+    expected = {"useful": exp_useful, "moved": exp_moved}
+    observed = {"useful": obs_useful, "moved": obs_useful + obs_padded}
+    ok = expected == observed
+    return _check(
+        "candidate_dma_model", "ok" if ok else "violated",
+        expected=expected, observed=observed,
+        detail="ia_candidate_dma_bytes_total vs "
+        "candidate_dma_bytes_per_fetch x recorded fetches"
+        + ("" if ok else " — a call site's byte accounting has "
+           "drifted from the shared model"),
+    )
+
+
+def check_polish_dma(metrics: Optional[dict]) -> Dict:
+    """Observed polish row-gather bytes vs the polish byte model priced
+    over the recorded row counts — exact equality."""
+    from ..kernels.polish_stream import polish_dma_bytes_per_fetch
+
+    bytes_v = _counter_values(metrics, "ia_polish_dma_bytes_total")
+    rows = _counter_values(metrics, "ia_polish_dma_rows_total")
+    if not bytes_v and not rows:
+        return _check(
+            "polish_dma_model", "skipped",
+            detail="no polish row-gather traffic recorded (stream-mode "
+            "polish not traced in this session)",
+        )
+    if bytes_v and not rows:
+        # Pre-round-9 artifact (see the candidate-DMA twin).
+        return _check(
+            "polish_dma_model", "skipped",
+            detail="byte series present but no row counter — "
+            "pre-round-9 trace artifact; expectation unavailable",
+        )
+    exp_useful = exp_moved = 0.0
+    for key, n in rows.items():
+        lab = dict(key)
+        try:
+            moved, useful = polish_dma_bytes_per_fetch(
+                int(lab["d_useful"]), int(lab["itemsize"])
+            )
+        except (KeyError, ValueError):
+            return _check(
+                "polish_dma_model", "violated",
+                expected="{d_useful, itemsize} row labels", observed=lab,
+                detail="row counter carries unpriceable labels",
+            )
+        exp_moved += n * moved
+        exp_useful += n * useful
+    obs_useful = bytes_v.get((("kind", "useful"),), 0.0)
+    obs_padded = bytes_v.get((("kind", "padded"),), 0.0)
+    expected = {"useful": exp_useful, "moved": exp_moved}
+    observed = {"useful": obs_useful, "moved": obs_useful + obs_padded}
+    ok = expected == observed
+    return _check(
+        "polish_dma_model", "ok" if ok else "violated",
+        expected=expected, observed=observed,
+        detail="ia_polish_dma_bytes_total vs "
+        "polish_dma_bytes_per_fetch x recorded rows"
+        + ("" if ok else " — gather_rows' byte accounting has drifted "
+           "from the shared model"),
+    )
+
+
+def check_comms(metrics: Optional[dict]) -> Dict:
+    """Observed collective-site ledger vs the parallel/comms.py site
+    model, per mesh axis — exact equality.  Both series are booked at
+    trace time inside the same traced bodies, so they skip together on
+    jit cache hits; any imbalance means a collective was added or
+    removed without the model (or the model without the code)."""
+    obs = _counter_values(metrics, "ia_collectives_total")
+    exp = _counter_values(metrics, "ia_collectives_expected_total")
+    if not obs and not exp:
+        return _check(
+            "comms_model", "skipped",
+            detail="no sharded collectives traced in this session",
+        )
+    obs_by_axis: Dict[str, float] = {}
+    for key, n in obs.items():
+        axis = dict(key).get("axis", "?")
+        obs_by_axis[axis] = obs_by_axis.get(axis, 0.0) + n
+    exp_by_axis = {dict(k).get("axis", "?"): v for k, v in exp.items()}
+    ok = obs_by_axis == exp_by_axis
+    return _check(
+        "comms_model", "ok" if ok else "violated",
+        expected=exp_by_axis, observed=obs_by_axis,
+        detail="ia_collectives_total vs the sharded_a_allreduce_sites "
+        "prediction booked in the traced bodies"
+        + ("" if ok else " — a collective site and parallel/comms.py "
+           "have drifted apart"),
+    )
+
+
+def _walk_spans(spans: List[dict]):
+    for sp in spans or []:
+        yield sp
+        yield from _walk_spans(sp.get("children", []))
+
+
+def check_energy_series(spans: Optional[dict],
+                        metrics: Optional[dict]) -> Dict:
+    """Run-health invariant on the NNF energy series: finite and
+    non-negative everywhere (violated otherwise), within the declared
+    ENERGY_MAX envelope (degraded otherwise)."""
+    energies: List = []
+    for sp in _walk_spans((spans or {}).get("spans", [])):
+        if sp.get("name") == "level":
+            e = (sp.get("attrs") or {}).get("nnf_energy")
+            if e is not None:
+                energies.append(("span", sp.get("attrs", {}).get("level"),
+                                 e))
+    gauge = (metrics or {}).get("ia_nnf_energy") or {}
+    for label_str, v in (gauge.get("values") or {}).items():
+        energies.append(
+            ("gauge", parse_label_str(label_str).get("level"), v)
+        )
+    if not energies:
+        return _check(
+            "energy_series", "skipped",
+            detail="no per-level NNF energies recorded",
+        )
+    bad = [
+        (src, lvl, e) for src, lvl, e in energies
+        if not _is_num(e) or not math.isfinite(e) or e < 0
+    ]
+    over = [
+        (src, lvl, e) for src, lvl, e in energies
+        if _is_num(e) and math.isfinite(e) and e > ENERGY_MAX
+    ]
+    status = "violated" if bad else ("degraded" if over else "ok")
+    return _check(
+        "energy_series", status,
+        expected=f"finite, >= 0, <= {ENERGY_MAX} (declared envelope)",
+        observed={
+            "n_values": len(energies),
+            "non_finite_or_negative": bad,
+            "over_envelope": over,
+        },
+        detail="per-level NNF mean match distance (spans + "
+        "ia_nnf_energy gauge)",
+    )
+
+
+def check_span_tree(spans: Optional[dict]) -> Dict:
+    """Span-tree completeness: every opened (timed) span closed, and
+    every level span carrying exactly its declared em_iter children."""
+    if not spans or not spans.get("spans"):
+        return _check(
+            "span_tree", "skipped", detail="no host span tree recorded"
+        )
+    unclosed, missing_em = [], []
+    for sp in _walk_spans(spans["spans"]):
+        # A timed span serializes with its relative start `t`; one that
+        # never closed has no wall.  Untimed annotations have t: null.
+        if sp.get("t") is not None and sp.get("wall_ms") is None:
+            unclosed.append(sp.get("name"))
+        if sp.get("name") == "level":
+            declared = (sp.get("attrs") or {}).get("em_iters")
+            if declared is not None:
+                got = len([
+                    c for c in sp.get("children", [])
+                    if c.get("name") == "em_iter"
+                ])
+                if got != declared:
+                    missing_em.append({
+                        "level": (sp.get("attrs") or {}).get("level"),
+                        "declared": declared, "recorded": got,
+                    })
+    ok = not unclosed and not missing_em
+    return _check(
+        "span_tree", "ok" if ok else "violated",
+        expected="every opened span closed; em_iter children == "
+        "declared em_iters per level",
+        observed={"unclosed": unclosed, "em_iter_mismatch": missing_em},
+        detail="host span tree structural invariants",
+    )
+
+
+def check_telemetry_overhead(metrics: Optional[dict]) -> Dict:
+    """The measured span+metrics overhead gauge against its budget."""
+    gauge = (metrics or {}).get("ia_telemetry_overhead_frac") or {}
+    values = list((gauge.get("values") or {}).values())
+    if not values:
+        return _check(
+            "telemetry_overhead", "skipped",
+            detail="no ia_telemetry_overhead_frac gauge in this session",
+        )
+    worst = max(values)
+    ok = worst <= OVERHEAD_BUDGET_FRAC
+    return _check(
+        "telemetry_overhead", "ok" if ok else "degraded",
+        expected=f"<= {OVERHEAD_BUDGET_FRAC}", observed=worst,
+        detail="measured tracer-on vs tracer-off wall ratio",
+    )
+
+
+def check_instrument_drift(record: Optional[dict]) -> Dict:
+    """Bench records: the host-differenced loop figure diverging more
+    than INSTRUMENT_DRIFT_FRAC from the trace-derived figure is
+    instrument drift (the loop instrument is diagnostic-only; when it
+    stops tracking the authoritative trace the host clocks are
+    contaminated and every host-timed field deserves suspicion)."""
+    if not record:
+        return _check(
+            "instrument_drift", "skipped", detail="no bench record"
+        )
+    loop = record.get("kernel_sweep_ms_loop")
+    trace = record.get("kernel_sweep_ms_trace")
+    if not (_is_num(loop) and _is_num(trace)) or trace <= 0:
+        return _check(
+            "instrument_drift", "skipped",
+            detail="record carries no comparable loop+trace sweep pair",
+        )
+    drift = abs(loop - trace) / trace
+    ok = drift <= INSTRUMENT_DRIFT_FRAC
+    return _check(
+        "instrument_drift", "ok" if ok else "degraded",
+        expected=f"|loop - trace| / trace <= {INSTRUMENT_DRIFT_FRAC}",
+        observed={"loop_ms": loop, "trace_ms": trace,
+                  "drift_frac": round(drift, 4)},
+        detail="sweep-time instrument agreement (trace authoritative)"
+        + ("" if ok else " — instrument drift: host clocks "
+           "contaminated, distrust host-timed fields in this record"),
+    )
+
+
+# ------------------------------------------------------------ evaluation
+def evaluate_health(
+    spans: Optional[dict] = None,
+    metrics: Optional[dict] = None,
+    bench_record: Optional[dict] = None,
+    context: Optional[str] = None,
+    provenance: str = "measured",
+) -> Dict[str, Any]:
+    """Assemble the health verdict for one run.
+
+    `spans`: a Tracer.to_dict() tree (or host_spans.json contents);
+    `metrics`: a MetricsRegistry.to_dict() exposition (or metrics.json
+    contents); `bench_record`: the bench.py record when the caller is
+    the benchmark; `provenance` stamps every check (a verdict computed
+    over carried/projected cells must say so)."""
+    checks = [
+        check_candidate_dma(metrics),
+        check_polish_dma(metrics),
+        check_comms(metrics),
+        check_energy_series(spans, metrics),
+        check_span_tree(spans),
+        check_telemetry_overhead(metrics),
+    ]
+    if bench_record is not None:
+        checks.append(check_instrument_drift(bench_record))
+    if provenance != "measured":
+        for c in checks:
+            c["provenance"] = provenance
+    worst = max(_SEVERITY[c["status"]] for c in checks)
+    verdict = {0: "ok", 1: "degraded", 2: "violated"}[worst]
+    counts = {s: 0 for s in ("ok", "degraded", "violated", "skipped")}
+    for c in checks:
+        counts[c["status"]] += 1
+    return {
+        "schema_version": HEALTH_SCHEMA_VERSION,
+        "kind": "health",
+        "context": context,
+        "verdict": verdict,
+        "counts": counts,
+        "checks": checks,
+    }
+
+
+def health_from_trace_dir(trace_dir: str) -> Dict[str, Any]:
+    """Offline evaluation over a telemetry directory's artifacts
+    (host_spans.json + metrics.json — the layout telemetry_session
+    writes), for the `ia-synth health` subcommand."""
+    from .report import HOST_SPANS_FILE, METRICS_FILE, _load_json
+
+    spans = _load_json(os.path.join(trace_dir, HOST_SPANS_FILE))
+    metrics = _load_json(os.path.join(trace_dir, METRICS_FILE))
+    if spans is None and metrics is None:
+        raise FileNotFoundError(
+            f"no telemetry artifacts in {trace_dir}: need "
+            f"{HOST_SPANS_FILE} and/or {METRICS_FILE} (run synth/batch "
+            "with --trace-dir)"
+        )
+    return evaluate_health(
+        spans=spans, metrics=metrics, context=f"offline:{trace_dir}"
+    )
+
+
+def write_health(health: Dict[str, Any], path: str) -> None:
+    from ..utils.io import atomic_write_json
+
+    atomic_write_json(path, health)
+
+
+def render_health(health: Dict[str, Any]) -> str:
+    """Human-readable verdict: one line per check, worst first."""
+    lines = [
+        f"health: {health['verdict'].upper()} — "
+        + ", ".join(
+            f"{n} {s}" for s, n in health["counts"].items() if n
+        )
+    ]
+    order = {"violated": 0, "degraded": 1, "ok": 2, "skipped": 3}
+    for c in sorted(health["checks"], key=lambda c: order[c["status"]]):
+        line = f"  [{c['status']:>8}] {c['name']}: {c['detail']}"
+        if c["status"] in ("degraded", "violated"):
+            line += (
+                f" (expected {c.get('expected')!r}, "
+                f"observed {c.get('observed')!r})"
+            )
+        lines.append(line)
+    return "\n".join(lines)

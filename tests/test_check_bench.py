@@ -216,6 +216,53 @@ class TestValidateBench:
         rec["value"] = 0
         assert any("value" in e for e in validate_bench(rec))
 
+    def test_loop_without_trace_rejected(self):
+        """Round-9 enforcement of the instrument ranking (VERDICT r5
+        weak 6 made it diagnostic-only): a record publishing the
+        host-differenced loop figure with no trace-derived figure has
+        no authoritative instrument and is rejected outright."""
+        rec = _tpu_record(
+            {
+                **bench._kernel_util_fields(5.5, 5.5, None, _meta(True)),
+                **bench._polish_fields(_HEADLINE_CFG, 1024),
+            }
+        )
+        assert rec["kernel_sweep_ms_trace"] is None
+        errs = validate_bench(rec)
+        assert any("diagnostic-only" in e for e in errs)
+        # With the trace figure present the same record validates.
+        assert validate_bench(self._valid()) == []
+
+    def test_embedded_health_validated(self):
+        """A round-9 record's embedded run-sentinel verdict is held to
+        the health schema, and a violated verdict fails the record."""
+        from image_analogies_tpu.telemetry.sentinel import (
+            evaluate_health,
+        )
+
+        base = self._valid()
+        base["health"] = evaluate_health(bench_record=base)
+        assert validate_bench(base) == []
+
+        rec = copy.deepcopy(base)
+        rec["health"]["checks"][0].pop("provenance")
+        assert any("provenance" in e for e in validate_bench(rec))
+
+        rec = copy.deepcopy(base)
+        # Forge a violated verdict consistently with its checks.
+        rec["health"]["checks"][0]["status"] = "violated"
+        rec["health"]["verdict"] = "violated"
+        counts = rec["health"]["counts"]
+        counts["violated"] += 1
+        first_status_was = base["health"]["checks"][0]["status"]
+        counts[first_status_was] -= 1
+        rec["health"]["checks"][0].setdefault("expected", None)
+        rec["health"]["checks"][0].setdefault("observed", None)
+        assert any(
+            "fails its own expected-vs-observed" in e
+            for e in validate_bench(rec)
+        )
+
 class TestCheckPolish:
     """tools/check_polish.py wrapper: tier-1 enforces the round-8
     polish artifact's schema — the acceptance criteria (bit-identity

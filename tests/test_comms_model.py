@@ -85,6 +85,62 @@ class TestShardedACount:
             txt.count("all_reduce"), want
         )
 
+    def test_kappa_coherence_collectives_gated_on_polish(self, rng):
+        """Round-9 model fix, pinned against the compiled artifact:
+        the Ashikhmin adoption pass's 8 all-reduces (2 sweeps x 4
+        neighbors) happen ONLY on EM iterations whose polish is
+        engaged — tile_patchmatch_lean returns before the coherence
+        pass when polish_iters is 0, so a mid-EM under
+        pm_polish_final_only contributes none.  The model previously
+        booked 8 per EM; at this probe that error is exactly 8 ops.
+        (pm_polish_iters=1 keeps the runtime count equal to the traced
+        site count, so the HLO text count is exact — see
+        sharded_a_allreduce_sites on the scan subtlety.)"""
+        from image_analogies_tpu.kernels.patchmatch_tile import (
+            band_bounds,
+            prepare_a_planes,
+        )
+        from image_analogies_tpu.models.analogy import (
+            _level_plan,
+            assemble_features_lean,
+        )
+        from image_analogies_tpu.parallel.sharded_a import (
+            _sharded_level_fn,
+        )
+
+        cfg = SynthConfig(
+            levels=1, matcher="patchmatch", pallas_mode="interpret",
+            em_iters=2, pm_iters=1, pm_polish_iters=1,
+            pm_polish_random=1, kappa=5.0,
+        )
+        h = w = 128
+        ha = wa = 136
+        mesh = make_mesh(axis_names=("bands",))
+        n_dev = mesh.devices.size
+        token = _mesh_token(mesh)
+        src_b, _ = _imgs(rng, h, w)
+        src_a, flt_a = _imgs(rng, ha, wa)
+        f_a_tab = assemble_features_lean(src_a, flt_a, cfg, None, None)
+        specs, _use_coarse, _n = _level_plan(
+            cfg, src_a, flt_a, False, h, w
+        )
+        bands = prepare_a_planes(
+            src_a, flt_a, None, None, specs, n_bands=n_dev
+        )
+        run = _sharded_level_fn(cfg, 0, False, token, True)
+        txt = run.lower(
+            f_a_tab, jnp.stack(bands), jnp.stack(band_bounds(ha, n_dev)),
+            src_b, src_b, src_b, flt_a, jnp.zeros((8, 8), jnp.int32),
+            jnp.zeros((8, 8), jnp.int32), src_b, jax.random.PRNGKey(0),
+        ).as_text()
+        want = sharded_a_allreduce_count(cfg, ha, wa)
+        # em0 (mid: polish 0, so NO coherence pass either): 4+2.
+        # em1 (final): 4+2 + polish (1 + 1*(8+1)) + coherence 2*4.
+        assert want == (4 + 2) + (4 + 2 + 10 + 8)
+        assert txt.count("all_reduce") == want, (
+            txt.count("all_reduce"), want
+        )
+
     def test_band_merge_bytes_model(self):
         cfg = SynthConfig()
         m = sharded_a_band_merge_bytes(cfg, 128, 128)

@@ -90,6 +90,74 @@ class TestMetricsRegistry:
         assert d["c"] == {"kind": "counter", "help": "ch",
                           "values": {"total": 1.0}}
 
+    def test_hostile_label_value_round_trips(self):
+        """Round-9 exposition hardening: a label value carrying every
+        character the format escapes (backslash, double quote, line
+        feed — including the adversarial `\\n` sequence that a naive
+        chained-replace unescape corrupts) must render per the
+        exposition rules and parse back to the exact original."""
+        from image_analogies_tpu.telemetry.metrics import (
+            escape_label_value,
+            parse_label_str,
+            unescape_label_value,
+        )
+
+        hostile = 'pa\\th "quoted"\nline2\\n-literal'
+        reg = MetricsRegistry()
+        reg.counter("req_total", "requests").inc(
+            2, labels={"path": hostile, "code": "200"}
+        )
+        text = reg.to_prometheus()
+        # Rendered form: escapes applied, exactly one line feed (the
+        # line separator itself) — the raw newline never leaks into
+        # the exposition body.
+        line = [ln for ln in text.splitlines() if ln.startswith(
+            "req_total{"
+        )][0]
+        assert "\n" not in line
+        assert '\\n' in line and '\\"' in line and "\\\\" in line
+        # Round trip through the registry's own serialized form.
+        label_str = next(iter(reg.to_dict()["req_total"]["values"]))
+        assert parse_label_str(label_str) == {
+            "path": hostile, "code": "200"
+        }
+        # And through the pure escape pair.
+        assert unescape_label_value(escape_label_value(hostile)) == (
+            hostile
+        )
+
+    def test_type_line_exactly_once_per_family(self):
+        """`# TYPE` must appear exactly once per metric family even
+        when the family fans out into labeled children (counter label
+        sets, histogram _bucket/_sum/_count series)."""
+        reg = MetricsRegistry()
+        c = reg.counter("req_total", "requests")
+        for code in ("200", "404", "500"):
+            c.inc(labels={"code": code})
+        h = reg.histogram("lat_ms", "latency", buckets=(1.0, 10.0))
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v, labels={"route": "a"})
+            h.observe(v, labels={"route": "b"})
+        text = reg.to_prometheus()
+        assert text.count("# TYPE req_total counter") == 1
+        assert text.count("# TYPE lat_ms histogram") == 1
+        # No stray TYPE lines for the histogram's child series.
+        assert "# TYPE lat_ms_bucket" not in text
+        assert text.count("# TYPE") == 2
+        # All six bucket series are present under the one family.
+        assert text.count("lat_ms_bucket{") == 6
+
+    def test_help_line_escapes_newlines(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total", "line1\nline2 \\ backslash").inc()
+        text = reg.to_prometheus()
+        (help_line,) = [
+            ln for ln in text.splitlines() if ln.startswith("# HELP")
+        ]
+        assert help_line == (
+            "# HELP c_total line1\\nline2 \\\\ backslash"
+        )
+
     def test_candidate_dma_byte_counters_from_tile_sweep(self, rng):
         """Round-6 observability satellite: a traced tile_sweep must
         record its candidate-DMA bytes split useful vs padded, with

@@ -1,0 +1,316 @@
+"""tools/check_trajectory.py pytest wrapper (round 9, ISSUE 4
+satellite): tier-1 fails if any committed BENCH_r*/SCALE_r* artifact
+violates its own (round-aware) schema or the declared trajectory
+tolerances — plus synthetic-history cases pinning the regression rule
+and the measured-vs-carried provenance discipline (a carried cell can
+never improve a trajectory)."""
+
+import copy
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from check_trajectory import (  # noqa: E402 (tools/ import)
+    cell_provenance,
+    check_trajectory,
+    main as trajectory_main,
+)
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..")
+)
+
+
+def _bench(value, psnr=35.7, **extra):
+    """A minimal round-3-era bench record around one headline cell."""
+    return {
+        "metric": "1024x1024 B' synth wall-clock (5-level pyr, 5x5 patch)",
+        "value": value, "unit": "s", "device": "tpu",
+        "psnr_vs_cpu_ref_db": psnr,
+        "acceptance_configs": [
+            {"config": "3:super-resolution-1024", "wall_s": value},
+        ],
+        **extra,
+    }
+
+
+def _write_history(root, records):
+    for name, data in records.items():
+        with open(os.path.join(root, name), "w") as f:
+            json.dump(data, f)
+
+
+class TestCommittedHistory:
+    def test_committed_artifacts_hold_the_trajectory(self):
+        """THE acceptance criterion: every committed BENCH_r*.json /
+        SCALE_r*.json passes its schema and the declared per-series
+        tolerances."""
+        errs, report = check_trajectory(_REPO_ROOT)
+        assert errs == []
+        # The tracked series actually engaged (not a vacuous pass).
+        series = {r["series"] for r in report if r.get("summary")}
+        assert "bench.value" in series
+        assert "scale.4096.wall_s" in series
+        assert "scale.1024.dist_ratio_vs_exact" in series
+
+    def test_cli_all_exits_zero_on_committed_history(self, tmp_path):
+        out = str(tmp_path / "trajectory.json")
+        assert trajectory_main(
+            ["--all", "--root", _REPO_ROOT, "--json", out]
+        ) == 0
+        with open(out) as f:
+            dump = json.load(f)
+        assert dump["violations"] == []
+        assert any(r.get("summary") for r in dump["report"])
+
+
+class TestRegressionRule:
+    def test_wall_regression_beyond_tolerance_fails(self, tmp_path):
+        _write_history(str(tmp_path), {
+            "BENCH_r03.json": _bench(0.80),
+            "BENCH_r04.json": _bench(0.58),
+            # 2x the best prior measured wall — the silent regression
+            # this tool exists to catch.
+            "BENCH_r05.json": _bench(1.16),
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert any(
+            "bench.value" in e and "regresses" in e for e in errs
+        )
+        assert trajectory_main(["--all", "--root", str(tmp_path)]) == 1
+
+    def test_regression_within_tolerance_passes(self, tmp_path):
+        _write_history(str(tmp_path), {
+            "BENCH_r03.json": _bench(0.80),
+            "BENCH_r04.json": _bench(0.58),
+            "BENCH_r05.json": _bench(0.62),  # +6.9% over best: inside 15%
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert errs == []
+
+    def test_psnr_floor_is_absolute(self, tmp_path):
+        _write_history(str(tmp_path), {
+            "BENCH_r03.json": _bench(0.80, psnr=34.9),  # below 35 dB gate
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert any("floor" in e for e in errs)
+
+    def test_pre_since_rounds_are_out_of_scope(self, tmp_path):
+        """Rounds before a series' declared `since` (the r1/r2
+        measurement era) are schema-checked but not trajectory-
+        compared — r1's dispatch-time 0.08 s must not become the bar
+        r3's corrected measurement is judged against."""
+        _write_history(str(tmp_path), {
+            "BENCH_r01.json": {
+                "metric": "m", "value": 0.0837, "unit": "s",
+                "device": "tpu", "psnr_vs_cpu_ref_db": 40.9,
+            },
+            "BENCH_r03.json": _bench(0.80),
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert errs == []
+
+
+class TestProvenanceDiscipline:
+    def test_carried_cell_never_improves_the_trajectory(self, tmp_path):
+        """A carried (or modeled) cell must not set the bar: after a
+        carried 'improvement' to 0.40 s, a measured 0.60 s is judged
+        against the measured best (0.58) — and passes; were the
+        carried cell allowed to improve the trajectory, 0.60 would be
+        a 50% regression."""
+        _write_history(str(tmp_path), {
+            "BENCH_r04.json": _bench(0.58),
+            "BENCH_r05.json": _bench(
+                0.40, provenance="carried"
+            ),
+            "BENCH_r06.json": _bench(0.60),
+        })
+        errs, report = check_trajectory(str(tmp_path))
+        assert errs == []
+        summary = next(
+            r for r in report
+            if r.get("summary") and r["series"] == "bench.value"
+        )
+        assert summary["best"] == 0.58
+        assert summary["inert_cells"] == 1
+
+    def test_carried_cell_not_flagged_as_regression(self, tmp_path):
+        """Echoing an old number as carried is inert in both
+        directions — it neither improves nor regresses."""
+        _write_history(str(tmp_path), {
+            "BENCH_r04.json": _bench(0.58),
+            "BENCH_r05.json": _bench(5.00, provenance="carried"),
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert errs == []
+
+    def test_per_cell_provenance_wins_over_row(self, tmp_path):
+        rec = _bench(0.58)
+        rec["cell_provenance"] = {"value": "modeled"}
+        _write_history(str(tmp_path), {"BENCH_r07.json": rec})
+        errs, report = check_trajectory(str(tmp_path))
+        assert errs == []
+        cell = next(
+            r for r in report
+            if not r.get("summary") and r["series"] == "bench.value"
+        )
+        assert cell["provenance"] == "modeled"
+        assert cell["status"] == "inert"
+
+    def test_unknown_provenance_rejected(self, tmp_path):
+        _write_history(str(tmp_path), {
+            "BENCH_r04.json": _bench(0.58, provenance="vibes"),
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert any("provenance" in e for e in errs)
+
+    def test_cell_provenance_helper(self):
+        row = {"provenance": "carried",
+               "cell_provenance": {"wall_s": "measured"}}
+        assert cell_provenance(row, "wall_s") == "measured"
+        assert cell_provenance(row, "psnr_db") == "carried"
+        assert cell_provenance({}, "anything") == "measured"
+
+
+class TestSchemaChecks:
+    def _scale(self, rows):
+        return {"comment": "synthetic history for the schema tests",
+                "rows": rows}
+
+    def test_dist_ratio_below_one_is_a_broken_probe(self, tmp_path):
+        _write_history(str(tmp_path), {
+            "SCALE_r04.json": self._scale([
+                {"size": 1024, "wall_s": 1.0,
+                 "dist_ratio_vs_exact": 0.97},
+            ]),
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert any("exact oracle" in e for e in errs)
+
+    def test_dist_ratio_envelope_ceiling(self, tmp_path):
+        _write_history(str(tmp_path), {
+            "SCALE_r04.json": self._scale([
+                {"size": 4096, "wall_s": 10.0,
+                 "dist_ratio_vs_exact": 1.95},
+            ]),
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert any("ceiling" in e for e in errs)
+
+    def test_rows_must_be_size_sorted(self, tmp_path):
+        _write_history(str(tmp_path), {
+            "SCALE_r04.json": self._scale([
+                {"size": 2048, "wall_s": 2.0},
+                {"size": 1024, "wall_s": 1.0},
+            ]),
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert any("increasing" in e for e in errs)
+
+    def test_roofline_bound_enforced_every_era(self, tmp_path):
+        _write_history(str(tmp_path), {
+            "BENCH_r04.json": _bench(
+                0.58, kernel_hbm_roofline_frac=1.159
+            ),
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert any("impossible" in e for e in errs)
+
+    def test_round3_record_needs_acceptance_table(self, tmp_path):
+        rec = _bench(0.80)
+        del rec["acceptance_configs"]
+        _write_history(str(tmp_path), {"BENCH_r03.json": rec})
+        errs, _ = check_trajectory(str(tmp_path))
+        assert any("acceptance_configs" in e for e in errs)
+
+    def test_round9_record_held_to_full_validator(self, tmp_path):
+        """From round 9 on, a BENCH record must pass the CURRENT
+        tools/check_bench.py contract — including the embedded
+        run-sentinel health verdict bench.py now ships."""
+        rec = _bench(0.55)  # r3-era shape: no kernel section, no health
+        _write_history(str(tmp_path), {"BENCH_r09.json": rec})
+        errs, _ = check_trajectory(str(tmp_path))
+        assert any("health" in e for e in errs)
+        assert any("kernel" in e for e in errs)
+
+    def test_non_object_artifact_is_a_violation_not_a_crash(
+        self, tmp_path
+    ):
+        """A truncated/hand-edited artifact whose top level is valid
+        JSON but not an object must read as a schema violation (exit
+        1), never a traceback."""
+        _write_history(str(tmp_path), {
+            "BENCH_r05.json": ["not", "an", "object"],
+            "SCALE_r05.json": ["also", "not"],
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert any(
+            "BENCH_r05.json" in e and "object" in e for e in errs
+        )
+        assert any(
+            "SCALE_r05.json" in e and "object" in e for e in errs
+        )
+        assert trajectory_main(["--all", "--root", str(tmp_path)]) == 1
+
+    def test_wrapper_shape_unwrapped(self, tmp_path):
+        """The driver's capture wrapper ({n, cmd, rc, tail, parsed})
+        reads as its parsed record."""
+        _write_history(str(tmp_path), {
+            "BENCH_r03.json": {
+                "n": 3, "cmd": "python bench.py", "rc": 0, "tail": "",
+                "parsed": _bench(0.80),
+            },
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert errs == []
+
+    def test_builder_probe_files_out_of_scope(self, tmp_path):
+        """BENCH_r*_builder*.json are CPU field-builder probes, not
+        round records — they must not pollute the trajectory."""
+        _write_history(str(tmp_path), {
+            "BENCH_r04.json": _bench(0.58),
+            "BENCH_r04_builder.json": {"garbage": True},
+        })
+        errs, report = check_trajectory(str(tmp_path))
+        assert errs == []
+        assert all(
+            r.get("artifact") != "BENCH_r04_builder.json"
+            for r in report
+        )
+
+
+class TestScaleTrajectory:
+    def test_scale_wall_regression_fails(self, tmp_path):
+        rows4 = [{"size": 4096, "wall_s": 10.7,
+                  "dist_ratio_vs_exact": 1.69,
+                  "psnr_vs_full_oracle_db": 36.5}]
+        rows5 = copy.deepcopy(rows4)
+        rows5[0]["wall_s"] = 21.5  # 2x
+        _write_history(str(tmp_path), {
+            "SCALE_r04.json": {"comment": "c", "rows": rows4},
+            "SCALE_r05.json": {"comment": "c", "rows": rows5},
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert any(
+            "scale.4096.wall_s" in e and "regresses" in e for e in errs
+        )
+
+    def test_quality_and_wall_tracked_independently(self, tmp_path):
+        """A PSNR drop past tolerance fails even when the wall
+        improves — the trajectory is multi-series by design."""
+        rows4 = [{"size": 2048, "wall_s": 2.7,
+                  "dist_ratio_vs_exact": 1.60,
+                  "psnr_vs_full_oracle_db": 36.4}]
+        rows5 = [{"size": 2048, "wall_s": 2.0,
+                  "dist_ratio_vs_exact": 1.60,
+                  "psnr_vs_full_oracle_db": 35.6}]  # -0.8 dB
+        _write_history(str(tmp_path), {
+            "SCALE_r04.json": {"comment": "c", "rows": rows4},
+            "SCALE_r05.json": {"comment": "c", "rows": rows5},
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert any(
+            "scale.2048.psnr_vs_full_oracle_db" in e for e in errs
+        )

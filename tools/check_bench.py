@@ -142,6 +142,34 @@ def validate_bench(record: dict) -> List[str]:
             errs.append(
                 f"kernel_candidate_dma_efficiency {eff!r} not in (0, 1]"
             )
+    # Instrument ranking, ENFORCED (round 9; VERDICT r5 weak 6 made it
+    # diagnostic-only): the host-differenced loop figure may only be
+    # published next to the trace-derived one.  A loop-without-trace
+    # record has no authoritative instrument to rank against — re-run
+    # on a trace-forwarding backend instead of shipping host clocks
+    # alone.  (health.json additionally flags loop/trace divergence
+    # > 25% as instrument drift — telemetry/sentinel.py.)
+    if _num(record.get("kernel_sweep_ms_loop")) and not _num(
+        record.get("kernel_sweep_ms_trace")
+    ):
+        errs.append(
+            "kernel_sweep_ms_loop published without the trace-derived "
+            "figure (kernel_sweep_ms_trace) — the loop instrument is "
+            "diagnostic-only and cannot stand alone"
+        )
+    health = record.get("health")
+    if health is not None:
+        # Round-9 records embed their run-sentinel verdict; hold it to
+        # the health schema (same rules the standalone health.json
+        # gets) and refuse a record that ships a violated verdict.
+        from check_report import validate_health
+
+        errs.extend(f"health: {e}" for e in validate_health(health))
+        if health.get("verdict") == "violated":
+            errs.append(
+                "health.verdict is 'violated' — the record fails its "
+                "own expected-vs-observed assertions"
+            )
     mode = record.get("polish_mode")
     if mode is not None and mode not in _POLISH_MODES:
         errs.append(

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Validate a telemetry `report.json` (telemetry/report.py schema).
+"""Validate a telemetry `report.json` (telemetry/report.py schema) or a
+run-sentinel `health.json` (telemetry/sentinel.py schema).
 
 Fast, dependency-free smoke check for traced runs: exits nonzero when
 the report is structurally broken or missing phases — an unknown
@@ -9,12 +10,19 @@ phase.  `device_busy_ms` may be null (a CPU/tunnelled backend forwards
 no accelerator planes) but the KEY must exist: the report's contract
 is to state what it measured, never to omit the question.
 
+A record with `"kind": "health"` dispatches to `validate_health`
+(round 9): the verdict must be consistent with its checks, every
+non-skipped check must state both `expected` and `observed`, and every
+check must carry the measured-vs-carried/modeled provenance field —
+a verdict computed over carried cells has to say so.
+
 Usage:
     python tools/check_report.py path/to/report.json
+    python tools/check_report.py path/to/health.json   # auto-detected
     python tools/check_report.py --no-prologue report.json  # resumed
         runs skip the prologue span; relax that requirement only
 
-Runs under pytest too (tests/test_telemetry.py wraps `validate_report`)
+Runs under pytest too (tests/test_telemetry.py wraps both validators)
 so tier-1 exercises the same rules the CLI tool enforces.
 """
 
@@ -26,9 +34,90 @@ import sys
 from typing import List
 
 SCHEMA_VERSION = 1
+HEALTH_SCHEMA_VERSION = 1
 
 _LEVEL_REQUIRED = ("level", "shape", "wall_ms", "nnf_energy",
                    "device_busy_ms")
+
+_HEALTH_STATUSES = ("ok", "degraded", "violated", "skipped")
+_HEALTH_VERDICTS = ("ok", "degraded", "violated")
+_HEALTH_PROVENANCES = ("measured", "carried", "modeled")
+# violated > degraded > ok; skipped never moves the verdict.
+_SEVERITY = {"skipped": 0, "ok": 0, "degraded": 1, "violated": 2}
+
+
+def validate_health(health: dict) -> List[str]:
+    """Violations in a telemetry/sentinel.py health.json (empty list =
+    valid)."""
+    errs: List[str] = []
+    if not isinstance(health, dict):
+        return ["health record is not a JSON object"]
+    if health.get("schema_version") != HEALTH_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {health.get('schema_version')!r} != "
+            f"{HEALTH_SCHEMA_VERSION}"
+        )
+    if health.get("kind") != "health":
+        errs.append(f"kind {health.get('kind')!r} != 'health'")
+    verdict = health.get("verdict")
+    if verdict not in _HEALTH_VERDICTS:
+        errs.append(f"verdict {verdict!r} names none of {_HEALTH_VERDICTS}")
+
+    checks = health.get("checks")
+    if not isinstance(checks, list) or not checks:
+        errs.append("checks: missing or empty")
+        checks = []
+    worst = 0
+    for i, c in enumerate(checks):
+        if not isinstance(c, dict) or not isinstance(c.get("name"), str):
+            errs.append(f"checks[{i}]: not a named check object")
+            continue
+        status = c.get("status")
+        if status not in _HEALTH_STATUSES:
+            errs.append(
+                f"checks[{i}] ({c['name']}): status {status!r} names "
+                f"none of {_HEALTH_STATUSES}"
+            )
+            continue
+        worst = max(worst, _SEVERITY[status])
+        # The measured-vs-carried/modeled provenance field: a verdict
+        # over carried or projected cells must say so on every check.
+        if c.get("provenance") not in _HEALTH_PROVENANCES:
+            errs.append(
+                f"checks[{i}] ({c['name']}): provenance "
+                f"{c.get('provenance')!r} names none of "
+                f"{_HEALTH_PROVENANCES}"
+            )
+        if status != "skipped":
+            for key in ("expected", "observed"):
+                if key not in c:
+                    errs.append(
+                        f"checks[{i}] ({c['name']}): non-skipped check "
+                        f"missing key {key!r}"
+                    )
+        if not isinstance(c.get("detail"), str):
+            errs.append(
+                f"checks[{i}] ({c['name']}): detail is not a string"
+            )
+    if checks and verdict in _HEALTH_VERDICTS:
+        want = {0: "ok", 1: "degraded", 2: "violated"}[worst]
+        if verdict != want:
+            errs.append(
+                f"verdict {verdict!r} inconsistent with its checks "
+                f"(worst status implies {want!r})"
+            )
+    counts = health.get("counts")
+    if not isinstance(counts, dict):
+        errs.append("counts: missing section")
+    elif checks:
+        for s in _HEALTH_STATUSES:
+            n = len([c for c in checks
+                     if isinstance(c, dict) and c.get("status") == s])
+            if counts.get(s) != n:
+                errs.append(
+                    f"counts[{s!r}] {counts.get(s)!r} != {n} checks"
+                )
+    return errs
 
 
 def validate_report(report: dict, require_prologue: bool = True
@@ -126,6 +215,32 @@ def main(argv=None) -> int:
         print(f"check_report: cannot read {args.report}: {e}",
               file=sys.stderr)
         return 2
+    if isinstance(report, dict) and report.get("kind") == "health":
+        errs = validate_health(report)
+        if errs:
+            for e in errs:
+                print(f"check_report: {e}", file=sys.stderr)
+            print(
+                f"check_report: FAIL — {len(errs)} violation(s) in "
+                f"{args.report}", file=sys.stderr,
+            )
+            return 1
+        if report.get("verdict") == "violated":
+            # Schema-valid, but the run failed its own assertions —
+            # a gate built on this tool must agree with `ia-synth
+            # health` and check_bench, which both refuse the verdict.
+            print(
+                f"check_report: FAIL — {args.report} is well-formed "
+                "but its verdict is 'violated' (the run failed its "
+                "expected-vs-observed checks)", file=sys.stderr,
+            )
+            return 1
+        print(
+            f"check_report: OK — health verdict "
+            f"{report.get('verdict')!r}, "
+            f"{len(report.get('checks', []))} check(s)"
+        )
+        return 0
     errs = validate_report(report, require_prologue=not args.no_prologue)
     if errs:
         for e in errs:

@@ -1,0 +1,479 @@
+#!/usr/bin/env python
+"""Bench-trajectory regression detector over the committed
+`BENCH_r<NN>.json` / `SCALE_r<NN>.json` history (ISSUE 4 tentpole,
+part b).
+
+Eight rounds of benchmark artifacts encode the project's performance
+trajectory, but nothing machine-checked it: a silent 2x regression in
+sweep bytes, wall, or quality between rounds would only be caught by a
+human rereading JSON.  This tool declares the tracked series WITH
+their tolerances (the table ARCHITECTURE.md quotes) and fails loudly
+when a later round's MEASURED cell is worse than the best prior
+measured cell beyond its tolerance, or breaks an absolute floor or
+ceiling.
+
+Provenance discipline: a cell may be marked carried or modeled —
+either a row/record-level `"provenance": "carried"|"modeled"` or a
+per-field `"cell_provenance": {"<field>": "carried"}` (absent means
+measured, which is true of every artifact committed before round 9).
+Carried/modeled cells are schema-validated and reported but NEVER
+enter the regression comparison and NEVER become the trajectory's
+best: a carried cell can not "improve" a trajectory, and a projection
+can not set the bar a later measurement is judged against.
+
+Series declarations carry a `since` round: series whose measurement
+methodology stabilized later (the round-3 timing revision, the round-4
+HBM-streaming traffic model) start there, so the checker holds history
+to the rules each era actually obeyed.  Moving a `since` forward is an
+explicit, reviewable act — exactly the loud failure this tool exists
+to force when a model legitimately changes.
+
+Schema checks are round-aware too: every BENCH record answers the
+headline questions; round >= 3 records need their acceptance table;
+roofline fractions are held to [0, 1] whenever present; round >= 9
+records must pass the FULL current validator (tools/check_bench.py),
+including the embedded run-sentinel health verdict bench.py now ships.
+
+Usage:
+    python tools/check_trajectory.py --all           # repo history
+    python tools/check_trajectory.py --all --root DIR
+    python tools/check_trajectory.py --json OUT.json --all
+
+Exit codes: 0 trajectory holds, 1 violation(s), 2 unreadable input.
+Runs under pytest (tests/test_trajectory.py) so tier-1 fails if any
+committed artifact violates its own schema or the declared tolerances.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+_SCALE_RE = re.compile(r"^SCALE_r(\d+)\.json$")
+
+PROVENANCES = ("measured", "carried", "modeled")
+
+# ---------------------------------------------------------- declarations
+# direction: "lower"/"higher" is better.  rel_tol/abs_tol: a later
+# measured cell may be worse than the best prior measured cell by at
+# most this much (either bound passing suffices when both are given).
+# floor/ceiling: absolute bounds on every measured cell.  since: first
+# round the series' methodology holds (see module docstring).
+BENCH_SERIES: Tuple[Dict, ...] = (
+    {"field": "value", "direction": "lower", "rel_tol": 0.15,
+     "since": 3, "label": "1024^2 headline wall (s)"},
+    {"field": "value_default_schedule_s", "direction": "lower",
+     "rel_tol": 0.10, "since": 3,
+     "label": "default-schedule wall (s)"},
+    {"field": "psnr_vs_cpu_ref_db", "direction": "higher",
+     "abs_tol": 0.30, "floor": 35.0, "since": 3,
+     "label": "min-seed PSNR vs exact oracle (dB)"},
+    {"field": "kernel_sweep_ms", "direction": "lower", "rel_tol": 0.25,
+     "since": 3, "label": "tile_sweep steady-state (ms)"},
+    {"field": "kernel_bytes_per_sweep", "direction": "lower",
+     "rel_tol": 0.02, "since": 4,
+     "label": "modeled sweep traffic (B; r4 streaming model)"},
+    {"field": "kernel_hbm_roofline_frac", "direction": "higher",
+     "rel_tol": 0.20, "since": 4, "label": "HBM roofline fraction"},
+    {"field": "instrumented_wall_s", "direction": "lower",
+     "rel_tol": 0.20, "since": 4,
+     "label": "instrumented-run wall (s; telemetry overhead proxy)"},
+    {"field": "kernel_candidate_dma_efficiency", "direction": "higher",
+     "abs_tol": 0.05, "since": 7,
+     "label": "candidate-DMA useful/moved fraction"},
+    {"field": "kernel_polish_dma_efficiency", "direction": "higher",
+     "abs_tol": 0.05, "since": 8,
+     "label": "polish-DMA useful/moved fraction"},
+    {"field": "kernel_bytes_per_polish", "direction": "lower",
+     "rel_tol": 0.02, "since": 8, "label": "modeled polish traffic (B)"},
+)
+
+# SCALE rows are keyed by size; each series is tracked per size.
+SCALE_SERIES: Tuple[Dict, ...] = (
+    {"field": "wall_s", "direction": "lower", "rel_tol": 0.10,
+     "since": 3, "label": "scale wall (s)"},
+    {"field": "dist_ratio_vs_exact", "direction": "lower",
+     "rel_tol": 0.05, "ceiling": 1.80, "since": 4,
+     "label": "dist ratio vs exact NN (declared envelope <= 1.80; "
+              "r4 accepted the streaming-kernel trade)"},
+    {"field": "psnr_vs_full_oracle_db", "direction": "higher",
+     "abs_tol": 0.30, "floor": 35.0, "since": 4,
+     "label": "PSNR vs full-synthesis oracle (dB)"},
+)
+
+
+def _num(v) -> bool:
+    return (
+        isinstance(v, (int, float)) and not isinstance(v, bool)
+        and math.isfinite(v)
+    )
+
+
+def cell_provenance(container: dict, field: str) -> str:
+    """measured | carried | modeled for one cell: the per-field
+    `cell_provenance` map wins, then the row/record-level `provenance`
+    key; absent means measured (true of all pre-round-9 artifacts)."""
+    per_cell = container.get("cell_provenance")
+    if isinstance(per_cell, dict) and field in per_cell:
+        return per_cell[field]
+    return container.get("provenance", "measured")
+
+
+# -------------------------------------------------------------- loading
+def load_history(root: str):
+    """(bench, scale) lists of (round, filename, payload), round-sorted.
+    BENCH payloads unwrap the driver's capture wrapper to the parsed
+    record.  Builder probe files (BENCH_r*_builder*.json) do not match
+    the round pattern and are deliberately out of scope — they are
+    CPU-built field-builder exercises, not round records."""
+    bench, scale = [], []
+    for name in sorted(os.listdir(root)):
+        m = _BENCH_RE.match(name)
+        if m:
+            with open(os.path.join(root, name)) as f:
+                data = json.load(f)
+            # A non-object top level (truncated/hand-edited artifact)
+            # must surface as a schema violation downstream, not an
+            # AttributeError here.
+            rec = data
+            if isinstance(data, dict) and isinstance(
+                data.get("parsed"), dict
+            ):
+                rec = data["parsed"]
+            bench.append((int(m.group(1)), name, rec))
+        m = _SCALE_RE.match(name)
+        if m:
+            with open(os.path.join(root, name)) as f:
+                scale.append((int(m.group(1)), name, json.load(f)))
+    bench.sort(key=lambda t: t[0])
+    scale.sort(key=lambda t: t[0])
+    return bench, scale
+
+
+# ------------------------------------------------------ schema (by era)
+def validate_bench_record(rnd: int, name: str, rec: dict) -> List[str]:
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"{name}: record is not a JSON object"]
+    if rnd >= 9:
+        # Current era: the full tools/check_bench.py contract,
+        # including the enforced instrument ranking and the embedded
+        # health verdict every bench.py record now ships.
+        tools_dir = os.path.dirname(os.path.abspath(__file__))
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        from check_bench import validate_bench
+
+        errs.extend(f"{name}: {e}" for e in validate_bench(rec))
+        if "health" not in rec:
+            errs.append(
+                f"{name}: round-{rnd} record missing its embedded "
+                "run-sentinel 'health' verdict"
+            )
+        return errs
+    # Headline questions, every era.
+    if not isinstance(rec.get("metric"), str):
+        errs.append(f"{name}: metric missing or not a string")
+    if not (_num(rec.get("value")) and rec.get("value", 0) > 0):
+        errs.append(f"{name}: value {rec.get('value')!r} not positive")
+    if rec.get("unit") != "s":
+        errs.append(f"{name}: unit {rec.get('unit')!r} != 's'")
+    if rec.get("device") not in ("tpu", "cpu-fallback"):
+        errs.append(f"{name}: device {rec.get('device')!r} unknown")
+    if not _num(rec.get("psnr_vs_cpu_ref_db")):
+        errs.append(f"{name}: psnr_vs_cpu_ref_db missing")
+    if rnd >= 3:
+        configs = rec.get("acceptance_configs")
+        if not isinstance(configs, list) or not configs:
+            errs.append(f"{name}: acceptance_configs missing or empty")
+        else:
+            for i, row in enumerate(configs):
+                if not isinstance(row, dict) or not (
+                    _num(row.get("wall_s")) and row["wall_s"] > 0
+                ):
+                    errs.append(
+                        f"{name}: acceptance_configs[{i}] lacks a "
+                        "positive wall_s"
+                    )
+    for key in ("kernel_hbm_roofline_frac", "kernel_vpu_roofline_frac",
+                "kernel_mxu_roofline_frac"):
+        frac = rec.get(key)
+        if frac is not None and (
+            not _num(frac) or frac < 0 or frac > 1.0
+        ):
+            errs.append(
+                f"{name}: {key}={frac!r} outside [0, 1] — impossible"
+            )
+    return errs
+
+
+def validate_scale_artifact(rnd: int, name: str, data: dict) -> List[str]:
+    errs: List[str] = []
+    if not isinstance(data, dict):
+        return [f"{name}: artifact is not a JSON object"]
+    if not isinstance(data.get("comment"), str) or not data["comment"]:
+        errs.append(f"{name}: missing provenance comment")
+    rows = data.get("rows")
+    if not isinstance(rows, list) or not rows:
+        return errs + [f"{name}: rows missing or empty"]
+    last_size = 0
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            errs.append(f"{name}: rows[{i}] is not an object")
+            continue
+        size = row.get("size")
+        if not (_num(size) and size > 0):
+            errs.append(f"{name}: rows[{i}] size {size!r} not positive")
+            continue
+        if size <= last_size:
+            errs.append(
+                f"{name}: rows[{i}] size {size} not strictly increasing"
+            )
+        last_size = size
+        if cell_provenance(row, "wall_s") == "measured" and not (
+            _num(row.get("wall_s")) and row["wall_s"] > 0
+        ):
+            errs.append(
+                f"{name}: rows[{i}] (size {size}) wall_s "
+                f"{row.get('wall_s')!r} not positive"
+            )
+        lvl = row.get("level_wall_ms")
+        if lvl is not None and (
+            not isinstance(lvl, list)
+            or not lvl
+            or not all(_num(v) and v > 0 for v in lvl)
+        ):
+            errs.append(
+                f"{name}: rows[{i}] (size {size}) level_wall_ms is not "
+                "a list of positive walls"
+            )
+        e0 = row.get("nnf_energy_level0")
+        if e0 is not None and (not _num(e0) or e0 < 0):
+            errs.append(
+                f"{name}: rows[{i}] (size {size}) nnf_energy_level0 "
+                f"{e0!r} not finite/non-negative"
+            )
+        dr = row.get("dist_ratio_vs_exact")
+        if dr is not None and (not _num(dr) or dr < 1.0):
+            errs.append(
+                f"{name}: rows[{i}] (size {size}) dist_ratio_vs_exact "
+                f"{dr!r} below 1.0 — an approximation cannot beat the "
+                "exact oracle; the probe is broken"
+            )
+        prov = row.get("provenance")
+        if prov is not None and prov not in PROVENANCES:
+            errs.append(
+                f"{name}: rows[{i}] provenance {prov!r} names none of "
+                f"{PROVENANCES}"
+            )
+    return errs
+
+
+# --------------------------------------------------------- trajectories
+def _worse_than(value: float, best: float, decl: Dict) -> bool:
+    """True when `value` regresses past `best` beyond the declared
+    tolerance (either bound passing suffices when both are given)."""
+    rel = decl.get("rel_tol")
+    abs_ = decl.get("abs_tol")
+    if decl["direction"] == "lower":
+        bounds = []
+        if rel is not None:
+            bounds.append(best * (1 + rel))
+        if abs_ is not None:
+            bounds.append(best + abs_)
+        return value > max(bounds)  # regressed past EVERY allowance
+    bounds = []
+    if rel is not None:
+        bounds.append(best * (1 - rel))
+    if abs_ is not None:
+        bounds.append(best - abs_)
+    return value < min(bounds)  # regressed past EVERY allowance
+
+
+def _bound_violation(value: float, decl: Dict) -> Optional[str]:
+    floor = decl.get("floor")
+    ceiling = decl.get("ceiling")
+    if floor is not None and value < floor:
+        return f"below the declared floor {floor}"
+    if ceiling is not None and value > ceiling:
+        return f"above the declared ceiling {ceiling}"
+    return None
+
+
+def check_series(
+    decl: Dict, cells: List[Tuple[int, str, dict]], series_name: str,
+    errs: List[str], report: List[Dict],
+) -> None:
+    """One tracked series over (round, artifact, container) cells:
+    measured cells compare against the best prior measured cell and
+    then (only they) may advance it; carried/modeled cells are listed
+    but inert (module docstring's provenance discipline)."""
+    field = decl["field"]
+    best: Optional[float] = None
+    best_at = None
+    n_meas = n_inert = 0
+    for rnd, name, container in cells:
+        if rnd < decl["since"] or not isinstance(container, dict):
+            continue  # non-object containers already failed schema
+        value = container.get(field)
+        if value is None:
+            continue
+        prov = cell_provenance(container, field)
+        entry = {
+            "series": series_name, "round": rnd, "artifact": name,
+            "value": value, "provenance": prov, "status": "ok",
+        }
+        if prov not in PROVENANCES:
+            errs.append(
+                f"{name}: {series_name} round {rnd}: provenance "
+                f"{prov!r} names none of {PROVENANCES}"
+            )
+            entry["status"] = "invalid"
+            report.append(entry)
+            continue
+        if not _num(value):
+            errs.append(
+                f"{name}: {series_name} round {rnd}: value {value!r} "
+                "is not a finite number"
+            )
+            entry["status"] = "invalid"
+            report.append(entry)
+            continue
+        if prov != "measured":
+            n_inert += 1
+            entry["status"] = "inert"
+            report.append(entry)
+            continue
+        n_meas += 1
+        bound = _bound_violation(value, decl)
+        if bound is not None:
+            errs.append(
+                f"{name}: {series_name} round {rnd}: {value} {bound}"
+            )
+            entry["status"] = "violated"
+        elif best is not None and _worse_than(value, best, decl):
+            errs.append(
+                f"{name}: {series_name} round {rnd}: {value} regresses "
+                f"past the best prior measured {best} (round "
+                f"{best_at[0]}, {best_at[1]}) beyond tolerance "
+                f"{{rel={decl.get('rel_tol')}, "
+                f"abs={decl.get('abs_tol')}}}"
+            )
+            entry["status"] = "violated"
+        better = best is None or (
+            value < best if decl["direction"] == "lower" else value > best
+        )
+        if better:
+            best, best_at = value, (rnd, name)
+        report.append(entry)
+    if n_meas or n_inert:
+        report.append({
+            "series": series_name, "summary": True,
+            "measured_cells": n_meas, "inert_cells": n_inert,
+            "best": best,
+            "best_at": best_at[1] if best_at else None,
+        })
+
+
+def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
+    """All schema + trajectory checks over the committed history.
+    Returns (violations, machine-readable report rows)."""
+    bench, scale = load_history(root)
+    errs: List[str] = []
+    report: List[Dict] = []
+
+    for rnd, name, rec in bench:
+        errs.extend(validate_bench_record(rnd, name, rec))
+    for rnd, name, data in scale:
+        errs.extend(validate_scale_artifact(rnd, name, data))
+
+    for decl in BENCH_SERIES:
+        check_series(
+            decl, [(r, n, rec) for r, n, rec in bench],
+            f"bench.{decl['field']}", errs, report,
+        )
+    def _rows(data):
+        rows = data.get("rows") if isinstance(data, dict) else None
+        return [r for r in (rows or []) if isinstance(r, dict)]
+
+    sizes = sorted({
+        row.get("size")
+        for _, _, data in scale
+        for row in _rows(data)
+        if _num(row.get("size"))
+    })
+    for decl in SCALE_SERIES:
+        for size in sizes:
+            cells = [
+                (r, n, row)
+                for r, n, data in scale
+                for row in _rows(data)
+                if row.get("size") == size
+            ]
+            check_series(
+                decl, cells, f"scale.{size}.{decl['field']}", errs,
+                report,
+            )
+    return errs, report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--all", action="store_true",
+        help="check every BENCH_r*/SCALE_r* artifact under --root",
+    )
+    ap.add_argument(
+        "--root", default=None,
+        help="history directory (default: the repo root this tool "
+        "lives in)",
+    )
+    ap.add_argument(
+        "--json", default=None, metavar="OUT",
+        help="also write the machine-readable trajectory report here",
+    )
+    args = ap.parse_args(argv)
+    if not args.all:
+        ap.error("nothing to do: pass --all")
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    try:
+        errs, report = check_trajectory(root)
+    except (OSError, ValueError) as e:
+        print(f"check_trajectory: cannot read history: {e}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"violations": errs, "report": report}, f, indent=1)
+            f.write("\n")
+    for row in report:
+        if row.get("summary"):
+            print(
+                f"check_trajectory: {row['series']}: "
+                f"{row['measured_cells']} measured / "
+                f"{row['inert_cells']} carried-or-modeled, best "
+                f"{row['best']} ({row['best_at']})"
+            )
+    if errs:
+        for e in errs:
+            print(f"check_trajectory: {e}", file=sys.stderr)
+        print(
+            f"check_trajectory: FAIL — {len(errs)} violation(s)",
+            file=sys.stderr,
+        )
+        return 1
+    n_b = len([1 for r in report if not r.get("summary")])
+    print(f"check_trajectory: OK — {n_b} tracked cells hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
