@@ -414,6 +414,37 @@ def _polish_fields(cfg, size: int):
     }
 
 
+def _memory_fields():
+    """Peak memory watermarks for the bench record (round 10): the
+    process's peak host RSS (ru_maxrss — the whole bench run's high-
+    water mark, read at record-assembly time so every phase above is
+    covered) and, when an accelerator backend is reachable AND exposes
+    PJRT memory stats, the device's peak bytes in use.  Absent device
+    stats publish as null — the record states what it measured, never
+    imputes (the report/sentinel discipline).  Schema enforced by
+    tools/check_bench.py."""
+    import resource
+    import sys
+
+    ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # ru_maxrss unit is KiB on Linux (this repo's only bench platform);
+    # macOS reports bytes.
+    peak_rss = ru if sys.platform == "darwin" else ru * 1024
+    device_peak = None
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        device_peak = int(peak) if peak else None
+    except Exception:  # noqa: BLE001 - stats are backend-optional
+        device_peak = None
+    return {
+        "peak_host_rss_bytes": int(peak_rss),
+        "device_memory_peak_bytes": device_peak,
+    }
+
+
 def _psnr_over_seeds(a, ap, b, levels, em_iters, seeds=(0, 1, 2)):
     """PSNR of the patchmatch pipeline vs the exact-NN brute oracle at
     full scale, one patchmatch run per seed — for BOTH the headline
@@ -716,6 +747,9 @@ def main() -> None:
         # field is the number they actually sum toward.
         "instrumented_wall_s": instrumented_wall_s,
         "acceptance_configs": config_rows,
+        # Peak-memory watermarks (round 10): host RSS always, device
+        # watermark when the backend exposes PJRT memory stats.
+        **_memory_fields(),
     }
     if util:
         rec.update(util)

@@ -89,7 +89,10 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
         "--trace-dir", dest="trace_dir", default=None, metavar="DIR",
         help="telemetry directory: a jax.profiler (Perfetto/XProf) "
         "device trace of the synthesis plus the run's host span tree "
-        "(host_spans.json) and metrics exposition (metrics.json/.prom) "
+        "(host_spans.json), metrics exposition (metrics.json/.prom), "
+        "and flight-recorder dump (flight.json — flushed BEFORE the "
+        "process dies on SIGTERM/SIGINT, so killed runs leave a "
+        "post-mortem) "
         "— self-contained input for the `report` subcommand.  Enables "
         "per-level host spans (one sync per level, like --progress)",
     )
@@ -107,6 +110,16 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
         "(expected-vs-observed model checks + span/energy invariants, "
         "telemetry/sentinel.py), print the verdict, and write "
         "health.json beside the other --trace-dir artifacts.  Implies "
+        "instrumentation (one host sync per level)",
+    )
+    p.add_argument(
+        "--metrics-port", type=int, default=None, metavar="PORT",
+        help="serve live telemetry mid-run on 127.0.0.1:PORT "
+        "(0 = ephemeral port): /metrics (Prometheus exposition), "
+        "/healthz (incremental sentinel verdict; HTTP 503 when "
+        "violated), /progress (open span stack + model-calibrated "
+        "ETA).  The bound endpoint is announced in "
+        "<trace-dir>/live.json when --trace-dir is set.  Implies "
         "instrumentation (one host sync per level)",
     )
 
@@ -162,6 +175,15 @@ def _emit_health(tracer, trace_dir, context: str) -> None:
     )
     if trace_dir:
         write_health(health, os.path.join(trace_dir, HEALTH_FILE))
+    # A violated verdict preserves the flight recorder's event window
+    # alongside the verdict (telemetry/flight.py): the dump is the
+    # "what was happening" half of the post-mortem.  The session has
+    # already torn down by this point (outputs save before the health
+    # epilogue), so the recorder is reached through the handle the
+    # session left on the tracer, not the installed-recorder hook.
+    recorder = getattr(tracer, "flight_recorder", None)
+    if recorder is not None and health["verdict"] == "violated":
+        recorder.flush("violation")
     print(render_health(health))
 
 
@@ -209,7 +231,10 @@ def cmd_synth(args) -> int:
     # minimal host syncs).  The historic --profile keeps its original
     # meaning — a device trace of the UN-instrumented run — so it does
     # NOT enable spans; --trace-dir (the telemetry layout) does.
-    instrument = bool(args.progress or args.trace_dir or args.health)
+    instrument = bool(
+        args.progress or args.trace_dir or args.health
+        or args.metrics_port is not None
+    )
     if args.bands > 1 and not args.spatial:
         raise SystemExit(
             "--bands requires --spatial (it names the A-band axis of "
@@ -221,6 +246,7 @@ def cmd_synth(args) -> int:
     with telemetry_session(
         args.trace_dir or args.profile, sink=progress,
         enabled=instrument, artifact_dir=args.trace_dir,
+        metrics_port=args.metrics_port,
     ) as tracer:
         # Disabled tracer: events still reach the JSONL/log stream
         # directly through the writer (the historic behavior).
@@ -305,12 +331,17 @@ def cmd_batch(args) -> int:
     t0 = time.perf_counter()
 
     # --profile keeps its historic un-instrumented-trace meaning (see
-    # cmd_synth); only --progress / --trace-dir / --health enable
-    # spans, and telemetry artifacts land only in --trace-dir.
-    instrument = bool(args.progress or args.trace_dir or args.health)
+    # cmd_synth); only --progress / --trace-dir / --health /
+    # --metrics-port enable spans, and telemetry artifacts land only
+    # in --trace-dir.
+    instrument = bool(
+        args.progress or args.trace_dir or args.health
+        or args.metrics_port is not None
+    )
     with telemetry_session(
         args.trace_dir or args.profile, sink=progress,
         enabled=instrument, artifact_dir=args.trace_dir,
+        metrics_port=args.metrics_port,
     ) as tracer:
         bps = np.asarray(
             synthesize_batch(

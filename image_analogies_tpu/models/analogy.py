@@ -981,7 +981,10 @@ def _resolve_channels(a, ap, b, cfg: SynthConfig):
     return a, ap, b, ap, None
 
 
-def record_prologue(tracer, pyr_raw_b, levels: int, t0: float) -> None:
+def record_prologue(tracer, pyr_raw_b, levels: int, t0: float,
+                    cfg: Optional[SynthConfig] = None,
+                    a_hw=None, batched: bool = False,
+                    runner: str = "single") -> None:
     """Drain the async prologue and record its span — shared by every
     runner so the sync barrier lives in ONE place.
 
@@ -989,17 +992,131 @@ def record_prologue(tracer, pyr_raw_b, levels: int, t0: float) -> None:
     prologue wall is charged to its own span, not the coarsest level
     (the round-2 bench charged 3.4 s of prologue to a 64^2 level).
     The scalar readback is the reliable barrier on the tunnelled
-    platform (block_until_ready can return early — bench.py _sync)."""
+    platform (block_until_ready can return early — bench.py _sync).
+
+    Round 10: with `cfg`, additionally declares the RUN PLAN as an
+    untimed `run_plan` mark — total levels, per-level shapes, and the
+    modeled per-level cost units (`level_eta_cost_units`) the live
+    /progress endpoint calibrates its ETA from (telemetry/live.py).
+    `batched` says pyr_raw_b entries carry a leading frame axis;
+    `a_hw` is the finest A shape (the sharded runners' comms term);
+    `runner` names which collective model applies."""
     if not tracer.enabled:
         return
     float(jnp.sum(pyr_raw_b[levels - 1]))
     tracer.record(
         "prologue", round((time.perf_counter() - t0) * 1000, 3)
     )
+    if cfg is None:
+        return
+    shapes = []
+    for lvl in range(levels):
+        s = pyr_raw_b[lvl].shape
+        hw = s[1:3] if batched else s[:2]
+        shapes.append([int(hw[0]), int(hw[1])])
+    tracer.annotate(
+        "run_plan",
+        levels=levels,
+        shapes=shapes,
+        em_iters=cfg.em_iters,
+        matcher=cfg.matcher,
+        runner=runner,
+        eta_cost_units=level_eta_cost_units(cfg, shapes, a_hw, runner),
+    )
+
+
+def level_eta_cost_units(cfg: SynthConfig, shapes, a_hw=None,
+                         runner: str = "single") -> Dict[str, float]:
+    """Modeled RELATIVE cost of every pyramid level, for the live
+    /progress ETA: {str(level): units}.  Only ratios are consumed —
+    telemetry/live.py calibrates an absolute seconds-per-unit rate
+    from the measured walls of completed levels, so the model shapes
+    the projection and the measurement scales it.
+
+    The patchmatch term prices the kernel's dominant traffic with the
+    SAME candidate-DMA byte model the bench and sentinel use
+    (kernels.patchmatch_tile.candidate_dma_bytes_per_fetch): per pixel,
+    em_iters x pm_iters x K_TOTAL candidate fetches at the level's
+    channel count (coarse context doubles the channels below the top
+    level); the brute matcher is O(pixels x A-pixels) per EM instead.
+    Sharded runners add the parallel/comms.py collective count times
+    the per-merge plane bytes — a small term at the published scales,
+    included so the two analytic models both feed the projection (and
+    so a collective-bound future mesh reprices correctly).  Geometry
+    details the host can't know without the arrays (exact channel
+    specs, tile heights) are approximated — this is an ETA, and the
+    per-level RATIOS are dominated by the 4x pixel scaling the model
+    gets exactly."""
+    from ..kernels.patchmatch_tile import (
+        K_TOTAL,
+        candidate_dma_bytes_per_fetch,
+    )
+
+    base_chan = 2 if cfg.color_mode == "luminance" else 6
+    if cfg.steerable:
+        base_chan += cfg.n_orientations
+    units: Dict[str, float] = {}
+    for level, (h, w) in enumerate(shapes):
+        px = float(h) * float(w)
+        has_coarse = level < len(shapes) - 1
+        n_chan = base_chan * (2 if has_coarse else 1)
+        if cfg.matcher == "brute":
+            ah, aw = a_hw if a_hw is not None else (h, w)
+            # A pyramid level l is 4^-l of the finest A side.
+            cost = cfg.em_iters * px * (
+                float(ah) * float(aw) / 4.0 ** level
+            )
+        else:
+            moved, _ = candidate_dma_bytes_per_fetch(n_chan, 8)
+            cost = cfg.em_iters * cfg.pm_iters * K_TOTAL * px * (
+                moved / 8.0  # per-fetch bytes per covered row
+            )
+        if runner in ("sharded_a", "spatial-banded") and a_hw is not None:
+            from ..parallel.comms import (
+                sharded_a_allreduce_count,
+                sharded_a_band_merge_bytes,
+            )
+
+            ah = max(1, int(a_hw[0]) // 2 ** level)
+            aw = max(1, int(a_hw[1]) // 2 ** level)
+            try:
+                n_coll = sharded_a_allreduce_count(cfg, ah, aw)
+                merge = sharded_a_band_merge_bytes(cfg, h, w)
+                cost += n_coll * merge["bytes_per_merge"]
+            except Exception:  # noqa: BLE001 - ETA must never block a run
+                pass
+        units[str(level)] = cost
+    return units
+
+
+def shard_sync_walls(level_t0: float, parts) -> List[float]:
+    """Per-shard completion walls (ms since the level's clock started):
+    one scalar-readback barrier per shard slice, in shard order — the
+    straggler watch's raw signal (round 10).
+
+    Each readback blocks until THAT shard's computation has finished
+    (the reliable barrier on the tunnelled platform — bench.py _sync),
+    so on an asynchronously-dispatching backend the walls are each
+    shard's true completion time relative to the level start.  Walls
+    are CUMULATIVE completion stamps, not deltas: shards that finished
+    before an earlier-in-order straggler read back almost instantly
+    once reached, so max/median over these stamps isolates the slow
+    shard.  On the synchronous CPU test mesh every stamp lands
+    together and the ratio degenerates to ~1 — by design (no fake
+    skew).  Caveat: a shard EARLIER in read order than the straggler
+    cannot be charged less than its own dispatch tail; the ratio is a
+    lower bound on true skew, never an overstatement."""
+    walls = []
+    for p in parts:
+        float(jnp.sum(p))
+        walls.append(round((time.perf_counter() - level_t0) * 1000, 3))
+    return walls
 
 
 def record_level_span(tracer, cfg: SynthConfig, level_t0: float,
-                      level: int, h, w, nnf_energy: float, **attrs):
+                      level: int, h, w, nnf_energy: float,
+                      shard_walls: Optional[List[float]] = None,
+                      shard_axis: Optional[str] = None, **attrs):
     """Timed `level` span + declared em_iter children — the shared
     form for the parallel runners (batch/spatial/sharded-A), whose
     level wall is clocked around one already-synced runner call.  The
@@ -1007,7 +1124,46 @@ def record_level_span(tracer, cfg: SynthConfig, level_t0: float,
     context-managed span + `_record_level_telemetry` instead.  The
     `em_iters` declaration and matching untimed children are what the
     run sentinel's span-tree completeness check holds every runner
-    to."""
+    to.
+
+    Round-10 straggler watch: with `shard_walls` (per-shard completion
+    walls from `shard_sync_walls`) the level additionally publishes
+    `ia_shard_level_wall_ms{level, shard, axis}` gauges and the
+    `ia_shard_imbalance_ratio{level, axis}` max/median ratio the
+    sentinel's `straggler_skew` check reads, and carries both on the
+    span's attrs so flight dumps and reports show them too."""
+    if shard_walls:
+        # True median (even counts average the two middles): the upper
+        # middle alone IS the max on a 2-shard mesh, which would pin
+        # the ratio at 1.0 and blind the straggler watch exactly where
+        # skew is most common.
+        s = sorted(shard_walls)
+        n = len(s)
+        med = s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+        ratio = (
+            round(max(shard_walls) / med, 4) if med > 0 else 1.0
+        )
+        attrs["shard_walls_ms"] = shard_walls
+        attrs["shard_imbalance"] = ratio
+        reg = (
+            tracer.registry if tracer.registry is not None
+            else get_registry()
+        )
+        axis = shard_axis or "shard"
+        wall_g = reg.gauge(
+            "ia_shard_level_wall_ms",
+            "per-shard completion wall per pyramid level (ms since "
+            "level start; post-hoc readback stamps — straggler watch)",
+        )
+        for i, wall in enumerate(shard_walls):
+            wall_g.set(wall, labels={
+                "level": str(level), "shard": str(i), "axis": axis,
+            })
+        reg.gauge(
+            "ia_shard_imbalance_ratio",
+            "max/median per-shard level wall (1.0 = balanced; the "
+            "sentinel flags sustained skew)",
+        ).set(ratio, labels={"level": str(level), "axis": axis})
     sp = tracer.record(
         "level",
         round((time.perf_counter() - level_t0) * 1000, 3),
@@ -1149,7 +1305,10 @@ def _synthesize_single(a, ap, b, cfg: SynthConfig, levels: int,
                 return {"bp": out, "nnf": aux["nnf"], "dist": aux["dist"]}
             return out
 
-    record_prologue(tracer, pyr_raw_b, levels, prologue_t0)
+    record_prologue(
+        tracer, pyr_raw_b, levels, prologue_t0, cfg=cfg,
+        a_hw=pyr_src_a[0].shape[:2], runner="single",
+    )
 
     for level in range(start_level, -1, -1):
         with tracer.span("level", level=level) as lvl_span:

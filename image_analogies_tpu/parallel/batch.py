@@ -488,10 +488,15 @@ def synthesize_batch(
     (
         pyr_src_a, pyr_flt_a, pyr_copy_a, pyr_src_b, pyr_raw_b, yiq_b
     ) = _batch_prologue_fn(cfg, levels, token)(a, ap, frames, _b_stats)
-    # Shared drain + span — uniform report phases across runners.
+    # Shared drain + span — uniform report phases across runners
+    # (round 10: also declares the run plan the live /progress ETA
+    # calibrates; batch pyramids carry a leading frame axis).
     from ..models.analogy import record_prologue
 
-    record_prologue(tracer, pyr_raw_b, levels, prologue_t0)
+    record_prologue(
+        tracer, pyr_raw_b, levels, prologue_t0, cfg=cfg,
+        a_hw=a.shape[:2], batched=True, runner="batch",
+    )
 
     for level in range(start_level, -1, -1):
         level_t0 = time.perf_counter()
@@ -545,13 +550,26 @@ def synthesize_batch(
         )
 
         if tracer.enabled:
-            # Sync first (nnf_energy readback), then record the timed
-            # `level` span — its emitted view is the legacy
-            # `level_done` event, which now also carries wall_ms.
-            from ..models.analogy import record_level_span
+            # Per-device-shard completion walls FIRST (the straggler
+            # watch's raw signal: frames shard over the mesh in
+            # contiguous blocks, so each block's readback barrier is
+            # one device's completion stamp), then the merged
+            # nnf_energy readback — by then every shard is synced, so
+            # the level span's own wall is unchanged.
+            from ..models.analogy import (
+                record_level_span,
+                shard_sync_walls,
+            )
 
+            n_sh = int(mesh.devices.size)
+            per = dist.shape[0] // n_sh
+            walls = shard_sync_walls(
+                level_t0,
+                [dist[i * per:(i + 1) * per] for i in range(n_sh)],
+            ) if per else None
             record_level_span(
-                tracer, cfg, level_t0, level, h, w, float(dist.mean())
+                tracer, cfg, level_t0, level, h, w, float(dist.mean()),
+                shard_walls=walls, shard_axis=BATCH_AXIS,
             )
         if cfg.save_level_artifacts:
             # Whole-batch per-level state through the single-image writer:

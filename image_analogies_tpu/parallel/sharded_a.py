@@ -388,10 +388,15 @@ def synthesize_sharded_a(
     (
         pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, pyr_raw_b, yiq_b
     ) = _prologue_fn(cfg, levels)(a, ap, b)
-    # Shared drain + span — uniform report phases across runners.
+    # Shared drain + span — uniform report phases across runners
+    # (round 10: also declares the run plan — including the comms-model
+    # collective term — for the live /progress ETA).
     from ..models.analogy import record_prologue
 
-    record_prologue(tracer, pyr_raw_b, levels, prologue_t0)
+    record_prologue(
+        tracer, pyr_raw_b, levels, prologue_t0, cfg=cfg,
+        a_hw=a.shape[:2], runner="sharded_a",
+    )
 
     key = jax.random.PRNGKey(cfg.seed)
     interpret = bool(resolve_pallas(cfg))
@@ -412,6 +417,7 @@ def synthesize_sharded_a(
         n_sharded_levels = levels - 1 - start_level
     for level in range(start_level, -1, -1):
         level_t0 = time.perf_counter()
+        shard_walls = None  # set on lean (band-sharded) levels only
         h, w = pyr_src_b[level].shape[:2]
         ha, wa = pyr_src_a[level].shape[:2]
         has_coarse = level < levels - 1
@@ -462,6 +468,27 @@ def synthesize_sharded_a(
                         pyr_flt_a[level + 1] if has_coarse else None,
                     ),
                     shard,
+                )
+            if tracer.enabled:
+                # Per-band completion walls of the band-sharded
+                # ASSEMBLY (the straggler watch's per-band signal on
+                # this runner): the EM body's pmin/psum merges
+                # synchronize the bands every pm iteration, so
+                # post-merge skew is unobservable by construction —
+                # the assembly phase, each band building its own table
+                # slice independently, is where a slow band shows.
+                # Instrumented runs already pay per-level syncs (the
+                # documented per-level-timing price); this adds the
+                # per-band readbacks to the same barrier.
+                from ..models.analogy import shard_sync_walls
+
+                rows_pb = f_a_tab.shape[0] // n_dev
+                shard_walls = shard_sync_walls(
+                    level_t0,
+                    [
+                        f_a_tab[i * rows_pb:(i + 1) * rows_pb, :1]
+                        for i in range(n_dev)
+                    ],
                 )
             bands = prepare_a_planes(
                 pyr_src_a[level],
@@ -538,7 +565,9 @@ def synthesize_sharded_a(
             from ..models.analogy import record_level_span
 
             record_level_span(
-                tracer, cfg, level_t0, level, h, w, float(dist.mean())
+                tracer, cfg, level_t0, level, h, w, float(dist.mean()),
+                shard_walls=shard_walls, shard_axis=_AXIS,
+                **({"shard_phase": "assemble"} if shard_walls else {}),
             )
         if cfg.save_level_artifacts:
             nnf_save = nnf

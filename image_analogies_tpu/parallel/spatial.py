@@ -305,10 +305,16 @@ def synthesize_spatial(
     ) = _prologue_fn(cfg, levels)(a, ap, b)
     # Shared drain + span (models/analogy.record_prologue) — every
     # runner's report carries the same prologue phase
-    # (tools/check_report.py requires it).
+    # (tools/check_report.py requires it).  Round 10: also declares
+    # the run plan the live /progress ETA calibrates (the banded 2-D
+    # runner's plan includes the comms-model collective term).
     from ..models.analogy import record_prologue
 
-    record_prologue(tracer, pyr_raw_b, levels, prologue_t0)
+    record_prologue(
+        tracer, pyr_raw_b, levels, prologue_t0, cfg=cfg,
+        a_hw=a.shape[:2],
+        runner="spatial-banded" if n_bands > 1 else "spatial",
+    )
 
     key = jax.random.PRNGKey(cfg.seed)
     bp = flt_bp = nnf = None  # global (H_l, W[, C]) state per level
@@ -584,6 +590,18 @@ def synthesize_spatial(
                     slab_nnf, slab_flt = _reslab_fn(
                         halo, n_slabs, 2, token, slab_axis
                     )(nnf_s, bp_s)
+        shard_walls = None
+        if tracer.enabled:
+            # Per-slab completion walls BEFORE the core merge touches
+            # the stack (the straggler watch's raw signal: dist_s keeps
+            # the leading slab axis, one readback barrier per slab
+            # column) — the merged readback below then finds everything
+            # already synced.
+            from ..models.analogy import shard_sync_walls
+
+            shard_walls = shard_sync_walls(
+                level_t0, [dist_s[i] for i in range(n_slabs)]
+            )
         if lean:
             nnf = (
                 _merge_cores(nnf_s[0], halo),
@@ -604,6 +622,7 @@ def synthesize_spatial(
             record_level_span(
                 tracer, cfg, level_t0, level, h, w, float(dist.mean()),
                 spatial_slabs=n_slabs,
+                shard_walls=shard_walls, shard_axis=slab_axis,
             )
         if cfg.save_level_artifacts:
             nnf_save = nnf

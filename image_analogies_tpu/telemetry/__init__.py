@@ -14,7 +14,13 @@ One coherent layer over what used to be three disconnected fragments
   subcommand's engine);
 - `sentinel` — end-of-run expected-vs-observed health verdicts
   (`evaluate_health` -> health.json) joining the live registry
-  against the analytic byte/comms models (round 9).
+  against the analytic byte/comms models (round 9);
+- `live`    — opt-in in-process HTTP exporter (`--metrics-port`):
+  /metrics, /healthz and /progress served mid-run from the same
+  tracer/registry the epilogue serializes (round 10);
+- `flight`  — bounded flight recorder flushed to flight.json on
+  SIGTERM/SIGINT/atexit/sentinel violation, so killed runs leave a
+  validated post-mortem artifact (round 10).
 
 Every future perf PR reports against this layer: instrument with
 spans + named-scope tags, count with the registry, publish with the
@@ -29,6 +35,8 @@ from .metrics import (
     get_registry,
     reset_registry,
 )
+from .flight import FLIGHT_FILE, FlightRecorder
+from .live import LIVE_FILE, LiveTelemetryServer, progress_snapshot
 from .report import build_report, render_table, write_report
 from .sentinel import (
     HEALTH_FILE,
@@ -49,6 +57,11 @@ __all__ = [
     "build_report",
     "render_table",
     "write_report",
+    "FLIGHT_FILE",
+    "FlightRecorder",
+    "LIVE_FILE",
+    "LiveTelemetryServer",
+    "progress_snapshot",
     "HEALTH_FILE",
     "evaluate_health",
     "health_from_trace_dir",

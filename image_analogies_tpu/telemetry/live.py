@@ -1,0 +1,255 @@
+"""In-process live telemetry endpoint — scrape a run WHILE it runs
+(round 10 tentpole, with telemetry/flight.py).
+
+Every artifact before this round was post-hoc (`metrics.json`,
+`health.json` exist only at the epilogue), so a hung 4096² synthesis or
+a stalled shard was invisible until it was dead.  This module is the
+Prometheus-style pull answer (PAPERS.md: Borgmon/Monarch lineage;
+Sigelman et al. 2010 for the always-on tracing posture): an opt-in
+stdlib `http.server` on a daemon thread, bound to loopback, serving
+the SAME objects the epilogue serializes — no second bookkeeping path
+that could drift from the artifacts.
+
+Endpoints:
+
+  /metrics   the session registry's Prometheus text exposition
+             (format 0.0.4, now including the derived `_quantile`
+             families) — point any scraper at it mid-run.
+  /healthz   the run sentinel's registry-joinable checks evaluated
+             incrementally against the LIVE registry (candidate-DMA /
+             polish-DMA / comms ledgers, energy gauge, overhead,
+             straggler skew).  The span-tree completeness check is an
+             end-of-run invariant by definition (the run span is
+             legitimately open mid-run), so the live verdict evaluates
+             with spans=None and that check reports skipped.  HTTP 503
+             on a violated verdict (ready-check semantics), and a
+             violated live verdict flushes the flight recorder.
+  /progress  the open span stack (where the run is right now) plus
+             completed-level walls and an ETA — measured walls
+             calibrate the per-level cost model the run declared at
+             its prologue (models/analogy.record_prologue's `run_plan`
+             mark: pixel counts priced by the candidate-DMA byte model
+             and, on sharded runs, the parallel/comms.py collective
+             term), so the estimate is model-shaped but
+             measurement-scaled, and says so (`eta_basis`).
+
+Thread-safety posture: the run thread owns the tracer/registry and the
+server only READS.  Registry reads take the per-metric locks; span-tree
+reads ride CPython's GIL atomicity for list/dict ops, and the rare
+torn read (an attrs dict resized mid-serialize) surfaces as HTTP 500 —
+the scraper retries; the RUN is never touched.  Handlers never raise
+into the server loop.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+LIVE_FILE = "live.json"
+
+
+def _walk_spans(spans):
+    for sp in spans or []:
+        yield sp
+        yield from _walk_spans(sp.get("children", []))
+
+
+def progress_snapshot(tracer) -> Dict[str, Any]:
+    """The /progress payload: open span stack, completed levels, ETA.
+
+    ETA: the `run_plan` mark (recorded by models/analogy.record_prologue
+    on instrumented runs) carries per-level modeled cost units; the
+    measured walls of completed levels calibrate seconds-per-unit, and
+    the remaining levels' units price out at that rate.  With no plan
+    (a pre-round-10 caller) the 4x-pixels-per-finer-level pyramid law
+    is applied to the finest completed wall instead; with no completed
+    level yet the ETA is null — stated, never imputed."""
+    tree = tracer.to_dict()
+    plan = None
+    done: Dict[int, float] = {}
+    for sp in _walk_spans(tree.get("spans")):
+        if sp.get("name") == "run_plan":
+            plan = sp.get("attrs") or {}
+        elif sp.get("name") == "level":
+            attrs = sp.get("attrs") or {}
+            if attrs.get("level") is not None and sp.get("wall_ms"):
+                done[int(attrs["level"])] = sp["wall_ms"]
+
+    eta_s = None
+    eta_basis = None
+    levels_total = plan.get("levels") if plan else None
+    remaining = None
+    if done:
+        if plan and plan.get("eta_cost_units"):
+            units = {
+                int(lvl): u
+                for lvl, u in plan["eta_cost_units"].items()
+            }
+            done_units = sum(units.get(lvl, 0.0) for lvl in done)
+            rem = {
+                lvl: u for lvl, u in units.items() if lvl not in done
+            }
+            remaining = sorted(rem, reverse=True)
+            if done_units > 0:
+                rate = sum(done.values()) / 1000.0 / done_units
+                eta_s = round(rate * sum(rem.values()), 3)
+                eta_basis = "cost-model x measured rate"
+        else:
+            # Pyramid fallback: each finer level has 4x the pixels of
+            # the one above it; scale the finest completed wall.
+            finest = min(done)
+            remaining = list(range(finest - 1, -1, -1))
+            eta_s = round(
+                done[finest] / 1000.0
+                * sum(4.0 ** (finest - lvl) for lvl in remaining),
+                3,
+            )
+            eta_basis = "4x-pyramid law x finest measured level"
+
+    return {
+        "stack": tracer.stack_snapshot(),
+        "levels_total": levels_total,
+        "levels_done": sorted(done, reverse=True),
+        "level_wall_ms": {str(lvl): done[lvl] for lvl in sorted(done)},
+        "levels_remaining": remaining,
+        "eta_s": eta_s,
+        "eta_basis": eta_basis,
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # The server thread must never write request logs over the run's
+    # stdout/progress stream.
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        live = self.server.live  # type: ignore[attr-defined]
+        try:
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            if path == "/metrics":
+                self._send(
+                    200,
+                    live.registry.to_prometheus().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif path == "/healthz":
+                health = live.evaluate_live_health()
+                code = 503 if health["verdict"] == "violated" else 200
+                self._send(
+                    code,
+                    (json.dumps(health, indent=1) + "\n").encode(),
+                    "application/json",
+                )
+            elif path == "/progress":
+                body = json.dumps(
+                    progress_snapshot(live.tracer), indent=1
+                ) + "\n"
+                self._send(200, body.encode(), "application/json")
+            else:
+                self._send(404, b"not found\n", "text/plain")
+        except Exception as e:  # noqa: BLE001 - never kill the server
+            try:
+                self._send(
+                    500, f"live telemetry error: {e}\n".encode(),
+                    "text/plain",
+                )
+            except Exception:  # noqa: BLE001 - client went away
+                pass
+
+
+class LiveTelemetryServer:
+    """The exporter: bind, serve on a daemon thread, announce, stop.
+
+    `port=0` binds an ephemeral port (the bound port is `self.port`
+    after `start()`); `announce(dir)` writes `<dir>/live.json` with the
+    URL so out-of-process consumers (and the scrape test) can find an
+    ephemeral endpoint without parsing stdout."""
+
+    def __init__(self, tracer, registry, port: int = 0,
+                 host: str = "127.0.0.1", flight=None):
+        self.tracer = tracer
+        self.registry = registry
+        self.flight = flight
+        self.host = host
+        self._requested_port = int(port)
+        self.port: Optional[int] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def evaluate_live_health(self) -> Dict[str, Any]:
+        """The sentinel's registry-joinable checks against the live
+        registry (module docstring: span-tree completeness is
+        end-of-run-only, so spans stay out of the live verdict)."""
+        from .sentinel import evaluate_health
+
+        health = evaluate_health(
+            metrics=self.registry.to_dict(), context="live"
+        )
+        if self.flight is not None and health["verdict"] == "violated":
+            self.flight.flush("violation")
+        return health
+
+    def start(self) -> "LiveTelemetryServer":
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), _Handler
+        )
+        self._httpd.live = self  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        httpd = self._httpd
+        self._thread = threading.Thread(
+            # Tight poll interval: shutdown() blocks a full poll cycle,
+            # and the exporter stops inside the run's teardown path.
+            target=lambda: httpd.serve_forever(poll_interval=0.1),
+            name="ia-live-telemetry",
+            daemon=True,
+        )
+        self._thread.start()
+        import logging
+
+        logging.getLogger("image_analogies_tpu").info(
+            "live telemetry: http://%s:%d (/metrics /healthz /progress)",
+            self.host, self.port,
+        )
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def announce(self, artifact_dir: str) -> None:
+        import os
+
+        from ..utils.io import atomic_write_json
+
+        os.makedirs(artifact_dir, exist_ok=True)
+        atomic_write_json(
+            os.path.join(artifact_dir, LIVE_FILE),
+            {
+                "url": self.url,
+                "host": self.host,
+                "port": self.port,
+                "pid": os.getpid(),
+                "endpoints": ["/metrics", "/healthz", "/progress"],
+            },
+        )
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

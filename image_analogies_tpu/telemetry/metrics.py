@@ -209,6 +209,61 @@ class Histogram:
             self._sums[key] = self._sums.get(key, 0.0) + float(value)
             self._totals[key] = self._totals.get(key, 0) + 1
 
+    # Quantiles derived for the Prometheus exposition (round 10): the
+    # mid-run scrape story needs tail latencies (a straggling shard
+    # shows up in p99 level-wall long before it shows in the mean), and
+    # cumulative buckets alone push the interpolation onto every
+    # consumer.
+    QUANTILES = (0.5, 0.99)
+
+    def quantile(self, q: float,
+                 labels: Optional[Dict[str, str]] = None):
+        """Estimated q-quantile (0 < q <= 1) of one label set's
+        observations, by linear interpolation inside the cumulative
+        buckets — the same estimator PromQL's histogram_quantile()
+        applies, so a scraped family and this method answer alike.
+        The first bucket interpolates from 0 (observations here are
+        non-negative wall/byte figures); ranks landing in the +Inf
+        bucket clamp to the highest finite bound (stated, not
+        extrapolated).  None when the label set has no observations."""
+        if not 0.0 < q <= 1.0:
+            raise ValueError(f"quantile {q} outside (0, 1]")
+        key = _label_key(labels)
+        total = self._totals.get(key, 0)
+        if not total:
+            return None
+        rank = q * total
+        prev_bound, prev_cum = 0.0, 0
+        for bound, cum in zip(self.buckets, self._counts[key]):
+            if cum >= rank:
+                if cum == prev_cum:
+                    return bound
+                frac = (rank - prev_cum) / (cum - prev_cum)
+                return prev_bound + (bound - prev_bound) * frac
+            prev_bound, prev_cum = bound, cum
+        return self.buckets[-1]
+
+    def expose_quantiles(self) -> List[str]:
+        """Derived `<name>_quantile{quantile="q", ...}` gauge series,
+        one per (label set, q) — rendered by the registry as its OWN
+        family with its own single TYPE line, because the exposition
+        format reserves a histogram family's children for
+        _bucket/_sum/_count (adding quantile children under the
+        histogram TYPE would break format-0.0.4 parsers)."""
+        lines = []
+        for key in sorted(self._totals):
+            base = dict(key)
+            for q in self.QUANTILES:
+                v = self.quantile(q, base)
+                if v is None:
+                    continue
+                lines.append(
+                    f"{self.name}_quantile"
+                    f"{_label_str(_label_key({**base, 'quantile': _fmt(q)}))}"
+                    f" {_fmt(v)}"
+                )
+        return lines
+
     def count(self, labels: Optional[Dict[str, str]] = None) -> int:
         return self._totals.get(_label_key(labels), 0)
 
@@ -312,6 +367,25 @@ class MetricsRegistry:
                 lines.append(f"# HELP {name} {help_text}")
             lines.append(f"# TYPE {name} {m.kind}")
             lines.extend(m.expose())
+            if isinstance(m, Histogram):
+                # Derived p50/p99 children as a SEPARATE gauge family
+                # (round 10): the histogram family's TYPE line stays
+                # alone over _bucket/_sum/_count, and the derived
+                # `<name>_quantile` family gets exactly one TYPE line
+                # of its own.  A real metric registered under the
+                # derived name wins — emitting both would print two
+                # TYPE lines for one family.
+                qlines = (
+                    m.expose_quantiles()
+                    if f"{name}_quantile" not in self._metrics else []
+                )
+                if qlines:
+                    lines.append(
+                        f"# HELP {name}_quantile p50/p99 estimates "
+                        f"interpolated from {name} buckets"
+                    )
+                    lines.append(f"# TYPE {name}_quantile gauge")
+                    lines.extend(qlines)
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:

@@ -155,6 +155,24 @@ class Tracer:
         self._ts0 = _iso_now()
         self._stack: List[Span] = []
         self.roots: List[Span] = []
+        # Span-event observers (telemetry/flight.py's ring buffer): each
+        # is called as fn(kind, span) with kind in {"open", "close",
+        # "mark"}.  The list is almost always empty, and every notify
+        # site is gated on a truthiness check, so un-observed tracing
+        # pays one falsy branch — nothing else.
+        self._observers: List = []
+
+    def add_observer(self, fn) -> None:
+        """Subscribe fn(kind, span) to span open/close/mark events."""
+        self._observers.append(fn)
+
+    def remove_observer(self, fn) -> None:
+        if fn in self._observers:
+            self._observers.remove(fn)
+
+    def _notify(self, kind: str, sp: "Span") -> None:
+        for fn in self._observers:
+            fn(kind, sp)
 
     # -- recording ----------------------------------------------------
     def span(self, name: str, **attrs):
@@ -164,6 +182,8 @@ class Tracer:
             return _NULL_SPAN
         sp = Span(name, attrs, self)
         self._push(sp)
+        if self._observers:
+            self._notify("open", sp)
         return sp
 
     def annotate(self, name: str, parent: Optional[Span] = None, **attrs):
@@ -178,6 +198,8 @@ class Tracer:
             parent.children.append(sp)
         else:
             self._attach(sp)
+        if self._observers:
+            self._notify("mark", sp)
         return sp
 
     def record(self, name: str, wall_ms: float, **attrs):
@@ -205,6 +227,8 @@ class Tracer:
             return
         mark = Span(event, fields, self, timed=False)
         self._attach(mark)
+        if self._observers:
+            self._notify("mark", mark)
         if self.sink is not None:
             self.sink.emit(event, **fields)
 
@@ -219,6 +243,8 @@ class Tracer:
     def _close(self, sp: Span) -> None:
         if self._stack and self._stack[-1] is sp:
             self._stack.pop()
+        if self._observers:
+            self._notify("close", sp)
         event = _SPAN_EVENTS.get(sp.name)
         if event and self.sink is not None:
             fields = dict(sp.attrs)
@@ -242,6 +268,28 @@ class Tracer:
         from ..utils.io import atomic_write_json
 
         atomic_write_json(path, self.to_dict())
+
+    def stack_snapshot(self) -> List[Dict[str, Any]]:
+        """The currently-open span stack, outermost first, as plain
+        dicts — what the live `/progress` endpoint (telemetry/live.py)
+        and the flight recorder's dump report as "where the run is
+        right now".  Reads a tuple copy of the stack, so a concurrent
+        push/pop on the run thread cannot break the walk (CPython list
+        ops are atomic under the GIL); attrs are shallow-copied for the
+        same reason."""
+        now = time.perf_counter()
+        out = []
+        for sp in tuple(self._stack):
+            out.append({
+                "name": sp.name,
+                "attrs": dict(sp.attrs),
+                "ts": sp.ts,
+                "open_s": (
+                    round(now - sp.t_start, 3)
+                    if sp.t_start is not None else None
+                ),
+            })
+        return out
 
     def find(self, name: str) -> List[Span]:
         """All spans named `name`, depth-first — test/report helper."""

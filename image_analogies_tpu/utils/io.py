@@ -42,3 +42,15 @@ def atomic_write_json(path: str, obj) -> None:
         json.dump(obj, f, indent=1)
         f.write("\n")
     os.replace(tmp, path)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Text twin of `atomic_write_json` (same tmp + rename contract) —
+    for non-JSON telemetry artifacts like the Prometheus exposition
+    (`metrics.prom`)."""
+    import os
+
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)

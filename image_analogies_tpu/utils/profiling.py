@@ -39,7 +39,8 @@ _SAME_AS_TRACE_DIR = object()
 @contextlib.contextmanager
 def telemetry_session(trace_dir: Optional[str], sink=None,
                       enabled: bool = True,
-                      artifact_dir=_SAME_AS_TRACE_DIR):
+                      artifact_dir=_SAME_AS_TRACE_DIR,
+                      metrics_port: Optional[int] = None):
     """Device trace + span tracer + telemetry artifact writes.
 
     Yields a `telemetry.Tracer` (disabled when `enabled` is False, so
@@ -50,6 +51,15 @@ def telemetry_session(trace_dir: Optional[str], sink=None,
     sharded-gather counters record through `get_registry()` and land
     in the session's registry too).
 
+    Round-10 live layer: an enabled session with an `artifact_dir`
+    installs a flight recorder (telemetry/flight.py — span-event ring
+    buffer flushed to `<artifact_dir>/flight.json` on SIGTERM/SIGINT/
+    atexit/sentinel violation and at teardown), and `metrics_port`
+    (the CLI's `--metrics-port`; 0 = ephemeral) additionally serves
+    /metrics, /healthz and /progress from an in-process HTTP exporter
+    (telemetry/live.py), announcing the bound endpoint in
+    `<artifact_dir>/live.json`.
+
     On exit — crash included, a partial run's telemetry is exactly
     when you want the evidence — writes into `artifact_dir` (default:
     `trace_dir`; the CLI passes them separately so the historic
@@ -58,11 +68,13 @@ def telemetry_session(trace_dir: Optional[str], sink=None,
       host_spans.json   the span tree (telemetry/spans.py schema)
       metrics.json      the registry's JSON exposition
       metrics.prom      the registry's Prometheus text exposition
+      flight.json       the flight recorder's final dump
 
+    every one via tmp + rename (a crash mid-epilogue must never leave
+    the truncated artifact the sentinel would then have to refuse),
     alongside whatever `*.xplane.pb` files `jax.profiler.trace` left,
     making the directory self-contained input for the `report`
     subcommand."""
-    import json
     import os
 
     from ..telemetry import NULL_TRACER, MetricsRegistry, Tracer
@@ -70,6 +82,7 @@ def telemetry_session(trace_dir: Optional[str], sink=None,
 
     if artifact_dir is _SAME_AS_TRACE_DIR:
         artifact_dir = trace_dir
+    flight = live = None
     if enabled:
         reg = MetricsRegistry()
         tracer = Tracer(sink=sink, registry=reg)
@@ -77,17 +90,47 @@ def telemetry_session(trace_dir: Optional[str], sink=None,
     else:
         tracer = NULL_TRACER
         reg = prev_reg = None
+    # The flight/live setup lives INSIDE the try: a failed exporter
+    # bind (e.g. EADDRINUSE on a fixed --metrics-port) must unwind the
+    # registry swap and the recorder's signal/atexit handlers through
+    # the same finally the run itself uses — not leak them into the
+    # process for the next session to trip over.
     try:
+        if enabled:
+            if artifact_dir:
+                from ..telemetry.flight import install_for_session
+
+                flight = install_for_session(tracer, reg, artifact_dir)
+                # Handle for epilogues that run AFTER session teardown
+                # (the CLI health epilogue flushes on a violated
+                # verdict).
+                tracer.flight_recorder = flight
+            if metrics_port is not None:
+                from ..telemetry.live import LiveTelemetryServer
+
+                live = LiveTelemetryServer(
+                    tracer, reg, port=metrics_port, flight=flight
+                ).start()
+                if artifact_dir:
+                    live.announce(artifact_dir)
         with device_trace(trace_dir):
             yield tracer
     finally:
+        if live is not None:
+            live.stop()
+        if flight is not None:
+            flight.uninstall()  # final flush, reason "session-end"
         if enabled:
             set_registry(prev_reg)
         if artifact_dir and tracer.enabled:
+            from ..utils.io import atomic_write_json, atomic_write_text
+
             os.makedirs(artifact_dir, exist_ok=True)
             tracer.write(os.path.join(artifact_dir, "host_spans.json"))
-            with open(os.path.join(artifact_dir, "metrics.json"), "w") as f:
-                json.dump(reg.to_dict(), f, indent=1)
-                f.write("\n")
-            with open(os.path.join(artifact_dir, "metrics.prom"), "w") as f:
-                f.write(reg.to_prometheus())
+            atomic_write_json(
+                os.path.join(artifact_dir, "metrics.json"), reg.to_dict()
+            )
+            atomic_write_text(
+                os.path.join(artifact_dir, "metrics.prom"),
+                reg.to_prometheus(),
+            )

@@ -1,0 +1,569 @@
+"""Live-telemetry layer tests (round 10): the in-process HTTP exporter
+(telemetry/live.py), the flight recorder (telemetry/flight.py), the
+`validate_flight` wrapper (tools/check_report.py), and the layer's
+measured overhead budget.
+
+The acceptance-critical paths run as ONE real subprocess lifecycle
+(module fixture): a CPU synth started with `--trace-dir` +
+`--metrics-port 0`, scraped mid-run over HTTP, then SIGTERM'd — the
+scrape must return well-formed /metrics + /progress output and the
+killed run must leave a `flight.json` that parses and validates.
+"""
+
+import json
+import os
+import signal
+import statistics
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from check_report import main as check_report_main  # noqa: E402
+from check_report import validate_flight  # noqa: E402
+
+from image_analogies_tpu.config import SynthConfig  # noqa: E402
+from image_analogies_tpu.telemetry import (  # noqa: E402
+    MetricsRegistry,
+    Tracer,
+    evaluate_health,
+)
+from image_analogies_tpu.telemetry.flight import (  # noqa: E402
+    FlightRecorder,
+)
+from image_analogies_tpu.telemetry.live import (  # noqa: E402
+    LiveTelemetryServer,
+    progress_snapshot,
+)
+
+# One synth config shared by every in-process test that actually runs
+# a synthesis (the plan test and both arms of the overhead pin): a
+# single compile cache serves all of them — and it is the SAME config
+# tests/test_sentinel.py's span-layer overhead test uses, so a full
+# tier-1 run compiles this pipeline once.
+_SYNTH_CFG = dict(
+    levels=2, matcher="patchmatch", pallas_mode="off",
+    em_iters=1, pm_iters=3, pm_polish_iters=1, pm_polish_random=1,
+)
+
+
+def _get(url, timeout=5.0, retries=3):
+    """GET with a short retry: a torn read of the live span tree is
+    documented to surface as HTTP 500 (the scraper retries, the run is
+    untouched) — the test client honors that contract."""
+    for attempt in range(retries):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return (
+                    resp.status,
+                    resp.headers.get("Content-Type", ""),
+                    resp.read(),
+                )
+        except urllib.error.HTTPError as e:
+            if e.code != 500 or attempt == retries - 1:
+                raise
+            time.sleep(0.1)
+
+
+# ------------------------------------------------- subprocess lifecycle
+@pytest.fixture(scope="module")
+def killed_run(tmp_path_factory):
+    """One instrumented synth subprocess: scrape mid-run, SIGTERM it,
+    collect the artifacts.  Returns a dict the tests below assert on —
+    the run itself happens once (subprocess start-up dominates the
+    cost, so the scrape test and the flight test share it)."""
+    from image_analogies_tpu import cli
+
+    assets = str(tmp_path_factory.mktemp("live_assets"))
+    cli.main(["examples", "--out", assets, "--size", "96"])
+    trace = str(tmp_path_factory.mktemp("live_run") / "trace")
+    out = str(tmp_path_factory.mktemp("live_run_out") / "bp.png")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "image_analogies_tpu.cli", "synth",
+            "--a", os.path.join(assets, "texture_by_numbers_A.png"),
+            "--ap", os.path.join(assets, "texture_by_numbers_Ap.png"),
+            "--b", os.path.join(assets, "texture_by_numbers_B.png"),
+            "--out", out, "--levels", "3", "--matcher", "patchmatch",
+            "--em-iters", "1", "--pm-iters", "4", "--device", "cpu",
+            "--trace-dir", trace, "--metrics-port", "0",
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    result = {"trace": trace}
+    try:
+        # The live endpoint is announced at session start (before the
+        # heavy compiles), so live.json is the rendezvous.
+        live_path = os.path.join(trace, "live.json")
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if os.path.isfile(live_path) or proc.poll() is not None:
+                break
+            time.sleep(0.05)
+        assert os.path.isfile(live_path), (
+            "live.json never appeared (subprocess exited "
+            f"rc={proc.poll()} before announcing)"
+        )
+        with open(live_path) as f:
+            url = json.load(f)["url"]
+
+        # Scrape while the synth runs; keep polling /progress a little
+        # in case a level completes (not required — a scrape during
+        # compile is still "during a live synth").
+        result["metrics"] = _get(url + "/metrics")
+        result["healthz_code"] = None
+        try:
+            code, _, body = _get(url + "/healthz")
+            result["healthz_code"], result["healthz"] = code, body
+        except urllib.error.HTTPError as e:  # 503 on violated
+            result["healthz_code"] = e.code
+            result["healthz"] = e.read()
+        # Poll until the tracer shows life (open run span or a
+        # completed level): the SIGTERM below must land AFTER the
+        # first span events exist, or the (valid) flight dump would
+        # legitimately carry an empty window and the non-empty-events
+        # assertion would be a coin flip against profiler start-up.
+        prog_deadline = time.monotonic() + 20
+        while time.monotonic() < prog_deadline:
+            try:
+                _, ctype, body = _get(url + "/progress")
+            except (urllib.error.URLError, OSError):
+                break  # run finished between polls; keep the last scrape
+            result["progress"] = (ctype, body)
+            prog = json.loads(body)
+            if prog.get("stack") or prog.get("levels_done"):
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.25)
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+        try:
+            result["returncode"] = proc.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            result["returncode"] = proc.wait()
+    return result
+
+
+class TestLiveScrape:
+    def test_metrics_endpoint_wellformed(self, killed_run):
+        code, ctype, body = killed_run["metrics"]
+        assert code == 200
+        assert ctype.startswith("text/plain")
+        text = body.decode()
+        # Format 0.0.4 shape: every TYPE line names a known kind, and
+        # no family repeats its TYPE line.
+        type_lines = [
+            ln for ln in text.splitlines() if ln.startswith("# TYPE")
+        ]
+        for ln in type_lines:
+            assert ln.split()[-1] in ("counter", "gauge", "histogram")
+        assert len(type_lines) == len(set(type_lines))
+
+    def test_progress_endpoint_wellformed(self, killed_run):
+        ctype, body = killed_run["progress"]
+        assert ctype.startswith("application/json")
+        prog = json.loads(body)
+        for key in ("stack", "levels_done", "eta_s", "eta_basis",
+                    "levels_total"):
+            assert key in prog
+        # Mid-run the `run` span is open (the stack is the "where is
+        # it right now" answer).
+        assert any(sp["name"] == "run" for sp in prog["stack"])
+
+    def test_healthz_endpoint_wellformed(self, killed_run):
+        assert killed_run["healthz_code"] in (200, 503)
+        health = json.loads(killed_run["healthz"])
+        assert health["kind"] == "health"
+        assert health["context"] == "live"
+        by_name = {c["name"]: c for c in health["checks"]}
+        # Mid-run the span tree is legitimately open, so the live
+        # verdict must evaluate WITHOUT the end-of-run tree invariant.
+        assert by_name["span_tree"]["status"] == "skipped"
+
+
+class TestFlightDumpFromKilledRun:
+    def test_flight_json_exists_parses_validates(self, killed_run):
+        path = os.path.join(killed_run["trace"], "flight.json")
+        assert os.path.isfile(path), (
+            "SIGTERM'd run left no flight.json"
+        )
+        with open(path) as f:
+            dump = json.load(f)
+        assert validate_flight(dump) == []
+        assert dump["kind"] == "flight"
+        assert dump["events"], "flight dump carries no events"
+        # The whole-tool path the runbook uses: kind=flight dispatch.
+        assert check_report_main([path]) == 0
+
+    def test_killed_run_left_other_artifacts_parseable(self, killed_run):
+        """Epilogue artifacts are BEST-EFFORT on a kill (the SIGTERM
+        handler flushes the dump then re-delivers the signal — the
+        run may die before its epilogue), but any that DID land must
+        be complete JSON (the atomic-write satellite: tmp + rename
+        means no truncated files, ever)."""
+        trace = killed_run["trace"]
+        for name in ("host_spans.json", "metrics.json"):
+            p = os.path.join(trace, name)
+            if os.path.isfile(p):
+                with open(p) as f:
+                    json.load(f)  # must parse completely
+
+
+# ------------------------------------------------- in-process unit tests
+class TestFlightRecorder:
+    def _recorder(self, tmp_path, **kw):
+        reg = MetricsRegistry()
+        tracer = Tracer(registry=reg)
+        rec = FlightRecorder(
+            tracer, reg, str(tmp_path / "flight.json"), **kw
+        )
+        tracer.add_observer(rec.observe)
+        return tracer, reg, rec
+
+    def test_events_recorded_and_flushed(self, tmp_path):
+        tracer, reg, rec = self._recorder(tmp_path)
+        with tracer.span("run", matcher="patchmatch"):
+            with tracer.span("level", level=0) as sp:
+                sp.set(nnf_energy=0.5)
+            tracer.emit("resume", from_level=1)
+        rec.flush("manual")
+        dump = json.load(open(rec.path))
+        assert validate_flight(dump) == []
+        kinds = [(e["kind"], e["name"]) for e in dump["events"]]
+        assert ("open", "run") in kinds
+        assert ("close", "level") in kinds
+        assert ("mark", "resume") in kinds
+        close_level = next(
+            e for e in dump["events"]
+            if e["kind"] == "close" and e["name"] == "level"
+        )
+        assert close_level["attrs"]["nnf_energy"] == 0.5
+        assert close_level["wall_ms"] is not None
+
+    def test_ring_bounds_and_drop_accounting(self, tmp_path):
+        tracer, reg, rec = self._recorder(tmp_path, capacity=8)
+        for i in range(20):
+            tracer.annotate("em_iter", em=i)
+        dump = rec.to_dict("manual")
+        assert len(dump["events"]) == 8
+        assert dump["n_events_total"] == 20
+        assert dump["dropped_events"] == 12
+        # The window keeps the MOST RECENT events (flight-recorder
+        # semantics: the moments before death matter most).
+        assert dump["events"][-1]["attrs"]["em"] == 19
+        assert validate_flight(dump) == []
+
+    def test_flush_overwrites_atomically_with_reason(self, tmp_path):
+        tracer, reg, rec = self._recorder(tmp_path)
+        tracer.annotate("x")
+        rec.flush("manual")
+        rec.flush("violation")
+        dump = json.load(open(rec.path))
+        assert dump["flushed_on"] == "violation"
+        assert dump["n_flushes"] == 2
+        # No tmp litter left behind by the atomic writes.
+        assert [f for f in os.listdir(tmp_path)
+                if f.endswith(".tmp")] == []
+
+    def test_snapshots_capture_registry(self, tmp_path):
+        tracer, reg, rec = self._recorder(
+            tmp_path, snapshot_interval_s=0.0
+        )
+        reg.counter("c_total").inc(3)
+        tracer.annotate("tick")
+        dump = rec.to_dict("manual")
+        assert dump["snapshots"]
+        assert (
+            dump["snapshots"][-1]["metrics"]["c_total"]["values"]["total"]
+            == 3.0
+        )
+
+    def test_install_uninstall_restores_observers(self, tmp_path):
+        reg = MetricsRegistry()
+        tracer = Tracer(registry=reg)
+        rec = FlightRecorder(tracer, reg, str(tmp_path / "f.json"))
+        rec.install()
+        assert tracer._observers
+        rec.uninstall()
+        assert tracer._observers == []
+        # The teardown flush landed with the session-end reason.
+        dump = json.load(open(rec.path))
+        assert dump["flushed_on"] == "session-end"
+        assert validate_flight(dump) == []
+
+
+class TestLiveServerUnit:
+    def _serve(self, tracer, reg, flight=None):
+        return LiveTelemetryServer(
+            tracer, reg, port=0, flight=flight
+        ).start()
+
+    def test_unknown_path_404(self):
+        srv = self._serve(Tracer(), MetricsRegistry())
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv.url + "/nope")
+            assert err.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_healthz_violation_returns_503_and_flushes_flight(
+        self, tmp_path
+    ):
+        from image_analogies_tpu.telemetry.metrics import (
+            count_collectives,
+            set_registry,
+        )
+
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        try:
+            count_collectives(3, "bands")  # observed, no expectation
+        finally:
+            set_registry(prev)
+        tracer = Tracer(registry=reg)
+        rec = FlightRecorder(tracer, reg, str(tmp_path / "flight.json"))
+        srv = self._serve(tracer, reg, flight=rec)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv.url + "/healthz")
+            assert err.value.code == 503
+            health = json.loads(err.value.read())
+            assert health["verdict"] == "violated"
+        finally:
+            srv.stop()
+        # The violated live verdict preserved the evidence window.
+        dump = json.load(open(rec.path))
+        assert dump["flushed_on"] == "violation"
+        assert validate_flight(dump) == []
+
+    def test_metrics_endpoint_serves_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("req_total", "r").inc(2)
+        srv = self._serve(Tracer(registry=reg), reg)
+        try:
+            code, ctype, body = _get(srv.url + "/metrics")
+        finally:
+            srv.stop()
+        assert code == 200 and "version=0.0.4" in ctype
+        assert "req_total 2" in body.decode()
+
+
+class TestProgressSnapshot:
+    def _plan_tracer(self, walls):
+        tracer = Tracer()
+        tracer.annotate(
+            "run_plan", levels=3, shapes=[[64, 64], [32, 32], [16, 16]],
+            eta_cost_units={"0": 16.0, "1": 4.0, "2": 1.0},
+        )
+        for lvl, wall in walls.items():
+            tracer.record("level", wall, level=lvl, em_iters=1)
+        return tracer
+
+    def test_eta_from_cost_model(self):
+        prog = progress_snapshot(self._plan_tracer({2: 100.0}))
+        # rate = 0.1 s / 1 unit; remaining units 20 -> 2.0 s.
+        assert prog["eta_s"] == pytest.approx(2.0)
+        assert prog["eta_basis"] == "cost-model x measured rate"
+        assert prog["levels_remaining"] == [1, 0]
+        assert prog["levels_total"] == 3
+
+    def test_eta_shrinks_as_levels_complete(self):
+        prog = progress_snapshot(
+            self._plan_tracer({2: 100.0, 1: 400.0})
+        )
+        # rate = 0.5/5 = 0.1 s per unit; remaining 16 units -> 1.6 s.
+        assert prog["eta_s"] == pytest.approx(1.6)
+        assert prog["levels_remaining"] == [0]
+
+    def test_eta_pyramid_fallback_without_plan(self):
+        tracer = Tracer()
+        tracer.record("level", 100.0, level=2, em_iters=1)
+        prog = progress_snapshot(tracer)
+        # 4x per finer level: 0.1 * (4 + 16) = 2.0 s.
+        assert prog["eta_s"] == pytest.approx(2.0)
+        assert "pyramid" in prog["eta_basis"]
+
+    def test_no_completed_level_states_null(self):
+        prog = progress_snapshot(self._plan_tracer({}))
+        assert prog["eta_s"] is None
+        assert prog["eta_basis"] is None
+
+    def test_instrumented_run_declares_plan(self, rng):
+        """models/analogy.record_prologue (the ETA hook) declares a
+        run_plan whose cost units price every level — held against a
+        REAL instrumented single-device run."""
+        import jax.numpy as jnp
+
+        from image_analogies_tpu import create_image_analogy
+        from image_analogies_tpu.utils.examples import texture_by_numbers
+
+        cfg = SynthConfig(**_SYNTH_CFG)
+        a, ap, b = texture_by_numbers(128)
+        tracer = Tracer(registry=MetricsRegistry())
+        create_image_analogy(
+            *(jnp.asarray(x, jnp.float32) for x in (a, ap, b)),
+            cfg, progress=tracer,
+        )
+        (plan,) = tracer.find("run_plan")
+        assert plan.attrs["levels"] == 2
+        assert set(plan.attrs["eta_cost_units"]) == {"0", "1"}
+        assert (
+            plan.attrs["eta_cost_units"]["0"]
+            > plan.attrs["eta_cost_units"]["1"]
+        )
+        # A finished run's snapshot: nothing remaining, no ETA needed.
+        prog = progress_snapshot(tracer)
+        assert prog["levels_remaining"] == []
+        assert prog["levels_done"] == [1, 0]
+
+
+class TestValidateFlightWrapper:
+    def _valid(self, tmp_path):
+        reg = MetricsRegistry()
+        tracer = Tracer(registry=reg)
+        rec = FlightRecorder(tracer, reg, str(tmp_path / "f.json"))
+        tracer.add_observer(rec.observe)
+        with tracer.span("run"):
+            pass
+        return rec.to_dict("manual")
+
+    def test_valid_dump_passes(self, tmp_path):
+        assert validate_flight(self._valid(tmp_path)) == []
+
+    def test_bad_reason_fails(self, tmp_path):
+        dump = self._valid(tmp_path)
+        dump["flushed_on"] = "whim"
+        assert any("flushed_on" in e for e in validate_flight(dump))
+
+    def test_bad_event_kind_fails(self, tmp_path):
+        dump = self._valid(tmp_path)
+        dump["events"][0]["kind"] = "teleport"
+        assert any("kind" in e for e in validate_flight(dump))
+
+    def test_drop_accounting_mismatch_fails(self, tmp_path):
+        dump = self._valid(tmp_path)
+        dump["n_events_total"] += 1
+        assert any("accounting" in e for e in validate_flight(dump))
+
+    def test_missing_events_fails(self, tmp_path):
+        dump = self._valid(tmp_path)
+        del dump["events"]
+        assert any("events" in e for e in validate_flight(dump))
+
+    def test_cli_tool_dispatch_and_exit_codes(self, tmp_path):
+        good = str(tmp_path / "flight.json")
+        with open(good, "w") as f:
+            json.dump(self._valid(tmp_path), f)
+        assert check_report_main([good]) == 0
+        bad_dump = self._valid(tmp_path)
+        bad_dump["flushed_on"] = "whim"
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            json.dump(bad_dump, f)
+        assert check_report_main([bad]) == 1
+
+
+class TestLiveLayerOverhead:
+    def test_live_layer_under_budget(self, rng):
+        """ISSUE 5 acceptance: the live exporter + flight recorder
+        layer measured with the min-paired-delta harness (the
+        test_sentinel overhead discipline: load spikes on this 1-core
+        box are one-sided, so the MIN paired delta bounds the real
+        layer cost while a genuine regression shifts every pair) and
+        pinned under the shared 2% budget, published as the
+        `ia_live_telemetry_overhead_frac` gauge the sentinel's
+        telemetry_overhead check watches alongside
+        `ia_telemetry_overhead_frac`.
+
+        Both arms run the FULL span+metrics instrumentation; the live
+        arm adds what this round shipped — the recorder observing
+        every span event and the HTTP server thread idling alongside
+        (serving cost is borne per scrape; a same-core scraper would
+        measure the client, not the layer)."""
+        import jax.numpy as jnp
+
+        from image_analogies_tpu import create_image_analogy
+        from image_analogies_tpu.telemetry.metrics import get_registry
+        from image_analogies_tpu.telemetry.sentinel import (
+            OVERHEAD_BUDGET_FRAC,
+        )
+        from image_analogies_tpu.utils.examples import texture_by_numbers
+
+        cfg = SynthConfig(**_SYNTH_CFG)
+        a, ap, b = texture_by_numbers(128)
+        a, ap, b = (jnp.asarray(x, jnp.float32) for x in (a, ap, b))
+
+        # One long-lived exporter + recorder, exactly the session
+        # shape: the server and recorder are started ONCE per run in
+        # production (telemetry_session), so their spin-up/teardown is
+        # session cost, not per-level layer cost — the timed window
+        # measures the steady-state price of the observer notifying
+        # the ring buffer with the HTTP thread idling alongside.
+        import tempfile
+
+        base_tracer = Tracer(registry=MetricsRegistry())
+        live_reg = MetricsRegistry()
+        live_tracer = Tracer(registry=live_reg)
+
+        def run(tracer):
+            out = create_image_analogy(a, ap, b, cfg, progress=tracer)
+            return float(jnp.sum(out))
+
+        deltas, bases = [], []
+        with tempfile.TemporaryDirectory() as td:
+            rec = FlightRecorder(
+                live_tracer, live_reg, os.path.join(td, "flight.json")
+            )
+            live_tracer.add_observer(rec.observe)
+            srv = LiveTelemetryServer(
+                live_tracer, live_reg, port=0, flight=rec
+            )
+            srv.start()
+            try:
+                run(base_tracer)  # compile/warm (shared jit caches)
+                run(live_tracer)
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    run(base_tracer)
+                    base = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    run(live_tracer)
+                    full = time.perf_counter() - t0
+                    bases.append(base)
+                    deltas.append(full - base)
+            finally:
+                srv.stop()
+                live_tracer.remove_observer(rec.observe)
+                rec.flush("manual")
+        overhead = max(0.0, min(deltas) / statistics.median(bases))
+        get_registry().gauge(
+            "ia_live_telemetry_overhead_frac",
+            "measured live exporter + flight recorder cost as a "
+            "fraction of the synth wall (min paired delta, identical "
+            "span+metrics instrumentation on both arms)",
+        ).set(round(overhead, 4))
+        assert overhead < OVERHEAD_BUDGET_FRAC, (
+            f"live layer measured at {overhead:.2%} of wall — budget "
+            f"is {OVERHEAD_BUDGET_FRAC:.0%}"
+        )
+        # The published gauge is exactly what the sentinel watches.
+        health = evaluate_health(metrics=get_registry().to_dict())
+        by_name = {c["name"]: c for c in health["checks"]}
+        assert by_name["telemetry_overhead"]["status"] == "ok"
+        assert (
+            "ia_live_telemetry_overhead_frac"
+            in by_name["telemetry_overhead"]["observed"]
+        )

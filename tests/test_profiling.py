@@ -49,7 +49,12 @@ def test_span_tree_and_metrics_for_two_level_run(rng):
     assert run.wall_ms > 0.0
     assert run.attrs["matcher"] == "brute" and run.attrs["levels"] == 2
     child_names = [c.name for c in run.children]
-    assert child_names == ["prologue", "level", "level"]
+    # run_plan (round 10): the untimed mark declaring levels/shapes/
+    # ETA cost units for the live /progress endpoint.
+    assert child_names == ["prologue", "run_plan", "level", "level"]
+    (plan,) = tracer.find("run_plan")
+    assert plan.attrs["levels"] == 2
+    assert set(plan.attrs["eta_cost_units"]) == {"0", "1"}
 
     levels = tracer.find("level")
     assert [sp.attrs["level"] for sp in levels] == [1, 0]  # coarse->fine

@@ -129,7 +129,11 @@ class TestMetricsRegistry:
     def test_type_line_exactly_once_per_family(self):
         """`# TYPE` must appear exactly once per metric family even
         when the family fans out into labeled children (counter label
-        sets, histogram _bucket/_sum/_count series)."""
+        sets, histogram _bucket/_sum/_count series).  Round 10: a
+        histogram with observations additionally emits its DERIVED
+        `<name>_quantile` gauge family — its own family, its own
+        single TYPE line; the histogram family's children stay
+        exactly _bucket/_sum/_count."""
         reg = MetricsRegistry()
         c = reg.counter("req_total", "requests")
         for code in ("200", "404", "500"):
@@ -141,11 +145,76 @@ class TestMetricsRegistry:
         text = reg.to_prometheus()
         assert text.count("# TYPE req_total counter") == 1
         assert text.count("# TYPE lat_ms histogram") == 1
-        # No stray TYPE lines for the histogram's child series.
+        # No stray TYPE lines for the histogram's child series; the
+        # derived quantile family carries exactly one of its own.
         assert "# TYPE lat_ms_bucket" not in text
-        assert text.count("# TYPE") == 2
+        assert text.count("# TYPE lat_ms_quantile gauge") == 1
+        assert text.count("# TYPE") == 3
         # All six bucket series are present under the one family.
         assert text.count("lat_ms_bucket{") == 6
+        # p50/p99 per label set of the parent histogram.
+        assert text.count("lat_ms_quantile{") == 4
+
+    def test_quantile_interpolation(self):
+        """The derived p50/p99 values follow the PromQL
+        histogram_quantile estimator: linear interpolation inside the
+        cumulative bucket the rank lands in, from 0 for the first
+        bucket, clamped to the highest finite bound for ranks in
+        +Inf."""
+        reg = MetricsRegistry()
+        h = reg.histogram("h_ms", buckets=(10.0, 100.0))
+        for _ in range(8):
+            h.observe(5.0)   # le=10 bucket
+        for _ in range(2):
+            h.observe(50.0)  # le=100 bucket
+        # p50: rank 5 of 8 inside [0, 10) -> 10 * 5/8.
+        assert h.quantile(0.5) == pytest.approx(6.25)
+        # p99: rank 9.9, inside (10, 100]: 10 + 90 * (9.9-8)/2.
+        assert h.quantile(0.99) == pytest.approx(95.5)
+        h.observe(1e9)  # lands in +Inf: quantiles clamp, stated
+        assert h.quantile(0.99) == 100.0
+        assert reg.histogram("empty").quantile(0.5) is None
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+    def test_quantile_family_hostile_labels_round_trip(self):
+        """The derived family inherits the parent's label sets — which
+        may be hostile (backslash/quote/newline).  The exposition must
+        escape them per format 0.0.4 and the rendered label string
+        must parse back to the original labels plus the quantile
+        label."""
+        from image_analogies_tpu.telemetry.metrics import (
+            parse_label_str,
+        )
+
+        hostile = 'sl\\ab "q"\nband'
+        reg = MetricsRegistry()
+        reg.histogram("w_ms", buckets=(10.0,)).observe(
+            5.0, labels={"shard": hostile}
+        )
+        text = reg.to_prometheus()
+        qlines = [
+            ln for ln in text.splitlines()
+            if ln.startswith("w_ms_quantile{")
+        ]
+        assert len(qlines) == 2  # p50 + p99
+        for ln in qlines:
+            assert "\n" not in ln
+            labels = parse_label_str(ln[len("w_ms_quantile"):].rsplit(
+                " ", 1
+            )[0])
+            assert labels["shard"] == hostile
+            assert labels["quantile"] in ("0.5", "0.99")
+
+    def test_quantile_family_yields_to_real_metric(self):
+        """A REAL metric registered under `<hist>_quantile` wins: the
+        derived family is suppressed rather than printing two TYPE
+        lines for one family name."""
+        reg = MetricsRegistry()
+        reg.histogram("x_ms", buckets=(10.0,)).observe(5.0)
+        reg.gauge("x_ms_quantile").set(1.0)
+        text = reg.to_prometheus()
+        assert text.count("# TYPE x_ms_quantile") == 1
 
     def test_help_line_escapes_newlines(self):
         reg = MetricsRegistry()

@@ -12,7 +12,11 @@ config-1 row without its cross-backend correctness cell (VERDICT r5
 item 7), or — round 8 — a kernel section without the polish-phase
 byte fields (`kernel_bytes_per_polish*`, `polish_mode`,
 `kernel_polish_dma_efficiency`; see POLISH_r08.json and
-tools/check_polish.py for the round-8 artifact's own validator).
+tools/check_polish.py for the round-8 artifact's own validator), or —
+round 10 — malformed memory watermarks (`peak_host_rss_bytes` must be
+a positive byte count when present; `device_memory_peak_bytes` is
+null-or-positive, null meaning the backend exposed no PJRT memory
+stats).
 
 Accepts either the raw record bench.py prints or the driver's capture
 wrapper (`{"n": ..., "parsed": {...}}`).  Kernel-utilization fields are
@@ -84,6 +88,26 @@ def validate_bench(record: dict) -> List[str]:
         errs.append(f"device {record.get('device')!r} unknown")
     if not _num(record.get("psnr_vs_cpu_ref_db")):
         errs.append("psnr_vs_cpu_ref_db: missing or not a number")
+
+    # Round-10 memory watermarks: validated whenever present (pre-r10
+    # records legitimately lack them; a record that carries them must
+    # carry them sanely).  Host RSS is always measurable, so a present
+    # key must be a positive byte count; the device watermark is
+    # null-or-positive — a backend without PJRT memory stats states
+    # null rather than imputing (the check_report discipline).
+    rss = record.get("peak_host_rss_bytes")
+    if "peak_host_rss_bytes" in record and not (_num(rss) and rss > 0):
+        errs.append(
+            f"peak_host_rss_bytes {rss!r} is not a positive byte count"
+        )
+    dev_peak = record.get("device_memory_peak_bytes")
+    if "device_memory_peak_bytes" in record and dev_peak is not None and not (
+        _num(dev_peak) and dev_peak > 0
+    ):
+        errs.append(
+            f"device_memory_peak_bytes {dev_peak!r} is neither null "
+            "nor a positive byte count"
+        )
 
     configs = record.get("acceptance_configs")
     if not isinstance(configs, list) or not configs:

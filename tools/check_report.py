@@ -16,9 +16,17 @@ non-skipped check must state both `expected` and `observed`, and every
 check must carry the measured-vs-carried/modeled provenance field —
 a verdict computed over carried cells has to say so.
 
+A record with `"kind": "flight"` dispatches to `validate_flight`
+(round 10): the flight recorder's post-mortem dump
+(telemetry/flight.py — the artifact a SIGTERM'd/crashed run leaves)
+must carry a known flush reason, a well-formed bounded event window
+with a consistent drop count, and a metrics section that is either
+null or a registry exposition object.
+
 Usage:
     python tools/check_report.py path/to/report.json
     python tools/check_report.py path/to/health.json   # auto-detected
+    python tools/check_report.py path/to/flight.json   # auto-detected
     python tools/check_report.py --no-prologue report.json  # resumed
         runs skip the prologue span; relax that requirement only
 
@@ -35,6 +43,12 @@ from typing import List
 
 SCHEMA_VERSION = 1
 HEALTH_SCHEMA_VERSION = 1
+FLIGHT_SCHEMA_VERSION = 1
+
+_FLIGHT_REASONS = (
+    "sigterm", "sigint", "atexit", "violation", "session-end", "manual",
+)
+_FLIGHT_EVENT_KINDS = ("open", "close", "mark")
 
 _LEVEL_REQUIRED = ("level", "shape", "wall_ms", "nnf_energy",
                    "device_busy_ms")
@@ -117,6 +131,82 @@ def validate_health(health: dict) -> List[str]:
                 errs.append(
                     f"counts[{s!r}] {counts.get(s)!r} != {n} checks"
                 )
+    return errs
+
+
+def validate_flight(flight: dict) -> List[str]:
+    """Violations in a telemetry/flight.py flight.json (empty list =
+    valid).  The dump is the artifact of LAST resort — written from
+    signal handlers and atexit callbacks — so the validator holds it
+    to the full schema: a recorder that starts writing half-dumps must
+    fail tier-1, not be discovered during a real post-mortem."""
+    errs: List[str] = []
+    if not isinstance(flight, dict):
+        return ["flight record is not a JSON object"]
+    if flight.get("schema_version") != FLIGHT_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {flight.get('schema_version')!r} != "
+            f"{FLIGHT_SCHEMA_VERSION}"
+        )
+    if flight.get("kind") != "flight":
+        errs.append(f"kind {flight.get('kind')!r} != 'flight'")
+    reason = flight.get("flushed_on")
+    if reason not in _FLIGHT_REASONS:
+        errs.append(
+            f"flushed_on {reason!r} names none of {_FLIGHT_REASONS}"
+        )
+    if not isinstance(flight.get("ts"), str):
+        errs.append("ts: missing ISO-8601 flush timestamp")
+
+    events = flight.get("events")
+    if not isinstance(events, list):
+        errs.append("events: missing list")
+        events = []
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            errs.append(f"events[{i}]: not an object")
+            continue
+        if ev.get("kind") not in _FLIGHT_EVENT_KINDS:
+            errs.append(
+                f"events[{i}]: kind {ev.get('kind')!r} names none of "
+                f"{_FLIGHT_EVENT_KINDS}"
+            )
+        if not isinstance(ev.get("name"), str):
+            errs.append(f"events[{i}]: name is not a string")
+        if not isinstance(ev.get("t"), (int, float)):
+            errs.append(f"events[{i}]: t is not a number")
+
+    for key in ("capacity", "n_events_total", "dropped_events",
+                "n_flushes"):
+        v = flight.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"{key}: {v!r} is not a non-negative int")
+    n_total = flight.get("n_events_total")
+    dropped = flight.get("dropped_events")
+    if isinstance(n_total, int) and isinstance(dropped, int):
+        if n_total - dropped != len(events):
+            errs.append(
+                f"event accounting: n_events_total {n_total} - "
+                f"dropped_events {dropped} != {len(events)} events "
+                "in the window"
+            )
+
+    if not isinstance(flight.get("span_stack"), list):
+        errs.append("span_stack: missing list")
+    snapshots = flight.get("snapshots")
+    if not isinstance(snapshots, list):
+        errs.append("snapshots: missing list")
+    else:
+        for i, sn in enumerate(snapshots):
+            if not isinstance(sn, dict) or not isinstance(
+                sn.get("metrics"), dict
+            ):
+                errs.append(
+                    f"snapshots[{i}]: not a metrics snapshot object"
+                )
+    metrics = flight.get("metrics")
+    if metrics is not None and not isinstance(metrics, dict):
+        errs.append("metrics: neither null nor a registry exposition")
     return errs
 
 
@@ -215,6 +305,23 @@ def main(argv=None) -> int:
         print(f"check_report: cannot read {args.report}: {e}",
               file=sys.stderr)
         return 2
+    if isinstance(report, dict) and report.get("kind") == "flight":
+        errs = validate_flight(report)
+        if errs:
+            for e in errs:
+                print(f"check_report: {e}", file=sys.stderr)
+            print(
+                f"check_report: FAIL — {len(errs)} violation(s) in "
+                f"{args.report}", file=sys.stderr,
+            )
+            return 1
+        print(
+            f"check_report: OK — flight dump "
+            f"(flushed_on={report.get('flushed_on')!r}, "
+            f"{len(report.get('events', []))} event(s), "
+            f"{report.get('dropped_events')} dropped)"
+        )
+        return 0
     if isinstance(report, dict) and report.get("kind") == "health":
         errs = validate_health(report)
         if errs:
