@@ -279,18 +279,22 @@ def _kernel_util_fields(ms: float, ms_loop, ms_trace, meta):
     pytest wrapper) can exercise the REAL published-record builder on a
     CPU-built `sweep_setup` meta with a stand-in time."""
     from image_analogies_tpu.kernels.patchmatch_tile import (
+        _PRUNE_SAMPLES,
         K_TOTAL,
         LANE,
         candidate_dma_bytes_per_fetch,
+        coarse_dma_bytes_per_row,
         spec_groups,
     )
 
     specs, geom, n_bands = meta["specs"], meta["geom"], meta["n_bands"]
     n_chan = meta["n_chan"]
     thp, n_ty, n_tx = geom.thp, geom.n_ty, geom.n_tx
+    cand_dtype = meta.get("cand_dtype", "bf16")
+    prune = meta.get("prune")
 
     slot_bytes, useful_slot_bytes = candidate_dma_bytes_per_fetch(
-        n_chan, thp, meta["packed"]
+        n_chan, thp, meta["packed"], cand_dtype
     )
     tile_bytes = (n_chan + 6) * thp * LANE * 4  # B chans + 3 state in/out
     # Both the tile streaming AND the candidate-window DMAs repeat per
@@ -301,14 +305,36 @@ def _kernel_util_fields(ms: float, ms_loop, ms_trace, meta):
     # sweeps move ~0.69x of this (measured mean valid fraction 0.692
     # over a synthesis, 2026-08-01) for a ~1% time effect — the sweep
     # is eval-bound with the DMAs hidden at prefetch depth 6.
-    sweep_bytes = n_ty * n_tx * n_bands * (
-        tile_bytes + K_TOTAL * slot_bytes
+    # Round 11, the compressed path: with the PCA prune on, per tile
+    # every candidate pays _PRUNE_SAMPLES coarse projected-row fetches
+    # and only the top M survivors pay the exact window DMA —
+    # fetches x (coarse + survival x exact), the byte-model shape the
+    # compressed pipeline exists to buy (the sweep_setup harness masks
+    # cand_valid to the same M, so the timed kernel moves these bytes).
+    # NOTE the coarse term is PER SWEEP, not per band: prune_candidates
+    # ranks once per pm iteration and the same mask feeds every band
+    # call (models/patchmatch hoists it with cand_valid), so only the
+    # exact window fetches repeat per band — mirroring exactly what the
+    # ia_coarse_dma_* counters record, per the one-model discipline.
+    if prune:
+        k_dims, m_keep = prune
+        coarse_moved, coarse_useful = coarse_dma_bytes_per_row(k_dims)
+        cand_moved = m_keep * slot_bytes
+        cand_useful = m_keep * useful_slot_bytes
+        coarse_m = K_TOTAL * _PRUNE_SAMPLES * coarse_moved
+        coarse_u = K_TOTAL * _PRUNE_SAMPLES * coarse_useful
+    else:
+        cand_moved = K_TOTAL * slot_bytes
+        cand_useful = K_TOTAL * useful_slot_bytes
+        coarse_m = coarse_u = 0
+    sweep_bytes = n_ty * n_tx * (
+        n_bands * (tile_bytes + cand_moved) + coarse_m
     )
     # The window content actually consumed (2 lane blocks x C channels
     # per candidate; B/state tiles are all-useful): the numerator of
     # the candidate-DMA efficiency the packed layout exists to fix.
-    sweep_bytes_useful = n_ty * n_tx * n_bands * (
-        tile_bytes + K_TOTAL * useful_slot_bytes
+    sweep_bytes_useful = n_ty * n_tx * (
+        n_bands * (tile_bytes + cand_useful) + coarse_u
     )
     gbps = sweep_bytes / (ms / 1000) / 1e9
     vpu_flops, mxu_flops = _kernel_flops_per_sweep(specs, geom)
@@ -346,6 +372,17 @@ def _kernel_util_fields(ms: float, ms_loop, ms_trace, meta):
         ),
         "kernel_a_layout": (
             "packed-interleaved" if meta["packed"] else "unpacked"
+        ),
+        # Round-11 compressed-candidate fields: which mode the byte
+        # model above priced (and the timed harness ran).  Survival is
+        # the prune's M / K_TOTAL exact-fetch fraction (1.0 = every
+        # candidate exact-fetched, the uncompressed pipeline).
+        "kernel_cand_dtype": cand_dtype,
+        "kernel_cand_prune": (
+            f"{prune[0]}:{prune[1]}" if prune else "off"
+        ),
+        "kernel_prune_survival": (
+            round(prune[1] / K_TOTAL, 3) if prune else 1.0
         ),
         "kernel_sweep_ms": round(ms, 3),
         "kernel_sweep_ms_loop": ms_loop,
@@ -389,6 +426,9 @@ def _polish_fields(cfg, size: int):
     unpadded-feature-width fraction.  Schema enforced by
     tools/check_bench.py; the builder is exercised on CPU by
     tests/test_check_bench.py."""
+    from image_analogies_tpu.kernels.patchmatch_tile import (
+        resolve_cand_dtype,
+    )
     from image_analogies_tpu.kernels.polish_stream import (
         polish_dma_bytes_per_fetch,
         polish_eval_rows,
@@ -402,7 +442,19 @@ def _polish_fields(cfg, size: int):
     # coarse context block (level 0 always has a coarser level).
     d_feat = 2 * cfg.patch_size**2 + 2 * cfg.coarse_patch_size**2
     iters, n_random = _polish_schedule_for(cfg, size, size)
-    moved, useful = polish_dma_bytes_per_fetch(d_feat)
+    # Round 11: the per-fetch pricing follows the compression mode —
+    # bf16 rows (itemsize 2) on the default path, int8 rows + the
+    # per-patch scale on the compressed one (polish_dma_bytes_per_fetch).
+    # The jump-flood polish keeps its exact bf16 tables in EVERY mode
+    # (_polish_gather_fn does not reroute it — a rejected arm), so its
+    # record prices bf16 regardless of IA_CAND_DTYPE.
+    cand_dtype = (
+        resolve_cand_dtype()
+        if _POLISH_MODE in ("sequential", "stream")
+        else "bf16"
+    )
+    itemsize = 1 if cand_dtype == "int8" else 2
+    moved, useful = polish_dma_bytes_per_fetch(d_feat, itemsize, cand_dtype)
     rows = polish_eval_rows(size * size, iters, n_random)
     return {
         "polish_mode": _POLISH_MODE,
@@ -661,6 +713,35 @@ def _acceptance_configs(on_tpu: bool):
 
 
 def main() -> None:
+    # Round-11 compressed-candidate knobs (mirrors the CLI's flags):
+    # the bench runs — and its byte model prices — the selected mode,
+    # so a hardware A/B (tools/quant_ab.py) can drive this benchmark
+    # per arm without env plumbing.  Compressed-mode records' byte
+    # cells register as modeled in tools/check_trajectory.py and never
+    # set measured bars.
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="north-star 1024^2 synthesis benchmark"
+    )
+    ap.add_argument(
+        "--cand-dtype", default=None, choices=("bf16", "int8"),
+        help="candidate-table compression mode (default: module "
+        "default / IA_CAND_DTYPE)",
+    )
+    ap.add_argument(
+        "--pca-prune", default=None, metavar="K:M",
+        help="PCA coarse pre-prune spec, e.g. '16:8', or 'off' "
+        "(default: module default / IA_CAND_PRUNE)",
+    )
+    cli = ap.parse_args()
+    if cli.cand_dtype is not None or cli.pca_prune is not None:
+        from image_analogies_tpu.kernels.patchmatch_tile import (
+            set_cand_compression,
+        )
+
+        set_cand_compression(cli.cand_dtype, cli.pca_prune)
+
     import jax.numpy as jnp
 
     from image_analogies_tpu.utils.cache import enable_compilation_cache

@@ -57,6 +57,22 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
         help="project features to this many principal components before "
         "matching (Hertzmann-style PCA; default off)",
     )
+    p.add_argument(
+        "--cand-dtype", default=None, choices=("bf16", "int8"),
+        help="candidate-table compression mode (round 11): bf16 = the "
+        "uncompressed historical tables (default), int8 = quantized "
+        "sweep planes + per-patch-scaled polish rows, dequantized next "
+        "to the distance math.  Sets the process-wide kernel mode "
+        "(IA_CAND_DTYPE); quality pinned by the exact-NN oracle gates",
+    )
+    p.add_argument(
+        "--pca-prune", default=None, metavar="K:M",
+        help="PCA coarse-distance pre-prune (round 11): project "
+        "candidates to K dims through the level's pca_basis and "
+        "exact-fetch only the top M of each tile's shared candidates "
+        "per sweep (e.g. '16:8'); 'off' disables.  Sets the "
+        "process-wide kernel mode (IA_CAND_PRUNE)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
         "--feature-bytes-budget", type=int, default=None,
@@ -187,6 +203,21 @@ def _emit_health(tracer, trace_dir, context: str) -> None:
     print(render_health(health))
 
 
+def _apply_cand_compression(args) -> None:
+    """Install the --cand-dtype/--pca-prune knobs process-wide (they
+    are kernel module globals, not config fields — the _POLISH_MODE
+    rationale) before any level function compiles.  A malformed prune
+    spec fails at startup, before the (possibly large) images load."""
+    if args.cand_dtype is None and args.pca_prune is None:
+        return
+    from .kernels.patchmatch_tile import set_cand_compression
+
+    try:
+        set_cand_compression(args.cand_dtype, args.pca_prune)
+    except ValueError as e:
+        raise SystemExit(f"--cand-dtype/--pca-prune: {e}")
+
+
 def _select_device(device: str | None) -> None:
     from .utils.cache import enable_compilation_cache
 
@@ -199,6 +230,7 @@ def _select_device(device: str | None) -> None:
 
 
 def cmd_synth(args) -> int:
+    _apply_cand_compression(args)
     _select_device(args.device)
     from .models.analogy import create_image_analogy
     from .utils.io import load_image, save_image
@@ -309,6 +341,7 @@ def cmd_synth(args) -> int:
 
 
 def cmd_batch(args) -> int:
+    _apply_cand_compression(args)
     _select_device(args.device)
     import numpy as np
 

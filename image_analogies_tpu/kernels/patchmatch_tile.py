@@ -125,6 +125,124 @@ def resolve_packed(packed: Optional[bool] = None) -> bool:
     BEFORE entering any jit/lru cache so a flipped default (tests,
     layout A/B) can never hit a stale `None`-keyed compilation."""
     return _PACKED_DEFAULT if packed is None else bool(packed)
+
+
+# Candidate-table compression mode (round 11): "bf16" is the
+# UNCOMPRESSED historical representation — f32 sweep planes here, bf16
+# polish rows in kernels/polish_stream.py (the name tracks the polish
+# table's dtype, the site the selector was designed around) — and is
+# bit-identical to the pre-round-11 graphs by construction.  "int8"
+# stores both candidate tables quantized (this module's A planes on a
+# static [0, 1] affine grid, the polish rows with per-patch scale rows)
+# and dequantizes next to the distance math.  A module global with env
+# override, not a config knob, same rationale as _PACKED_DEFAULT /
+# _POLISH_MODE: the representation is a measured performance decision
+# both sides of the prepare/sweep contract must agree on.  Default
+# stays "bf16" pending the hardware A/B (tools/quant_ab.py,
+# QUANT_r11.json — no accelerator reachable in round 11).
+_CAND_DTYPES = ("bf16", "int8")
+_CAND_DTYPE = os.environ.get("IA_CAND_DTYPE", "bf16")
+
+# int8 A-plane affine grid: planes are normalized images (raw src/flt
+# channels and their repeat-upsampled coarse twins, all in [0, 1]), so
+# the quantization range is static — q = round(x*254) - 127, dequant
+# x^ = (q + 127) / 254; out-of-range values clip (quality is pinned by
+# the exact-metric merge + the dist-ratio/PSNR gates, not here).
+# Per-patch scale rows make no sense for a plane table (entries are
+# image columns, not patches); the per-patch scales live with the
+# polish row table (kernels/polish_stream.quantize_rows).
+_Q_SCALE = 254.0
+_Q_ZERO = 127.0
+
+
+def resolve_cand_dtype(cand_dtype: Optional[str] = None) -> str:
+    """`resolve_packed`-style single resolution point for the
+    candidate-table compression mode: explicit arg wins, else the
+    module default.  Resolve BEFORE any jit/lru cache."""
+    dt = _CAND_DTYPE if cand_dtype is None else cand_dtype
+    if dt not in _CAND_DTYPES:
+        raise ValueError(
+            f"cand_dtype {dt!r} names none of {_CAND_DTYPES}"
+        )
+    return dt
+
+
+def parse_prune(spec) -> Optional[Tuple[int, int]]:
+    """Parse a \"K:M\" PCA-prune spec (K = coarse PCA dims, M = exact
+    fetches that survive the coarse ranking per tile per sweep) to
+    (k, m), or None for off ("off"/""/None)."""
+    if spec in (None, "", "off"):
+        return None
+    if isinstance(spec, (tuple, list)):
+        k, m = spec
+    else:
+        try:
+            k_s, m_s = str(spec).split(":")
+            k, m = int(k_s), int(m_s)
+        except ValueError:
+            raise ValueError(
+                f"pca-prune spec {spec!r} is not 'K:M' (e.g. '16:8') "
+                "or 'off'"
+            ) from None
+    if not (1 <= k <= LANE):
+        raise ValueError(f"pca-prune K={k} outside [1, {LANE}]")
+    if not (1 <= m <= K_TOTAL):
+        raise ValueError(f"pca-prune M={m} outside [1, {K_TOTAL}]")
+    return int(k), int(m)
+
+
+# PCA coarse-distance pre-prune (round 11, stage 2): "off" or "K:M".
+# When on, the matcher projects candidate rows through a per-level
+# pca_basis to K dims, ranks each tile's K_TOTAL shared candidates by
+# coarse distance at _PRUNE_SAMPLES sample pixels, and zeroes
+# `cand_valid` for all but the top M — the kernel's existing
+# pl.when(ok) DMA skip then never moves the pruned candidates' bytes,
+# turning the byte model from fetches x bytes_per_fetch into
+# fetches x (coarse_bytes + survival x exact_bytes).  Default off
+# pending the hardware A/B (tools/quant_ab.py).
+_CAND_PRUNE = os.environ.get("IA_CAND_PRUNE", "off")
+
+
+def resolve_prune(prune=None) -> Optional[Tuple[int, int]]:
+    """Single resolution point for the PCA prune: explicit spec wins
+    (string or (k, m) tuple; "off"/None-tuple meaning off must be
+    passed as the string "off"), otherwise the module default."""
+    return parse_prune(_CAND_PRUNE if prune is None else prune)
+
+
+def set_cand_compression(cand_dtype: Optional[str] = None,
+                         prune=None) -> None:
+    """Install a compressed-candidate mode process-wide (the CLI's
+    --cand-dtype/--pca-prune flags, bench.py, tools/quant_ab.py):
+    validates, assigns the module globals, and clears the driver's
+    cached level/EM compilations so a flip can never reuse a stale
+    trace (the tools/polish_stream_ab.py discipline).  None leaves a
+    knob untouched."""
+    global _CAND_DTYPE, _CAND_PRUNE
+    if cand_dtype is not None:
+        _CAND_DTYPE = resolve_cand_dtype(cand_dtype)
+    if prune is not None:
+        parse_prune(prune)  # validate before assigning
+        _CAND_PRUNE = prune
+    if cand_dtype is not None or prune is not None:
+        from ..models import analogy as _an
+        from ..parallel import batch as _pb
+        from ..parallel import sharded_a as _psa
+        from ..parallel import spatial as _psp
+
+        # EVERY cached level/EM compilation resolves the mode at trace
+        # time, so all of them must drop — the parallel runners' lru
+        # entries included, or a flipped mode would silently reuse a
+        # stale arm's graphs (no dtype assert fires there: the cached
+        # fn prepared its own planes under the old mode).
+        for fn in (
+            _an._level_fn, _an._em_step_fn,
+            _pb._batch_step_fn_cached, _pb._lean_step_fn_cached,
+            _pb._batch_prologue_fn_cached, _pb._batch_level_fn_cached,
+            _psa._band_assemble_fn, _psa._sharded_level_fn,
+            _psp._reslab_fn, _psp._banded_lean_step_fn,
+        ):
+            fn.cache_clear()
 # Tile geometry: the padded tile is exactly one lane block wide so the
 # separable window never needs lane slicing.  P is the union halo of the
 # fine window (patch//2) and the dilated coarse window (2*(coarse//2)).
@@ -279,9 +397,18 @@ def prepare_a_planes(
     specs: Tuple[ChannelSpec, ...],
     n_bands: int = 1,
     packed: Optional[bool] = None,
+    cand_dtype: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, ...]:
     """A-side planes for the kernel: a tuple of `n_bands` arrays, each
     covering A rows [i*band_rows, (i+1)*band_rows) with window halos.
+
+    `cand_dtype` (resolved like `packed` — explicit wins, else the
+    module `_CAND_DTYPE`): "bf16" keeps the historical f32 planes;
+    "int8" stores each plane on the static [0, 1] affine grid
+    (q = round(x*_Q_SCALE) - _Q_ZERO, clipped) and the kernel
+    dequantizes next to its distance math.  Both sides of the
+    prepare/sweep contract must resolve the same mode (tile_sweep
+    asserts the array dtype against its resolved mode).
 
     Default (packed=True, round 7): (rows, Wq-1, 2C, 128) f32 where
     sublane 2c+b of entry q holds lane-block q+b of channel c, so ONE
@@ -321,15 +448,15 @@ def prepare_a_planes(
     """
     return _prepare_a_planes_jit(
         src, flt, src_coarse, flt_coarse, specs, n_bands,
-        resolve_packed(packed),
+        resolve_packed(packed), resolve_cand_dtype(cand_dtype),
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("specs", "n_bands", "packed")
+    jax.jit, static_argnames=("specs", "n_bands", "packed", "cand_dtype")
 )
 def _prepare_a_planes_jit(
-    src, flt, src_coarse, flt_coarse, specs, n_bands, packed,
+    src, flt, src_coarse, flt_coarse, specs, n_bands, packed, cand_dtype,
 ):
     p = halo_for(specs)
     chans = channel_images(src, flt, src_coarse, flt_coarse)
@@ -348,7 +475,14 @@ def _prepare_a_planes_jit(
         c = jnp.pad(
             c, ((p, pad_bottom), (p, wq * LANE - wa - p)), mode="edge"
         )
-        full.append(c.reshape(c.shape[0], wq, LANE).astype(jnp.float32))
+        c = c.astype(jnp.float32)
+        if cand_dtype == "int8":
+            # Static [0, 1] affine grid (edge padding replicates values,
+            # so padding and pointwise quantization commute).
+            c = jnp.clip(
+                jnp.round(c * _Q_SCALE - _Q_ZERO), -127.0, 127.0
+            ).astype(jnp.int8)
+        full.append(c.reshape(c.shape[0], wq, LANE))
     if packed:
         # Interleave (channel x adjacent-lane-block) on the sublane
         # axis: entry q's sublane 2c+b is channel c's lane-block q+b.
@@ -714,6 +848,7 @@ def _make_kernel(
     wa: int,
     coh_factor: float,
     packed: bool,
+    cand_dtype: str = "bf16",
 ):
     """The SMEM `band_ref` (row0, rows_own) selects the A row *band*
     this call can match into (global origin rows [row0, row0+rows_own));
@@ -852,6 +987,14 @@ def _make_kernel(
                     al = jnp.where(
                         lane < LANE - xr, rot[:, 0, :], rot[:, 1, :]
                     ).astype(jnp.float32)
+                    if cand_dtype == "int8":
+                        # Dequantize next to the distance math: the
+                        # slot holds the static-affine int8 grid
+                        # (prepare_a_planes); same formula as the
+                        # host-side dequant, so an int8 sweep is
+                        # bit-identical to the f32 sweep run on
+                        # dequantized planes (test-pinned).
+                        al = (al + _Q_ZERO) * (1.0 / _Q_SCALE)
                     dq = b_blk[c] - al
                     dq = dq * dq
                     acc = dq if acc is None else acc + dq
@@ -899,25 +1042,138 @@ def _make_kernel(
 
 
 def candidate_dma_bytes_per_fetch(
-    n_chan: int, thp: int, packed: Optional[bool] = None
+    n_chan: int, thp: int, packed: Optional[bool] = None,
+    cand_dtype: Optional[str] = None,
 ) -> Tuple[int, int]:
     """(moved, useful) HBM bytes of ONE candidate-window DMA.
 
     `useful` is the window content both layouts deliver: 2 lane blocks x
-    n_chan channels x thp rows of f32.  `moved` adds the physical
-    sublane pad of the fetched entry's trailing (sublanes, 128) f32
-    tile — packed fetches 1 entry of 2C sublanes (pad-free when C is a
-    multiple of 4), unpacked fetches 2 entries of C->8-padded sublanes.
+    n_chan channels x thp rows at the table itemsize.  `moved` adds the
+    physical sublane pad of the fetched entry's trailing
+    (sublanes, 128) tile — packed fetches 1 entry of 2C sublanes,
+    unpacked fetches 2 entries of C->granule-padded sublanes.  The
+    sublane granule is dtype-dependent: 8 for the f32 ("bf16" mode)
+    planes, 32 for int8 — which makes the int8 fetch TILE-GRANULE-BOUND
+    at the headline's 4 channels (2C=8 sublanes pad to 32, so moved
+    bytes exactly equal the f32 fetch; int8 only pays once 2C >= 32,
+    i.e. the steerable 16+-channel sets — recorded in QUANT_r11.json;
+    the compressed path's byte win at C=4 comes from the PCA prune).
     The ONE byte model shared by the kernel's telemetry counters and
     bench.py's roofline accounting, so the published efficiency claim
     and the observable counters cannot drift."""
     packed = resolve_packed(packed)
-    useful = thp * 2 * n_chan * LANE * 4
+    dt = resolve_cand_dtype(cand_dtype)
+    item = 1 if dt == "int8" else 4
+    gran = 32 if dt == "int8" else 8
+    useful = thp * 2 * n_chan * LANE * item
     if packed:
-        moved = thp * (-(-2 * n_chan // 8) * 8) * LANE * 4
+        moved = thp * (-(-2 * n_chan // gran) * gran) * LANE * item
     else:
-        moved = thp * 2 * (-(-n_chan // 8) * 8) * LANE * 4
+        moved = thp * 2 * (-(-n_chan // gran) * gran) * LANE * item
     return moved, useful
+
+
+# Sample pixels per tile for the coarse pre-prune ranking: the coarse
+# distance of a tile-shared candidate is the summed projected-feature
+# SSD at a 2x2 subgrid of quarter positions — one pixel is too noisy a
+# proxy for a 64x124 tile, a dense evaluation would defeat the prune.
+_PRUNE_SAMPLES = 4
+
+
+def coarse_dma_bytes_per_row(k: int, itemsize: int = 4) -> Tuple[int, int]:
+    """(moved, useful) HBM bytes of ONE coarse candidate-row fetch of
+    the (Na, k) PCA-projected table.  `useful` is the k projected dims
+    the ranking consumes; `moved` is the 128-lane-padded row XLA's
+    gather lowering transfers (a k<=128 table tiles to 128 lanes — the
+    same padded-row fact the polish model states).  The ONE coarse
+    byte model shared by the prune's telemetry counters, bench.py's
+    compressed sweep model, and the sentinel's coarse ledger."""
+    if not 0 < k <= LANE:
+        raise ValueError(f"coarse dims {k} outside (0, {LANE}]")
+    return LANE * itemsize, k * itemsize
+
+
+def tile_sample_positions(geom: TileGeometry, h: int, w: int):
+    """(qy, qx), each (n_ty, n_tx, _PRUNE_SAMPLES) int32: the absolute
+    B-image sample pixels the coarse prune ranks candidates at — a 2x2
+    quarter-position subgrid per tile, clipped to the image (edge tiles
+    sample their valid interior)."""
+    th, tw = geom.tile_h, geom.tile_w
+    sy = jnp.asarray([th // 4, th // 4, (3 * th) // 4, (3 * th) // 4])
+    sx = jnp.asarray([tw // 4, (3 * tw) // 4, tw // 4, (3 * tw) // 4])
+    qy = jnp.clip(
+        (jnp.arange(geom.n_ty) * th)[:, None, None] + sy[None, None, :],
+        0, h - 1,
+    )
+    qx = jnp.clip(
+        (jnp.arange(geom.n_tx) * tw)[None, :, None] + sx[None, None, :],
+        0, w - 1,
+    )
+    return (
+        jnp.broadcast_to(qy, (geom.n_ty, geom.n_tx, _PRUNE_SAMPLES)),
+        jnp.broadcast_to(qx, (geom.n_ty, geom.n_tx, _PRUNE_SAMPLES)),
+    )
+
+
+def prune_candidates(
+    cand_y: jnp.ndarray,
+    cand_x: jnp.ndarray,
+    cand_valid: jnp.ndarray,
+    proj_b_tiles: jnp.ndarray,
+    qy: jnp.ndarray,
+    qx: jnp.ndarray,
+    proj_a_flat: jnp.ndarray,
+    ha: int,
+    wa: int,
+    m_keep: int,
+) -> jnp.ndarray:
+    """PCA coarse-distance pre-prune (round 11, stage 2): rank each
+    tile's K_TOTAL shared candidate offsets by their summed projected-
+    feature SSD at the tile's sample pixels and return a cand_valid
+    mask keeping only the top `m_keep` — already-invalid (dedup/out-of-
+    range) candidates rank at +inf and never displace a valid one, so
+    when fewer than m_keep are valid all valid candidates survive.
+
+    The mask feeds tile_sweep's existing pl.when(ok) DMA skip, so a
+    pruned candidate's window bytes never move: the byte model becomes
+    K_TOTAL x coarse_row_bytes + m_keep x exact_fetch_bytes per tile.
+    The kappa split is positional and pruning never reorders slots, so
+    a surviving coherent candidate keeps its coherent accept factor.
+    `proj_b_tiles` is (n_ty, n_tx, S, k) — the projected B rows at
+    `tile_sample_positions` — and `proj_a_flat` the (Ha*Wa, k)
+    projected A table (ops/pca.py: same basis, fit on the A side).
+    Trace-time coarse-row counters mirror the candidate-DMA pair
+    (telemetry/sentinel.py coarse ledger)."""
+    from ..telemetry.metrics import (
+        count_coarse_dma_bytes,
+        count_coarse_dma_rows,
+    )
+
+    k = proj_a_flat.shape[-1]
+    itemsize = jnp.dtype(proj_a_flat.dtype).itemsize
+    py = jnp.clip(qy[..., None, :] + cand_y[..., :, None], 0, ha - 1)
+    px = jnp.clip(qx[..., None, :] + cand_x[..., :, None], 0, wa - 1)
+    n_rows = int(np.prod(py.shape))
+    moved, useful = coarse_dma_bytes_per_row(k, itemsize)
+    count_coarse_dma_bytes(
+        useful=n_rows * useful, padded=n_rows * (moved - useful)
+    )
+    count_coarse_dma_rows(n_rows, k, itemsize)
+    rows = jnp.take(
+        proj_a_flat, (py * wa + px).reshape(-1), axis=0
+    ).reshape(*py.shape, k)
+    diff = rows.astype(jnp.float32) - proj_b_tiles[..., None, :, :].astype(
+        jnp.float32
+    )
+    d = jnp.sum(diff * diff, axis=(-1, -2))  # (n_ty, n_tx, K_TOTAL)
+    d = jnp.where(cand_valid > 0, d, jnp.inf)
+    # Exact top-M via double argsort (rank of each slot in the coarse
+    # ordering); stable sort keeps earlier slots on ties, which biases
+    # survival toward the coherent end of the positional split.
+    order = jnp.argsort(d, axis=-1)
+    rank = jnp.argsort(order, axis=-1)
+    keep = rank < m_keep
+    return (keep & (cand_valid > 0)).astype(jnp.int32)
 
 
 def tile_sweep(
@@ -938,25 +1194,34 @@ def tile_sweep(
     coh_factor: float,
     interpret: bool = False,
     packed: Optional[bool] = None,
+    cand_dtype: Optional[str] = None,
+    cand_budget: Optional[int] = None,
 ):
     """One propagate+random-search sweep over every tile, against the A
     band described by `band` = (row0, rows_own) int32 (None: all of A).
 
-    `a_planes` is ONE f32 array from `prepare_a_planes` — built with the
-    SAME `packed` choice passed here (both default to the module layout,
-    `resolve_packed`); it stays in HBM (`memory_space=ANY`) and the
-    kernel DMA-streams each candidate's window from it.
+    `a_planes` is ONE array from `prepare_a_planes` — built with the
+    SAME `packed`/`cand_dtype` choices passed here (all default to the
+    module resolution points); it stays in HBM (`memory_space=ANY`) and
+    the kernel DMA-streams each candidate's window from it (int8 slots
+    dequantize in-kernel next to the distance math).
     `off_y/off_x/dist` are halo-blocked state planes; `dist` is carried
     in the kernel's metric across sweeps (monotone non-increasing per
     pixel).  `cand_valid` is the dedup mask the samplers produce (None:
     computed here — the samplers hoist it so multi-band callers don't
-    recompute it per band call).
+    recompute it per band call); a pruned mask (`prune_candidates`)
+    rides the same operand.  `cand_budget` is the STATIC per-tile
+    exact-fetch bound the mask enforces (the prune's M) — it only
+    prices the trace-time DMA counters (the runtime skip is the mask),
+    so the ledger stays exact on the compressed path.
     """
     return _tile_sweep_jit(
         a_planes, b_blocked, cand_y, cand_x, off_y, off_x, dist, band,
         cand_valid, specs=specs, geom=geom, ha=ha, wa=wa,
         coh_factor=coh_factor, interpret=interpret,
         packed=resolve_packed(packed),
+        cand_dtype=resolve_cand_dtype(cand_dtype),
+        cand_budget=cand_budget,
     )
 
 
@@ -964,11 +1229,13 @@ def tile_sweep(
     jax.jit,
     static_argnames=(
         "specs", "geom", "ha", "wa", "coh_factor", "interpret", "packed",
+        "cand_dtype", "cand_budget",
     ),
 )
 def _tile_sweep_jit(
     a_planes, b_blocked, cand_y, cand_x, off_y, off_x, dist, band,
     cand_valid, *, specs, geom, ha, wa, coh_factor, interpret, packed,
+    cand_dtype, cand_budget,
 ):
     from ..telemetry.metrics import (
         count_candidate_dma_bytes,
@@ -978,27 +1245,42 @@ def _tile_sweep_jit(
 
     count_kernel_launch("tile_sweep")  # trace-time count (see helper)
 
+    expect_dtype = jnp.int8 if cand_dtype == "int8" else jnp.float32
+    if a_planes.dtype != expect_dtype:
+        raise ValueError(
+            f"a_planes dtype {a_planes.dtype} does not match cand_dtype "
+            f"{cand_dtype!r} (expected {expect_dtype.__name__}) — both "
+            "sides of the prepare/sweep contract must resolve the same "
+            "compression mode"
+        )
     thp = geom.thp
     n_ty, n_tx = geom.n_ty, geom.n_tx
     # True channel count comes from the spec (the packed layout's
     # sublane axis is 2C, so a_planes.shape[2] is NOT the channel count
     # there).
     n_chan = len(specs)
-    # Useful vs padded candidate-DMA bytes of this traced sweep (all
-    # K_TOTAL fetches counted — the runtime pl.when(ok) skip makes the
-    # moved figure an upper bound for production sweeps, exact for the
-    # all-valid bench harness; same caveat as the bench byte model).
-    moved_b, useful_b = candidate_dma_bytes_per_fetch(n_chan, thp, packed)
+    # Useful vs padded candidate-DMA bytes of this traced sweep, priced
+    # at the resolved table dtype over the per-tile exact-fetch budget
+    # (K_TOTAL, or the prune's M when a cand_budget is declared — the
+    # runtime pl.when(ok) skip makes the moved figure an upper bound
+    # for production sweeps, exact for the all-valid bench harness;
+    # same caveat as the bench byte model).
+    budget = K_TOTAL if cand_budget is None else min(cand_budget, K_TOTAL)
+    n_fetch = n_ty * n_tx * budget
+    moved_b, useful_b = candidate_dma_bytes_per_fetch(
+        n_chan, thp, packed, cand_dtype
+    )
     count_candidate_dma_bytes(
-        useful=n_ty * n_tx * K_TOTAL * useful_b,
-        padded=n_ty * n_tx * K_TOTAL * (moved_b - useful_b),
+        useful=n_fetch * useful_b,
+        padded=n_fetch * (moved_b - useful_b),
+        dtype=cand_dtype,
     )
     # Structural twin of the byte counter: the fetch count plus the
     # geometry that prices a fetch, so the run sentinel can recompute
     # the expected bytes from the shared model and hold the two series
     # together (telemetry/sentinel.py candidate-DMA check).
     count_candidate_dma_fetches(
-        n_ty * n_tx * K_TOTAL, n_chan, thp, resolve_packed(packed)
+        n_fetch, n_chan, thp, resolve_packed(packed), cand_dtype
     )
     if band is None:
         band = jnp.asarray([0, ha], jnp.int32)
@@ -1025,7 +1307,9 @@ def _tile_sweep_jit(
     wx = jnp.asarray(wx_np)
     wy = jnp.asarray(wy_np)
 
-    kernel = _make_kernel(specs, geom, ha, wa, coh_factor, packed)
+    kernel = _make_kernel(
+        specs, geom, ha, wa, coh_factor, packed, cand_dtype
+    )
     state_blk = lambda i, j: (i, j)  # noqa: E731
     out = pl.pallas_call(
         kernel,
@@ -1085,12 +1369,13 @@ def _tile_sweep_jit(
         scratch_shapes=[
             # Candidate-window DMA slots, shaped to match the fetch:
             # packed = one (thp, 1, 2C, LANE) entry per candidate,
-            # unpacked = the two-block (thp, 2, C, LANE) window.
+            # unpacked = the two-block (thp, 2, C, LANE) window; dtype
+            # follows the table (int8 slots dequantize in-kernel).
             pltpu.VMEM(
                 (_PREFETCH_DEPTH, thp, 1, 2 * n_chan, LANE)
                 if packed
                 else (_PREFETCH_DEPTH, thp, 2, n_chan, LANE),
-                jnp.float32,
+                jnp.int8 if cand_dtype == "int8" else jnp.float32,
             ),
             pltpu.SemaphoreType.DMA((_PREFETCH_DEPTH,)),
         ],
@@ -1107,6 +1392,7 @@ def _tile_sweep_jit(
 def vmem_estimate(
     specs, ha: int, wa: int, n_bands: int = 1,
     packed: Optional[bool] = None,
+    cand_dtype: Optional[str] = None,
 ) -> int:
     """PHYSICAL bytes one prepared A band array occupies in HBM (f32
     planes, trailing-tile sublane pad included), with the TILE_H-1
@@ -1120,6 +1406,9 @@ def vmem_estimate(
     Wq entry of equal (packing re-uses the pad the old layout already
     paid, it does not grow residency)."""
     packed = resolve_packed(packed)
+    dt = resolve_cand_dtype(cand_dtype)
+    item = 1 if dt == "int8" else 4
+    gran = 32 if dt == "int8" else 8
     p = halo_for(specs)
     wq = -(-(wa + 2 * p) // LANE) + 1
     geom = tile_geometry(ha, wa, specs)
@@ -1128,11 +1417,14 @@ def vmem_estimate(
     rows = band_rows(ha, n_bands) + overlap + 2 * p + extra
     n_chan = len(specs)
     if packed:
-        return rows * (wq - 1) * (-(-2 * n_chan // 8) * 8) * LANE * 4
-    return rows * wq * (-(-n_chan // 8) * 8) * LANE * 4
+        return (
+            rows * (wq - 1) * (-(-2 * n_chan // gran) * gran) * LANE * item
+        )
+    return rows * wq * (-(-n_chan // gran) * gran) * LANE * item
 
 
-def kernel_vmem(specs, packed: Optional[bool] = None) -> int:
+def kernel_vmem(specs, packed: Optional[bool] = None,
+                cand_dtype: Optional[str] = None) -> int:
     """Static estimate of the kernel's VMEM per grid step (the A side is
     HBM-resident since the round-4 redesign, so this is the WHOLE VMEM
     story):
@@ -1160,7 +1452,9 @@ def kernel_vmem(specs, packed: Optional[bool] = None) -> int:
     n_groups = len(spec_groups(specs))
     b_tiles = n_chan * plane * 3        # 2x pipeline buffers + f32 copy
     state = 6 * plane * 2               # 3 in + 3 out, double-buffered
-    slot_bytes, _ = candidate_dma_bytes_per_fetch(n_chan, thp, packed)
+    slot_bytes, _ = candidate_dma_bytes_per_fetch(
+        n_chan, thp, packed, cand_dtype
+    )
     slots = _PREFETCH_DEPTH * slot_bytes
     temps = 10 * plane                  # rotate/select/dq/matmul/chains
     wmats = n_groups * (LANE * LANE + thp * LANE) * 4

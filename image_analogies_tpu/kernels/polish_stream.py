@@ -105,8 +105,16 @@ def prepare_polish_table(f_a_tab: jnp.ndarray) -> jnp.ndarray:
     return jnp.pad(f_a_tab, ((0, 0), (0, LANE - d)))
 
 
+# Per-patch scale row cost of the int8 quantized table (round 11,
+# stage 1): one f32 scale gathered per candidate row, dequantizing the
+# row next to the distance math.  The scale is useful bytes — the
+# distance sum consumes it — and rides the row fetch's pricing so the
+# ledger stays one joinable pair per mode.
+_SCALE_BYTES = 4
+
+
 def polish_dma_bytes_per_fetch(
-    d_useful: int, itemsize: int = 2
+    d_useful: int, itemsize: int = 2, cand_dtype: str = "bf16"
 ) -> Tuple[int, int]:
     """(moved, useful) HBM bytes of ONE candidate-row fetch.
 
@@ -114,13 +122,39 @@ def polish_dma_bytes_per_fetch(
     identical for the streamed DMA and for XLA's gather lowering (both
     move the padded row; the streamed path changes the RATE, not the
     bytes).  `useful` is the unpadded feature width the distance sum
-    consumes.  The ONE byte model shared by the kernel's telemetry
-    counter (`ia_polish_dma_bytes_total`), bench.py's
+    consumes.  `cand_dtype="int8"` (round 11) prices the quantized
+    table: itemsize-1 rows plus the per-patch f32 scale row each fetch
+    dequantizes with (`_SCALE_BYTES`, counted on both sides — the
+    scale is consumed) — 256 B bf16 rows become 132 B, a ~1.94x cut of
+    the polish's dominant traffic term.  Widths past LANE price at the
+    next 128-lane multiple (round 11: a (N, D) table lane-pads per
+    128-lane tile; the STREAMED table stays capped at one lane block —
+    prepare_polish_table — but the XLA take paths gather wide rows,
+    the int8 take engine included).  The ONE byte model shared by
+    the telemetry counters (`ia_polish_dma_bytes_total`), bench.py's
     `kernel_bytes_per_polish*` fields, and tools/check_polish.py —
     same discipline as `candidate_dma_bytes_per_fetch` (round 7)."""
-    if not 0 < d_useful <= LANE:
-        raise ValueError(f"d_useful {d_useful} outside (0, {LANE}]")
-    return LANE * itemsize, d_useful * itemsize
+    if d_useful <= 0:
+        raise ValueError(f"d_useful {d_useful} must be positive")
+    scale = _SCALE_BYTES if cand_dtype == "int8" else 0
+    lanes = -(-d_useful // LANE) * LANE
+    return lanes * itemsize + scale, d_useful * itemsize + scale
+
+
+def quantize_rows(tab: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(N, D) feature table -> ((N, D) int8, (N, 1) f32 per-patch scale
+    rows): symmetric per-row quantization q = round(x / s), s =
+    max|row| / 127 — each patch's feature row keeps its own dynamic
+    range (rows are windowed patch vectors with heterogeneous norms,
+    unlike the A planes' globally-normalized images).  Dequant is
+    q * s next to the distance math (models/patchmatch's polish
+    gather_fn), so the error per element is bounded by s/2."""
+    x = tab.astype(jnp.float32)
+    s = jnp.maximum(
+        jnp.max(jnp.abs(x), axis=1, keepdims=True), 1e-12
+    ) * (1.0 / 127.0)
+    q = jnp.clip(jnp.round(x / s), -127.0, 127.0).astype(jnp.int8)
+    return q, s
 
 
 def polish_eval_rows(
@@ -214,6 +248,7 @@ def gather_rows(
     interpret: bool = False,
     useful_width: Optional[int] = None,
     rows_per_block: Optional[int] = None,
+    cand_dtype: str = "bf16",
 ) -> jnp.ndarray:
     """DMA-streamed row gather: rows `idx` (any shape, flattened) of
     the (Na, LANE) padded table, returned as (idx.size, LANE) in
@@ -223,7 +258,12 @@ def gather_rows(
 
     `useful_width` (the unpadded feature width) feeds the trace-time
     `ia_polish_dma_bytes_total` counter; None counts the whole row as
-    useful.  Out-of-range indices are clamped (callers already clip —
+    useful.  `cand_dtype` labels and prices the counters: "int8"
+    (round 11, the quantized table) adds the per-patch scale row each
+    fetch dequantizes with to BOTH sides of the pricing — the caller
+    gathers the scales beside this kernel's rows (one site owns the
+    whole mode's ledger, so counter and model cannot drift).
+    Out-of-range indices are clamped (callers already clip —
     this mirrors jnp.take's TPU clamp semantics defensively)."""
     from ..telemetry.metrics import (
         count_polish_dma_bytes,
@@ -243,9 +283,11 @@ def gather_rows(
     moved_b, useful_b = polish_dma_bytes_per_fetch(
         useful_width if useful_width is not None else LANE,
         jnp.dtype(f_a_pad.dtype).itemsize,
+        cand_dtype,
     )
     count_polish_dma_bytes(
-        useful=m * useful_b, padded=m * (moved_b - useful_b)
+        useful=m * useful_b, padded=m * (moved_b - useful_b),
+        dtype=cand_dtype,
     )
     # Structural twin: row count + fetch pricing, so the run sentinel
     # can recompute the expected bytes from the shared model
@@ -254,6 +296,7 @@ def gather_rows(
         m,
         useful_width if useful_width is not None else LANE,
         jnp.dtype(f_a_pad.dtype).itemsize,
+        cand_dtype,
     )
     pad = n_blocks * rows - m
     if pad:

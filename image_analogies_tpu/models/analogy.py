@@ -1192,19 +1192,28 @@ def _record_level_telemetry(tracer, cfg: SynthConfig, level: int,
     em_iters per executed level, one level per level.
     """
     from . import patchmatch as _pm_mod
+    from ..kernels import patchmatch_tile as _pt_mod
 
     # Declare the expected EM-child count on the span itself so the
     # run sentinel's span-tree completeness check (telemetry/sentinel)
     # can hold children == declaration without knowing the config.
     lvl_span.set(em_iters=cfg.em_iters)
+    prune = _pt_mod.resolve_prune()
     for em in range(cfg.em_iters):
         # polish_mode: which polish engine the matcher compiled in
         # (models/patchmatch._POLISH_MODE — sequential cascade, jump
         # flood, or the round-8 DMA stream); recorded per em_iter so a
         # report from an A/B run says which arm it measured.
+        # cand_dtype/cand_prune (round 11): the compressed-candidate
+        # mode the matcher compiled in — same rationale, the A/B
+        # record must say which arm a span measured.
         em_sp = tracer.annotate(
             "em_iter", parent=lvl_span, em=em, fused=plan.fuse,
             polish_mode=_pm_mod._POLISH_MODE,
+            cand_dtype=_pt_mod.resolve_cand_dtype(),
+            cand_prune=(
+                "off" if prune is None else f"{prune[0]}:{prune[1]}"
+            ),
         )
         for phase in ("assemble", "match", "render"):
             tracer.annotate(phase, parent=em_sp)

@@ -215,6 +215,9 @@ def tile_patchmatch(
     from ..kernels.patchmatch_tile import (
         band_bounds,
         channel_images,
+        prune_candidates,
+        resolve_cand_dtype,
+        resolve_prune,
         sample_candidates_blocked,
         tile_geometry,
         tile_sweep,
@@ -232,6 +235,14 @@ def tile_patchmatch(
     pm_iters = _pm_iters_for(cfg, ha, wa)
     polish_iters, polish_random = _polish_schedule_for(
         cfg, ha, wa, polish_iters
+    )
+    # Round-11 compressed-candidate pipeline: both knobs resolve ONCE
+    # per call (the resolve_packed discipline) so driver-prepared
+    # a_planes and the sweeps below agree on the mode.
+    cand_dtype = resolve_cand_dtype()
+    prune = resolve_prune()
+    prune_state = _prune_setup(
+        prune, f_b.reshape(-1, f_b.shape[-1]), f_a_flat, geom, h, w
     )
     # bf16 accept-metric tables (see docstring); candidate_dist does its
     # math in f32 after the gather, so only quantization enters.
@@ -277,6 +288,15 @@ def tile_patchmatch(
         cand_y, cand_x, cand_valid = sample_candidates_blocked(
             oy_b, ox_b, jax.random.fold_in(key, t), geom, ha, wa
         )
+        if prune_state is not None:
+            # Stage-2 coarse pre-prune: only the top-M candidates by
+            # projected distance keep a valid mask, so the kernel's
+            # pl.when(ok) skip never moves the rest's window bytes.
+            proj_b_tiles, qy_s, qx_s, proj_a, m_keep = prune_state
+            cand_valid = prune_candidates(
+                cand_y, cand_x, cand_valid, proj_b_tiles, qy_s, qx_s,
+                proj_a, ha, wa, m_keep,
+            )
         # One call per A band; the carried per-pixel best makes the union
         # over bands a global search (single call when A fits VMEM).
         for band_planes, band in zip(raw.a_planes, bounds):
@@ -284,7 +304,8 @@ def tile_patchmatch(
                 band_planes, b_blocked, cand_y, cand_x, oy_b, ox_b, d_b,
                 band, cand_valid,
                 specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=coh,
-                interpret=interpret,
+                interpret=interpret, cand_dtype=cand_dtype,
+                cand_budget=prune[1] if prune else None,
             )
     off_y = from_blocked(oy_b, geom, h, w)
     off_x = from_blocked(ox_b, geom, h, w)
@@ -306,14 +327,12 @@ def tile_patchmatch(
     # sequential cascade (_POLISH_MODE — the A/B at the selector's
     # definition); "stream" is the SAME cascade with the row fetches
     # routed through the Pallas DMA gather (bit-identical output;
-    # only the engine differs); random-probe count comes from the
-    # scale-aware schedule above.
+    # only the engine differs); _CAND_DTYPE="int8" swaps the row table
+    # for the per-patch-quantized one (_polish_gather_fn) on either
+    # engine; random-probe count comes from the scale-aware schedule
+    # above.
     if _POLISH_MODE in ("sequential", "stream"):
-        gf = (
-            _stream_gather_fn(f_a16_flat, f_a16.shape[-1], interpret)
-            if _POLISH_MODE == "stream"
-            else None
-        )
+        gf = _polish_gather_fn(f_a16_flat, f_a16.shape[-1], interpret)
         nnf_p, d_p = patchmatch_sweeps(
             f_b16,
             f_a16,
@@ -539,6 +558,104 @@ def _stream_gather_fn(f_a_tab: jnp.ndarray, d_useful: int,
     return lambda _tab, ix: gather_rows(
         f_a_pad, ix, interpret=interpret, useful_width=d_useful
     )
+
+
+def _polish_gather_fn(f_a_tab: jnp.ndarray, d_useful: int,
+                      interpret: bool):
+    """Polish candidate-row gather engine under the
+    (_POLISH_MODE, _CAND_DTYPE) pair — None means the default
+    `jnp.take` (bf16 + sequential: today's graph, bit-identical).
+
+    "int8" (round 11, stage 1): the per-patch-quantized row table
+    (kernels/polish_stream.quantize_rows) with the fetched rows
+    dequantized right next to the distance math — candidate_dist's f32
+    accumulation sees q * scale rows, so only the quantization enters
+    the accept metric (the exact-metric re-rank downstream is
+    untouched; quality is pinned by the dist-ratio/PSNR proxy gates).
+    Under "stream" the int8 rows ride the Pallas DMA gather (half the
+    bf16 row bytes plus the scale — polish_dma_bytes_per_fetch); under
+    "sequential" the XLA take path fetches the same rows and THIS
+    closure books the same counters, so the sentinel's polish ledger
+    stays exact in every mode.  NOTE: the jump-flood polish
+    (polish_sweeps_planes) keeps its exact tables — _CAND_DTYPE does
+    not reroute it (the mode lost its A/B; compressing a rejected arm
+    buys nothing)."""
+    from ..kernels.patchmatch_tile import resolve_cand_dtype
+    from ..kernels.polish_stream import (
+        gather_rows,
+        polish_dma_bytes_per_fetch,
+        prepare_polish_table,
+        quantize_rows,
+    )
+
+    cand_dtype = resolve_cand_dtype()
+    stream = _POLISH_MODE == "stream"
+    if cand_dtype != "int8":
+        return (
+            _stream_gather_fn(f_a_tab, d_useful, interpret)
+            if stream else None
+        )
+    q_tab, scales = quantize_rows(f_a_tab)
+    if stream:
+        q_pad = prepare_polish_table(q_tab)
+
+        def gf(_tab, ix):
+            rows = gather_rows(
+                q_pad, ix, interpret=interpret, useful_width=d_useful,
+                cand_dtype="int8",
+            )
+            s = jnp.take(scales, ix.reshape(-1), axis=0)
+            return rows.astype(jnp.float32) * s
+
+        return gf
+
+    def gf(_tab, ix):
+        from ..telemetry.metrics import (
+            count_polish_dma_bytes,
+            count_polish_dma_rows,
+        )
+
+        flat = ix.reshape(-1)
+        m = flat.shape[0]
+        moved, useful = polish_dma_bytes_per_fetch(d_useful, 1, "int8")
+        count_polish_dma_bytes(
+            useful=m * useful, padded=m * (moved - useful), dtype="int8"
+        )
+        count_polish_dma_rows(m, d_useful, 1, "int8")
+        rows = jnp.take(q_tab, flat, axis=0).astype(jnp.float32)
+        return rows * jnp.take(scales, flat, axis=0)
+
+    return gf
+
+
+def _prune_setup(prune, f_b_flat, f_a_flat, geom, h, w):
+    """Per-call coarse-prune state (round 11, stage 2), or None when
+    the prune is off: fit the level's pca_basis on the A-side table
+    (ops/pca.py — the Hertzmann §3.1 machinery the repo already
+    carries), project both sides to the prune's k dims, and precompute
+    the per-tile sample-pixel rows the per-sweep ranking compares
+    against (kernels.patchmatch_tile.prune_candidates)."""
+    if prune is None:
+        return None
+    from ..kernels.patchmatch_tile import tile_sample_positions
+    from ..ops.pca import pca_basis, project
+
+    k_dims, m_keep = prune
+    # Width comes from the B side (the candidate_dist rule): a wider A
+    # table only carries zero pad columns, which must not enter the
+    # basis fit.
+    d = f_b_flat.shape[-1]
+    f_a_flat = jax.lax.slice(
+        f_a_flat, (0, 0), (f_a_flat.shape[0], d)
+    )
+    basis = pca_basis(f_a_flat.astype(jnp.float32), k_dims)
+    proj_a = project(f_a_flat.astype(jnp.float32), basis)
+    proj_b = project(f_b_flat.astype(jnp.float32), basis)
+    qy, qx = tile_sample_positions(geom, h, w)
+    proj_b_tiles = jnp.take(
+        proj_b, (qy * w + qx).reshape(-1), axis=0
+    ).reshape(*qy.shape, proj_b.shape[-1])
+    return proj_b_tiles, qy, qx, proj_a, m_keep
 
 
 def _lex_min(d: jnp.ndarray, idx: jnp.ndarray):
@@ -774,6 +891,9 @@ def tile_patchmatch_lean(
     from ..kernels.patchmatch_tile import (
         band_bounds,
         channel_images,
+        prune_candidates,
+        resolve_cand_dtype,
+        resolve_prune,
         sample_candidates_blocked,
         tile_geometry,
         tile_sweep,
@@ -797,6 +917,15 @@ def tile_patchmatch_lean(
     # separate (unprobed) composition — those callers keep the XLA
     # cascade.
     default_dist = dist_fn is None
+    cand_dtype = resolve_cand_dtype()
+    # The coarse prune follows the same rule as the stream hook: a
+    # caller-supplied dist_fn means f_a_tab is a shard-LOCAL table
+    # (parallel/sharded_a.py) while candidates index global A — a
+    # local basis fit would rank against the wrong rows, so sharded
+    # callers keep the full candidate set (composition unprobed,
+    # recorded in QUANT_r11.json).
+    prune = resolve_prune() if default_dist else None
+    prune_state = _prune_setup(prune, f_b_tab, f_a_tab, geom, h, w)
     if default_dist:
         dist_fn = lambda idx: candidate_dist_lean(  # noqa: E731
             f_b_tab, f_a_tab, idx
@@ -832,12 +961,19 @@ def tile_patchmatch_lean(
         cand_y, cand_x, cand_valid = sample_candidates_blocked(
             oy_b, ox_b, jax.random.fold_in(key, t), geom, ha, wa
         )
+        if prune_state is not None:
+            proj_b_tiles, qy_s, qx_s, proj_a, m_keep = prune_state
+            cand_valid = prune_candidates(
+                cand_y, cand_x, cand_valid, proj_b_tiles, qy_s, qx_s,
+                proj_a, ha, wa, m_keep,
+            )
         for band_planes, band in zip(raw.a_planes, bounds):
             oy_b, ox_b, d_b = tile_sweep(
                 band_planes, b_blocked, cand_y, cand_x, oy_b, ox_b, d_b,
                 band, cand_valid,
                 specs=specs, geom=geom, ha=ha, wa=wa, coh_factor=coh,
-                interpret=interpret,
+                interpret=interpret, cand_dtype=cand_dtype,
+                cand_budget=prune[1] if prune else None,
             )
         if sweep_merge is not None:
             oy_b, ox_b, d_b = sweep_merge(oy_b, ox_b, d_b)
@@ -866,13 +1002,14 @@ def tile_patchmatch_lean(
     # pairing along the last axis.
     if _POLISH_MODE in ("sequential", "stream"):
         polish_dist = dist_fn
-        if _POLISH_MODE == "stream" and default_dist:
-            gf = _stream_gather_fn(
-                f_a_tab, f_b_tab.shape[1], interpret
-            )
-            polish_dist = lambda idx: candidate_dist_lean(  # noqa: E731
-                f_b_tab, f_a_tab, idx, gather_fn=gf
-            )
+        if default_dist:
+            gf = _polish_gather_fn(f_a_tab, f_b_tab.shape[1], interpret)
+            if gf is not None:
+                polish_dist = (
+                    lambda idx: candidate_dist_lean(  # noqa: E731
+                        f_b_tab, f_a_tab, idx, gather_fn=gf
+                    )
+                )
         py_p, px_p, d_p = patchmatch_sweeps_lean(
             f_b_tab,
             f_a_tab,

@@ -420,7 +420,8 @@ def reset_registry() -> None:
     _global_registry.reset()
 
 
-def count_candidate_dma_bytes(useful: float, padded: float) -> None:
+def count_candidate_dma_bytes(useful: float, padded: float,
+                              dtype: str = "bf16") -> None:
     """Record one traced tile_sweep's candidate-window DMA bytes, split
     into the window content the kernel consumes (`kind="useful"`) and
     the sublane pad the fetch moves alongside it (`kind="padded"`) —
@@ -432,19 +433,24 @@ def count_candidate_dma_bytes(useful: float, padded: float) -> None:
 
     TRACE-TIME count (module docstring's jit caveat), like the launch
     counter below: one bump per tile_sweep call site traced into a
-    compilation, all K_TOTAL fetches counted (the runtime pl.when(ok)
-    skip makes the padded+useful total an upper bound for production
-    sweeps)."""
+    compilation, the per-tile fetch budget counted (K_TOTAL, or the
+    prune's M on the compressed path; the runtime pl.when(ok) skip
+    makes the padded+useful total an upper bound for production
+    sweeps).  `dtype` is the round-11 candidate-table compression mode
+    label ("bf16" = the uncompressed historical representation, the
+    value absent labels default to in the sentinel)."""
     c = get_registry().counter(
         "ia_candidate_dma_bytes_total",
         "candidate-window DMA bytes per traced tile_sweep, split "
-        "useful vs padded (trace-time static count)",
+        "useful vs padded, by candidate-table dtype (trace-time "
+        "static count)",
     )
-    c.inc(useful, labels={"kind": "useful"})
-    c.inc(padded, labels={"kind": "padded"})
+    c.inc(useful, labels={"kind": "useful", "dtype": dtype})
+    c.inc(padded, labels={"kind": "padded", "dtype": dtype})
 
 
-def count_polish_dma_bytes(useful: float, padded: float) -> None:
+def count_polish_dma_bytes(useful: float, padded: float,
+                           dtype: str = "bf16") -> None:
     """Record one traced polish row-gather's DMA bytes
     (kernels/polish_stream.gather_rows), split into the unpadded
     feature width the distance sum consumes (`kind="useful"`) and the
@@ -465,18 +471,23 @@ def count_polish_dma_bytes(useful: float, padded: float) -> None:
     `kernel_bytes_per_polish` multiplies the same per-fetch model by
     the RUNTIME schedule (`polish_eval_rows`), so the two agree on
     bytes-per-fetch and rows-per-sweep but deliberately differ by the
-    sweep-count factor."""
+    sweep-count factor.  `dtype` labels the round-11 compression mode
+    of the fetched rows ("bf16" = the uncompressed table; "int8" = the
+    quantized table whose per-fetch pricing includes the per-patch
+    scale row)."""
     c = get_registry().counter(
         "ia_polish_dma_bytes_total",
-        "polish candidate-row DMA bytes per traced gather_rows call, "
-        "split useful vs padded (trace-time static count)",
+        "polish candidate-row DMA bytes per traced gather call, "
+        "split useful vs padded, by row-table dtype (trace-time "
+        "static count)",
     )
-    c.inc(useful, labels={"kind": "useful"})
-    c.inc(padded, labels={"kind": "padded"})
+    c.inc(useful, labels={"kind": "useful", "dtype": dtype})
+    c.inc(padded, labels={"kind": "padded", "dtype": dtype})
 
 
 def count_candidate_dma_fetches(
-    n_fetch: int, n_chan: int, thp: int, packed: bool
+    n_fetch: int, n_chan: int, thp: int, packed: bool,
+    dtype: str = "bf16",
 ) -> None:
     """Record one traced tile_sweep's candidate-window FETCH COUNT with
     the geometry that prices a fetch ({chan, thp, packed} labels) —
@@ -493,34 +504,69 @@ def count_candidate_dma_fetches(
     get_registry().counter(
         "ia_candidate_dma_fetches_total",
         "candidate-window DMA fetches per traced tile_sweep, labeled "
-        "by the {chan, thp, packed} geometry that prices one fetch "
-        "(trace-time static count; sentinel joins this against "
+        "by the {chan, thp, packed, dtype} geometry that prices one "
+        "fetch (trace-time static count; sentinel joins this against "
         "candidate_dma_bytes_per_fetch)",
     ).inc(n_fetch, labels={
         "chan": str(n_chan), "thp": str(thp),
-        "packed": "1" if packed else "0",
+        "packed": "1" if packed else "0", "dtype": dtype,
     })
 
 
 def count_polish_dma_rows(
-    n_rows: int, d_useful: int, itemsize: int
+    n_rows: int, d_useful: int, itemsize: int, dtype: str = "bf16"
 ) -> None:
     """Record one traced polish row-gather's ROW COUNT with the
-    {d_useful, itemsize} labels that price a row fetch — the polish
-    twin of `count_candidate_dma_fetches`: the sentinel recomputes the
-    expected byte series from
+    {d_useful, itemsize, dtype} labels that price a row fetch — the
+    polish twin of `count_candidate_dma_fetches`: the sentinel
+    recomputes the expected byte series from
     `kernels.polish_stream.polish_dma_bytes_per_fetch` and holds the
     observed `ia_polish_dma_bytes_total` series to it.  TRACE-TIME
     count per call site (the byte counter's scan subtlety applies
     identically, so the two series stay joinable)."""
     get_registry().counter(
         "ia_polish_dma_rows_total",
-        "candidate rows fetched per traced gather_rows call, labeled "
-        "by the {d_useful, itemsize} fetch pricing (trace-time static "
-        "count; sentinel joins this against polish_dma_bytes_per_fetch)",
+        "candidate rows fetched per traced polish gather, labeled by "
+        "the {d_useful, itemsize, dtype} fetch pricing (trace-time "
+        "static count; sentinel joins this against "
+        "polish_dma_bytes_per_fetch)",
     ).inc(n_rows, labels={
         "d_useful": str(d_useful), "itemsize": str(itemsize),
+        "dtype": dtype,
     })
+
+
+def count_coarse_dma_bytes(useful: float, padded: float) -> None:
+    """Record one traced coarse pre-prune's projected-row gather bytes
+    (kernels.patchmatch_tile.prune_candidates), split into the k
+    projected dims the ranking consumes (`kind="useful"`) and the
+    128-lane row pad XLA's gather moves alongside (`kind="padded"`) —
+    the coarse third of the round-11 compressed-candidate ledger.  The
+    per-row math is `kernels.patchmatch_tile.coarse_dma_bytes_per_row`,
+    the same model bench.py's compressed sweep fields use.  TRACE-TIME
+    count per call site (one bump per traced prune — once per pm
+    iteration of a traced matcher body)."""
+    c = get_registry().counter(
+        "ia_coarse_dma_bytes_total",
+        "PCA coarse pre-prune projected-row gather bytes, split "
+        "useful vs padded (trace-time static count)",
+    )
+    c.inc(useful, labels={"kind": "useful"})
+    c.inc(padded, labels={"kind": "padded"})
+
+
+def count_coarse_dma_rows(n_rows: int, k: int, itemsize: int) -> None:
+    """Structural twin of `count_coarse_dma_bytes`: the coarse row
+    count with its {k, itemsize} pricing, so the run sentinel can
+    recompute the expected coarse bytes from `coarse_dma_bytes_per_row`
+    and hold the observed series to it (telemetry/sentinel.py coarse
+    ledger).  TRACE-TIME count, same caveat as the byte twin."""
+    get_registry().counter(
+        "ia_coarse_dma_rows_total",
+        "PCA coarse pre-prune rows gathered, labeled by the "
+        "{k, itemsize} row pricing (trace-time static count; sentinel "
+        "joins this against coarse_dma_bytes_per_row)",
+    ).inc(n_rows, labels={"k": str(k), "itemsize": str(itemsize)})
 
 
 def count_collectives(n: int, axis: str, kind: str = "all_reduce") -> None:

@@ -15,12 +15,19 @@ waiting for a human to reread JSON.
 
 Checks (each -> ok | degraded | violated | skipped):
 
-  candidate_dma_model   ia_candidate_dma_bytes_total{kind} ==
-                        Σ fetches(chan,thp,packed) x
+  candidate_dma_model   ia_candidate_dma_bytes_total{kind,dtype} ==
+                        Σ fetches(chan,thp,packed,dtype) x
                           candidate_dma_bytes_per_fetch(...), exactly
-  polish_dma_model      ia_polish_dma_bytes_total{kind} ==
-                        Σ rows(d_useful,itemsize) x
+                        per compression mode (round 11: absent dtype
+                        labels price at the uncompressed "bf16" mode)
+  polish_dma_model      ia_polish_dma_bytes_total{kind,dtype} ==
+                        Σ rows(d_useful,itemsize,dtype) x
                           polish_dma_bytes_per_fetch(...), exactly
+                        per compression mode
+  coarse_dma_model      ia_coarse_dma_bytes_total{kind} ==
+                        Σ rows(k,itemsize) x
+                          coarse_dma_bytes_per_row(...), exactly (the
+                        round-11 PCA pre-prune's projected-row ledger)
   comms_model           ia_collectives_total{axis} ==
                         ia_collectives_expected_total{axis} (the
                         parallel/comms.py site model, booked inside
@@ -159,11 +166,36 @@ def _is_num(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
 
 
+# Default compression-mode label for series recorded before round 11
+# added the {dtype} label: "bf16" IS the uncompressed historical
+# representation, so pricing unlabeled cells at it reproduces the old
+# models exactly (pre-r11 artifacts stay green).
+_DEFAULT_CAND_DTYPE = "bf16"
+
+
+def _by_dtype(values: Dict) -> Dict[str, Dict[str, float]]:
+    """{dtype: {"useful": x, "moved": y}} from a {kind[, dtype]}-labeled
+    byte series (moved = useful + padded; absent dtype = pre-r11)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for key, v in values.items():
+        lab = dict(key)
+        dt = lab.get("dtype", _DEFAULT_CAND_DTYPE)
+        slot = out.setdefault(dt, {"useful": 0.0, "moved": 0.0})
+        if lab.get("kind") == "useful":
+            slot["useful"] += v
+            slot["moved"] += v
+        else:
+            slot["moved"] += v
+    return out
+
+
 # ---------------------------------------------------------------- checks
 def check_candidate_dma(metrics: Optional[dict]) -> Dict:
     """Observed candidate-DMA bytes vs the byte model priced over the
-    recorded fetch counts — exact equality (both sides are integral
-    trace-time sums)."""
+    recorded fetch counts — exact equality PER COMPRESSION MODE (the
+    round-11 {dtype} label; both sides are integral trace-time sums,
+    and comparing per dtype means a compressed arm cannot hide inside
+    an uncompressed total)."""
     from ..kernels.patchmatch_tile import candidate_dma_bytes_per_fetch
 
     bytes_v = _counter_values(metrics, "ia_candidate_dma_bytes_total")
@@ -185,32 +217,32 @@ def check_candidate_dma(metrics: Optional[dict]) -> Dict:
             detail="byte series present but no fetch counter — "
             "pre-round-9 trace artifact; expectation unavailable",
         )
-    exp_useful = exp_moved = 0.0
+    expected: Dict[str, Dict[str, float]] = {}
     for key, n in fetches.items():
         lab = dict(key)
+        dt = lab.get("dtype", _DEFAULT_CAND_DTYPE)
         try:
             moved, useful = candidate_dma_bytes_per_fetch(
-                int(lab["chan"]), int(lab["thp"]), lab["packed"] == "1"
+                int(lab["chan"]), int(lab["thp"]), lab["packed"] == "1",
+                dt,
             )
         except (KeyError, ValueError):
             return _check(
                 "candidate_dma_model", "violated",
-                expected="{chan, thp, packed} fetch labels",
+                expected="{chan, thp, packed[, dtype]} fetch labels",
                 observed=lab,
                 detail="fetch counter carries unpriceable labels",
             )
-        exp_moved += n * moved
-        exp_useful += n * useful
-    obs_useful = bytes_v.get((("kind", "useful"),), 0.0)
-    obs_padded = bytes_v.get((("kind", "padded"),), 0.0)
-    expected = {"useful": exp_useful, "moved": exp_moved}
-    observed = {"useful": obs_useful, "moved": obs_useful + obs_padded}
+        slot = expected.setdefault(dt, {"useful": 0.0, "moved": 0.0})
+        slot["moved"] += n * moved
+        slot["useful"] += n * useful
+    observed = _by_dtype(bytes_v)
     ok = expected == observed
     return _check(
         "candidate_dma_model", "ok" if ok else "violated",
         expected=expected, observed=observed,
         detail="ia_candidate_dma_bytes_total vs "
-        "candidate_dma_bytes_per_fetch x recorded fetches"
+        "candidate_dma_bytes_per_fetch x recorded fetches, per dtype"
         + ("" if ok else " — a call site's byte accounting has "
            "drifted from the shared model"),
     )
@@ -218,7 +250,8 @@ def check_candidate_dma(metrics: Optional[dict]) -> Dict:
 
 def check_polish_dma(metrics: Optional[dict]) -> Dict:
     """Observed polish row-gather bytes vs the polish byte model priced
-    over the recorded row counts — exact equality."""
+    over the recorded row counts — exact equality per compression mode
+    (see the candidate twin)."""
     from ..kernels.polish_stream import polish_dma_bytes_per_fetch
 
     bytes_v = _counter_values(metrics, "ia_polish_dma_bytes_total")
@@ -226,8 +259,8 @@ def check_polish_dma(metrics: Optional[dict]) -> Dict:
     if not bytes_v and not rows:
         return _check(
             "polish_dma_model", "skipped",
-            detail="no polish row-gather traffic recorded (stream-mode "
-            "polish not traced in this session)",
+            detail="no polish row-gather traffic recorded (neither the "
+            "stream-mode nor the int8 polish traced in this session)",
         )
     if bytes_v and not rows:
         # Pre-round-9 artifact (see the candidate-DMA twin).
@@ -236,18 +269,64 @@ def check_polish_dma(metrics: Optional[dict]) -> Dict:
             detail="byte series present but no row counter — "
             "pre-round-9 trace artifact; expectation unavailable",
         )
-    exp_useful = exp_moved = 0.0
+    expected: Dict[str, Dict[str, float]] = {}
     for key, n in rows.items():
         lab = dict(key)
+        dt = lab.get("dtype", _DEFAULT_CAND_DTYPE)
         try:
             moved, useful = polish_dma_bytes_per_fetch(
-                int(lab["d_useful"]), int(lab["itemsize"])
+                int(lab["d_useful"]), int(lab["itemsize"]), dt
             )
         except (KeyError, ValueError):
             return _check(
                 "polish_dma_model", "violated",
-                expected="{d_useful, itemsize} row labels", observed=lab,
+                expected="{d_useful, itemsize[, dtype]} row labels",
+                observed=lab,
                 detail="row counter carries unpriceable labels",
+            )
+        slot = expected.setdefault(dt, {"useful": 0.0, "moved": 0.0})
+        slot["moved"] += n * moved
+        slot["useful"] += n * useful
+    observed = _by_dtype(bytes_v)
+    ok = expected == observed
+    return _check(
+        "polish_dma_model", "ok" if ok else "violated",
+        expected=expected, observed=observed,
+        detail="ia_polish_dma_bytes_total vs "
+        "polish_dma_bytes_per_fetch x recorded rows, per dtype"
+        + ("" if ok else " — a polish gather's byte accounting has "
+           "drifted from the shared model"),
+    )
+
+
+def check_coarse_dma(metrics: Optional[dict]) -> Dict:
+    """Observed PCA coarse pre-prune gather bytes vs
+    `coarse_dma_bytes_per_row` priced over the recorded row counts —
+    the third ledger of the round-11 compressed-candidate pipeline,
+    exact equality (skipped whenever the prune never traced, i.e.
+    every uncompressed run and all pre-r11 artifacts)."""
+    from ..kernels.patchmatch_tile import coarse_dma_bytes_per_row
+
+    bytes_v = _counter_values(metrics, "ia_coarse_dma_bytes_total")
+    rows = _counter_values(metrics, "ia_coarse_dma_rows_total")
+    if not bytes_v and not rows:
+        return _check(
+            "coarse_dma_model", "skipped",
+            detail="no coarse pre-prune traffic recorded (PCA prune "
+            "off, or no tile matcher traced in this session)",
+        )
+    exp_useful = exp_moved = 0.0
+    for key, n in rows.items():
+        lab = dict(key)
+        try:
+            moved, useful = coarse_dma_bytes_per_row(
+                int(lab["k"]), int(lab["itemsize"])
+            )
+        except (KeyError, ValueError):
+            return _check(
+                "coarse_dma_model", "violated",
+                expected="{k, itemsize} row labels", observed=lab,
+                detail="coarse row counter carries unpriceable labels",
             )
         exp_moved += n * moved
         exp_useful += n * useful
@@ -257,12 +336,12 @@ def check_polish_dma(metrics: Optional[dict]) -> Dict:
     observed = {"useful": obs_useful, "moved": obs_useful + obs_padded}
     ok = expected == observed
     return _check(
-        "polish_dma_model", "ok" if ok else "violated",
+        "coarse_dma_model", "ok" if ok else "violated",
         expected=expected, observed=observed,
-        detail="ia_polish_dma_bytes_total vs "
-        "polish_dma_bytes_per_fetch x recorded rows"
-        + ("" if ok else " — gather_rows' byte accounting has drifted "
-           "from the shared model"),
+        detail="ia_coarse_dma_bytes_total vs coarse_dma_bytes_per_row "
+        "x recorded rows"
+        + ("" if ok else " — prune_candidates' byte accounting has "
+           "drifted from the shared model"),
     )
 
 
@@ -492,6 +571,7 @@ def evaluate_health(
     checks = [
         check_candidate_dma(metrics),
         check_polish_dma(metrics),
+        check_coarse_dma(metrics),
         check_comms(metrics),
         check_energy_series(spans, metrics),
         check_span_tree(spans),

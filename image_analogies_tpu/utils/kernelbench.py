@@ -31,11 +31,14 @@ def sweep_setup(cfg, size: int):
     Returns None when the geometry is kernel-ineligible.
     """
     from ..kernels.patchmatch_tile import (
+        K_TOTAL,
         LANE,
         band_bounds,
         plan_channels,
         prepare_a_planes,
+        resolve_cand_dtype,
         resolve_packed,
+        resolve_prune,
         sample_candidates,
         tile_geometry,
         tile_sweep,
@@ -70,6 +73,16 @@ def sweep_setup(cfg, size: int):
     cand_y, cand_x, cand_valid = sample_candidates(
         ry, rx, jax.random.PRNGKey(0), geom, size, size,
     )
+    prune = resolve_prune()
+    if prune is not None:
+        # Compressed-path harness (round 11): keep only the first M
+        # slots valid so the timed kernel's pl.when(ok) skip moves
+        # exactly the modeled exact-fetch budget (the coarse ranking
+        # itself is XLA work outside the timed sweep — priced by the
+        # byte model, not this harness).
+        cand_valid = cand_valid * (
+            jnp.arange(K_TOTAL) < prune[1]
+        ).astype(cand_valid.dtype)
     bounds = band_bounds(size, n_bands)
 
     def one_iter(oy, ox, d):
@@ -78,6 +91,7 @@ def sweep_setup(cfg, size: int):
                 band_planes, b_blocked, cand_y, cand_x, oy, ox, d, band,
                 cand_valid,
                 specs=specs, geom=geom, ha=size, wa=size, coh_factor=1.0,
+                cand_budget=prune[1] if prune else None,
             )
         return oy, ox, d
 
@@ -87,10 +101,12 @@ def sweep_setup(cfg, size: int):
         "n_bands": n_bands,
         "a_planes": a_planes,
         "n_chan": n_chan,
-        # The layout this setup prepared and sweeps under — bench.py's
-        # byte model reads it so the published traffic matches what the
-        # timed kernel actually moved.
+        # The layout/compression this setup prepared and sweeps under —
+        # bench.py's byte model reads these so the published traffic
+        # matches what the timed kernel actually moved.
         "packed": resolve_packed(),
+        "cand_dtype": resolve_cand_dtype(),
+        "prune": prune,
     }
     return one_iter, (oy, ox, d), meta
 

@@ -345,6 +345,172 @@ class TestCheckPolish:
         assert check_main([str(tmp_path / "absent.json")]) == 2
 
 
+class TestCheckQuant:
+    """tools/check_quant.py wrapper: tier-1 enforces the round-11
+    compressed-candidate artifact's schema — the acceptance criteria
+    (default-path bit-identity, per-arm quality pins inside the
+    dist-ratio/PSNR gates, the extended byte model with its >= 3x
+    modeled reduction, a pre-stated kill criterion, the hardware
+    recipe) as validator rules, run against the COMMITTED
+    QUANT_r11.json."""
+
+    def _artifact(self):
+        import json
+
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "QUANT_r11.json"
+        )
+        with open(path) as f:
+            return json.load(f)
+
+    def test_committed_artifact_validates(self):
+        from check_quant import validate_quant
+
+        assert validate_quant(self._artifact()) == []
+
+    def test_violations_detected(self):
+        from check_quant import validate_quant
+
+        base = self._artifact()
+
+        rec = copy.deepcopy(base)
+        rec["decision"]["kill_criterion_prestated"] = ""
+        assert any("kill_criterion" in e for e in validate_quant(rec))
+
+        rec = copy.deepcopy(base)
+        rec["measured_this_round"]["default_bit_identical"] = False
+        assert any(
+            "default_bit_identical" in e for e in validate_quant(rec)
+        )
+
+        rec = copy.deepcopy(base)
+        rec["measured_this_round"]["arms"][1]["dist_ratio_vs_exact"] = 2.5
+        assert any("dist_ratio" in e for e in validate_quant(rec))
+
+        rec = copy.deepcopy(base)
+        rec["measured_this_round"]["arms"][1]["psnr_db"] = 20.0
+        assert any("psnr_db" in e for e in validate_quant(rec))
+
+        rec = copy.deepcopy(base)
+        rec["byte_model"]["int8_sweep_pad_bound_at_c4"] = False
+        assert any("pad_bound" in e for e in validate_quant(rec))
+
+        rec = copy.deepcopy(base)
+        # A claimed reduction below the ISSUE-6 floor must fail even
+        # when the recorded ratio is the honest quotient.
+        proj = rec["projection_modeled_not_measured"]
+        proj["bytes_per_sweep_1024_compressed"] = (
+            proj["bytes_per_sweep_1024_r7_baseline"] / 2.0
+        )
+        proj["reduction_ratio"] = 2.0
+        assert any("acceptance floor" in e for e in validate_quant(rec))
+
+        rec = copy.deepcopy(base)
+        proj = rec["projection_modeled_not_measured"]
+        proj["reduction_ratio"] = proj["reduction_ratio"] + 1.0
+        assert any("quotient" in e for e in validate_quant(rec))
+
+        rec = copy.deepcopy(base)
+        del rec["hardware_recipe"]
+        assert any("hardware_recipe" in e for e in validate_quant(rec))
+
+    def test_byte_model_consistency_with_kernels(self):
+        """The committed artifact's per-fetch cells must BE the shared
+        kernel models' numbers at the recorded geometry — not
+        hand-typed copies that can drift."""
+        from image_analogies_tpu.kernels.patchmatch_tile import (
+            candidate_dma_bytes_per_fetch,
+            coarse_dma_bytes_per_row,
+        )
+        from image_analogies_tpu.kernels.polish_stream import (
+            polish_dma_bytes_per_fetch,
+        )
+
+        bm = self._artifact()["byte_model"]
+        moved, useful = candidate_dma_bytes_per_fetch(
+            bm["sweep_fetch_int8_c4"]["n_chan"],
+            bm["sweep_fetch_int8_c4"]["thp"], True, "int8",
+        )
+        assert bm["sweep_fetch_int8_c4"]["moved"] == moved
+        assert bm["sweep_fetch_int8_c4"]["useful"] == useful
+        # The recorded negative really is the model's: int8 moved ==
+        # f32 moved at this geometry.
+        f32_moved, _ = candidate_dma_bytes_per_fetch(
+            bm["sweep_fetch_int8_c4"]["n_chan"],
+            bm["sweep_fetch_int8_c4"]["thp"], True, "bf16",
+        )
+        assert (bm["int8_sweep_pad_bound_at_c4"] is True) == (
+            moved == f32_moved
+        )
+        moved, useful = polish_dma_bytes_per_fetch(
+            bm["polish_fetch_int8"]["d_feat"], 1, "int8"
+        )
+        assert bm["polish_fetch_int8"]["moved"] == moved
+        assert bm["polish_fetch_int8"]["useful"] == useful
+        moved, useful = coarse_dma_bytes_per_row(bm["coarse_row"]["k"])
+        assert bm["coarse_row"]["moved"] == moved
+        assert bm["coarse_row"]["useful"] == useful
+
+    def test_projection_is_the_shared_model(self):
+        """The artifact's 1024^2 projection cells must reproduce from
+        the shared byte models at the headline geometry (the figures
+        tests/test_cand_compress.py asserts the 3x floor on)."""
+        from image_analogies_tpu.kernels.patchmatch_tile import (
+            K_TOTAL,
+            LANE,
+            _PRUNE_SAMPLES,
+            candidate_dma_bytes_per_fetch,
+            channel_specs,
+            coarse_dma_bytes_per_row,
+        )
+        import image_analogies_tpu.kernels.patchmatch_tile as pt
+
+        art = self._artifact()
+        proj = art["projection_modeled_not_measured"]
+        cfg = SynthConfig()
+        specs = channel_specs(1, 1, cfg, True)
+        geom = pt.tile_geometry(1024, 1024, specs)
+        thp, n_tiles = geom.thp, geom.n_ty * geom.n_tx
+        tile_bytes = (len(specs) + 6) * thp * LANE * 4
+        slot_f32, _ = candidate_dma_bytes_per_fetch(
+            len(specs), thp, True, "bf16"
+        )
+        slot_i8, _ = candidate_dma_bytes_per_fetch(
+            len(specs), thp, True, "int8"
+        )
+        coarse_moved, _ = coarse_dma_bytes_per_row(
+            art["byte_model"]["coarse_row"]["k"]
+        )
+        m_keep = int(
+            art["decision"]["recipe_pca_prune"].split(":")[1]
+        )
+        base = n_tiles * (tile_bytes + K_TOTAL * slot_f32)
+        comp = n_tiles * (
+            tile_bytes
+            + K_TOTAL * _PRUNE_SAMPLES * coarse_moved
+            + m_keep * slot_i8
+        )
+        assert proj["bytes_per_sweep_1024_r7_baseline"] == base
+        assert proj["bytes_per_sweep_1024_compressed"] == comp
+
+    def test_cli_exit_codes(self, tmp_path):
+        import json
+
+        from check_quant import main as check_main
+
+        good = str(tmp_path / "good.json")
+        with open(good, "w") as f:
+            json.dump(self._artifact(), f)
+        assert check_main([good]) == 0
+        bad = self._artifact()
+        del bad["decision"]
+        badp = str(tmp_path / "bad.json")
+        with open(badp, "w") as f:
+            json.dump(bad, f)
+        assert check_main([badp]) == 1
+        assert check_main([str(tmp_path / "absent.json")]) == 2
+
+
 class TestValidateBenchProbes:
     def test_cross_backend_identity_probe(self):
         """The bench's own config-1 cell builder, CPU form: interpret

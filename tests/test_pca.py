@@ -2,6 +2,7 @@
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from image_analogies_tpu.config import SynthConfig
 from image_analogies_tpu.models.analogy import create_image_analogy
@@ -45,6 +46,7 @@ def test_variance_ordering(rng):
     assert np.all(np.diff(var) <= 1e-3)
 
 
+@pytest.mark.slow  # r11 tier-1 budget (round-8 rule)
 def test_synthesis_with_pca_close_to_full(rng):
     a, ap, b = texture_by_numbers(48)
     base = dict(levels=2, matcher="patchmatch", em_iters=2, pm_iters=4, seed=1)

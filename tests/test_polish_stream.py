@@ -177,6 +177,8 @@ class TestStreamPolishPaths:
     criterion's argmin-tie-equal gate, pinned as exact field equality
     (strictly stronger)."""
 
+    @pytest.mark.slow  # r11 tier-1 budget: hook-level bit-identity
+    # (TestStreamDist) and the lean-path pin keep tier-1 coverage
     def test_standard_path_bit_identical(self, rng, monkeypatch):
         a, ap, b = _pair(rng)
         cfg = SynthConfig(
@@ -324,8 +326,16 @@ class TestByteModel:
         )
         with pytest.raises(ValueError):
             polish_dma_bytes_per_fetch(0)
-        with pytest.raises(ValueError):
-            polish_dma_bytes_per_fetch(LANE + 1)
+        # Widths past LANE price at the next 128-lane multiple (round
+        # 11: the XLA take engines gather wide rows; only the streamed
+        # table is capped at one lane block, by prepare_polish_table).
+        assert polish_dma_bytes_per_fetch(LANE + 1) == (
+            2 * LANE * 2, (LANE + 1) * 2
+        )
+        # int8 pricing adds the per-patch f32 scale to both sides.
+        assert polish_dma_bytes_per_fetch(68, 1, "int8") == (
+            LANE + 4, 68 + 4
+        )
 
     def test_eval_rows_formula(self):
         # Entry re-evaluation + iters * (8 propagation + n_random).

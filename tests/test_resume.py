@@ -6,6 +6,7 @@ keys derive from the level index, so the continuation is path-independent).
 import os
 
 import numpy as np
+import pytest
 
 from image_analogies_tpu import SynthConfig, create_image_analogy
 
@@ -166,6 +167,8 @@ def test_batch_resume_chunked(tmp_path, rng):
     np.testing.assert_array_equal(resumed, full)
 
 
+@pytest.mark.slow  # r11 tier-1 budget: the batch-resume roundtrips
+# keep the frame-key contract tier-1
 def test_batch_output_invariant_to_chunking(rng):
     """Per-frame PRNG keys derive from the GLOBAL frame index, so a
     key-dependent matcher (patchmatch) must produce identical frames for

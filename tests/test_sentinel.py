@@ -272,6 +272,154 @@ class TestModelChecks:
             == "violated"
         )
 
+    def test_compressed_mode_ledgers_green_and_exact(self, rng):
+        """Round-11 compressed path: int8 sweep traffic under a prune
+        budget, the coarse pre-prune's projected-row counters, and an
+        int8 polish row gather — candidate, polish, AND coarse DMA
+        checks must come back ok (the per-dtype join prices every
+        mode against the extended byte models, exactly)."""
+        import jax
+        import jax.numpy as jnp
+
+        from image_analogies_tpu.kernels.patchmatch_tile import (
+            LANE,
+            channel_specs,
+            prepare_a_planes,
+            prune_candidates,
+            sample_candidates,
+            tile_geometry,
+            tile_sample_positions,
+            tile_sweep,
+            to_blocked,
+        )
+        from image_analogies_tpu.kernels.polish_stream import (
+            gather_rows,
+            prepare_polish_table,
+            quantize_rows,
+        )
+
+        cfg = SynthConfig()
+        specs = channel_specs(1, 1, cfg, False)
+        h = w = wa = 128
+        ha = 152  # unique ha => fresh jit key => counters fire
+        geom = tile_geometry(h, w, specs)
+        mk = lambda *s: jnp.asarray(  # noqa: E731
+            rng.random(s, np.float32)
+        )
+        (a_planes,) = prepare_a_planes(
+            mk(ha, wa), mk(ha, wa), None, None, specs,
+            cand_dtype="int8",
+        )
+        b_blocked = jnp.stack(
+            [to_blocked(mk(h, w), geom) for _ in range(2)]
+        )
+        cand = sample_candidates(
+            jnp.zeros((h, w), jnp.int32), jnp.zeros((h, w), jnp.int32),
+            jax.random.PRNGKey(0), geom, ha, wa,
+        )
+        proj_a = jnp.asarray(rng.random((ha * wa, 16), np.float32))
+        qy, qx = tile_sample_positions(geom, h, w)
+        proj_b_tiles = jnp.take(
+            proj_a, (qy * w + qx).reshape(-1) % (ha * wa), axis=0
+        ).reshape(*qy.shape, 16)
+        z = jnp.zeros((geom.n_ty * geom.thp, geom.n_tx * LANE), jnp.int32)
+        d0 = jnp.full(
+            (geom.n_ty * geom.thp, geom.n_tx * LANE), np.inf, jnp.float32
+        )
+        q_tab, _scales = quantize_rows(
+            jnp.asarray(rng.random((64, 68), np.float32))
+        )
+        q_pad = prepare_polish_table(q_tab)
+        idx = jnp.asarray(rng.integers(0, 64, 200, dtype=np.int32))
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        try:
+            kept = prune_candidates(
+                cand[0], cand[1], cand[2], proj_b_tiles, qy, qx,
+                proj_a, ha, wa, 8,
+            )
+            tile_sweep(
+                a_planes, b_blocked, cand[0], cand[1], z, z, d0,
+                cand_valid=kept, specs=specs, geom=geom, ha=ha, wa=wa,
+                coh_factor=1.0, interpret=True, cand_dtype="int8",
+                cand_budget=8,
+            )
+            gather_rows(
+                q_pad, idx, interpret=True, useful_width=68,
+                cand_dtype="int8",
+            )
+        finally:
+            set_registry(prev)
+        health = evaluate_health(metrics=reg.to_dict())
+        by_name = _checks_by_name(health)
+        for name in (
+            "candidate_dma_model", "polish_dma_model",
+            "coarse_dma_model",
+        ):
+            assert by_name[name]["status"] == "ok", by_name[name]
+        # The candidate join really ran in the compressed mode.
+        assert "int8" in by_name["candidate_dma_model"]["expected"]
+        assert validate_health(health) == []
+
+    def test_coarse_dma_tamper_detected(self, rng):
+        import jax.numpy as jnp
+
+        from image_analogies_tpu.telemetry.metrics import (
+            count_coarse_dma_bytes,
+            count_coarse_dma_rows,
+        )
+        from image_analogies_tpu.kernels.patchmatch_tile import (
+            coarse_dma_bytes_per_row,
+        )
+
+        moved, useful = coarse_dma_bytes_per_row(16, 4)
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        try:
+            count_coarse_dma_bytes(
+                useful=10 * useful, padded=10 * (moved - useful)
+            )
+            count_coarse_dma_rows(11, 16, 4)  # one unaccounted row
+        finally:
+            set_registry(prev)
+        health = evaluate_health(metrics=reg.to_dict())
+        assert (
+            _checks_by_name(health)["coarse_dma_model"]["status"]
+            == "violated"
+        )
+
+    def test_compressed_arm_cannot_hide_in_another_dtype(self, rng):
+        """The per-dtype join's point: fetches booked under int8 with
+        bytes booked under bf16 agree in TOTAL but must still violate
+        — a compressed arm's accounting cannot launder through the
+        uncompressed series."""
+        from image_analogies_tpu.kernels.patchmatch_tile import (
+            candidate_dma_bytes_per_fetch,
+        )
+        from image_analogies_tpu.telemetry.metrics import (
+            count_candidate_dma_bytes,
+            count_candidate_dma_fetches,
+        )
+
+        moved, useful = candidate_dma_bytes_per_fetch(
+            4, 72, True, "int8"
+        )
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        try:
+            count_candidate_dma_fetches(10, 4, 72, True, "int8")
+            count_candidate_dma_bytes(
+                useful=10 * useful, padded=10 * (moved - useful),
+                dtype="bf16",
+            )
+        finally:
+            set_registry(prev)
+        health = evaluate_health(metrics=reg.to_dict())
+        assert (
+            _checks_by_name(health)["candidate_dma_model"]["status"]
+            == "violated"
+        )
+
     def test_comms_imbalance_detected(self):
         """An extra collective site without a model update (or vice
         versa) throws the ledger out of balance."""

@@ -179,6 +179,8 @@ def test_sharded_a_band_search_matches_sequential(rng):
     np.testing.assert_array_equal(np.asarray(d_m), np.asarray(d_s))
 
 
+@pytest.mark.slow  # r11 tier-1 budget: test_resume keeps the
+# checkpoint contract tier-1
 def test_sharded_a_checkpoint_roundtrip(rng, tmp_path):
     """Sharded-A checkpoint/resume (round-4: removed the v1
     NotImplementedError): per-level artifacts use the standard stacked

@@ -85,6 +85,8 @@ def test_spatial_patchmatch_quality(rng):
     assert psnr(sharded, oracle) > 20.0
 
 
+@pytest.mark.slow  # r11 tier-1 budget: spatial quality/bit-identity
+# tests keep the runner tier-1; kernel e2e lives in test_pallas_*
 def test_spatial_engages_pallas_kernel(rng):
     """The tile kernel must trace and run on the spatial path (slab-local
     offsets keep its tile->A coordinates valid), and the sharded kernel
@@ -267,6 +269,7 @@ def test_batch_unfused_brute_levels_match_fused():
     np.testing.assert_allclose(unfused, fused, atol=1e-6)
 
 
+@pytest.mark.slow  # r11 tier-1 budget (round-8 rule)
 def test_spatial_lean_composes_with_lean_path(rng):
     """Lean x spatial composition (round-2 VERDICT task 6): with a
     forced-tiny feature_bytes_budget, the sharded runner must take the

@@ -289,10 +289,14 @@ class TestMetricsRegistry:
             len(specs), thp, True
         )
         n_fetch = geom.n_ty * geom.n_tx * K_TOTAL
-        assert c.value(labels={"kind": "useful"}) == n_fetch * useful
-        assert c.value(labels={"kind": "padded"}) == n_fetch * (
-            moved - useful
-        )
+        # The dtype label is the round-11 compression mode; this
+        # uncompressed sweep books under "bf16".
+        assert c.value(
+            labels={"kind": "useful", "dtype": "bf16"}
+        ) == n_fetch * useful
+        assert c.value(
+            labels={"kind": "padded", "dtype": "bf16"}
+        ) == n_fetch * (moved - useful)
         # Fine-only = 2 channels: the packed fetch still pads 4 -> 8
         # sublanes (efficiency 0.5, vs 0.25 unpacked); at the
         # headline's 4 channels the padded series is exactly 0 —
@@ -337,10 +341,13 @@ class TestMetricsRegistry:
         c = reg.counter("ia_polish_dma_bytes_total")
         moved, useful = polish_dma_bytes_per_fetch(d_feat)
         assert moved == 128 * 2 and useful == d_feat * 2
-        assert c.value(labels={"kind": "useful"}) == 500 * useful
-        assert c.value(labels={"kind": "padded"}) == 500 * (
-            moved - useful
-        )
+        # dtype="bf16": the uncompressed row table (round-11 label).
+        assert c.value(
+            labels={"kind": "useful", "dtype": "bf16"}
+        ) == 500 * useful
+        assert c.value(
+            labels={"kind": "padded", "dtype": "bf16"}
+        ) == 500 * (moved - useful)
 
 
 # ----------------------------------------------------------------- spans

@@ -159,6 +159,55 @@ class TestProvenanceDiscipline:
         assert cell["provenance"] == "modeled"
         assert cell["status"] == "inert"
 
+    def test_compressed_mode_byte_cells_are_modeled(self, tmp_path):
+        """Round-11 rule: a compressed-mode bench record's byte cells
+        (different byte model) are forced to modeled — the compressed
+        path's smaller bytes/sweep must never become the floor an
+        uncompressed measurement is judged against."""
+        _write_history(str(tmp_path), {
+            "BENCH_r04.json": _bench(
+                0.58, kernel_bytes_per_sweep=3.11e9
+            ),
+            "BENCH_r05.json": _bench(
+                0.57, kernel_bytes_per_sweep=0.40e9,
+                kernel_cand_dtype="int8", kernel_cand_prune="16:8",
+                kernel_prune_survival=0.222,
+            ),
+            "BENCH_r06.json": _bench(
+                0.56, kernel_bytes_per_sweep=3.05e9
+            ),
+        })
+        errs, report = check_trajectory(str(tmp_path))
+        # Were the compressed cell allowed to set the bar, r06's
+        # 3.05e9 would be a ~7.6x regression against 0.40e9.
+        assert errs == []
+        summary = next(
+            r for r in report
+            if r.get("summary")
+            and r["series"] == "bench.kernel_bytes_per_sweep"
+        )
+        assert summary["best"] == 3.05e9
+        assert summary["inert_cells"] == 1
+
+    def test_prune_survival_alone_marks_compressed(self, tmp_path):
+        """A bf16 record with survival < 1 (prune-only arm) is still
+        a compressed byte model — same inert rule."""
+        _write_history(str(tmp_path), {
+            "BENCH_r04.json": _bench(
+                0.58, kernel_bytes_per_sweep=3.11e9
+            ),
+            "BENCH_r05.json": _bench(
+                0.57, kernel_bytes_per_sweep=0.90e9,
+                kernel_cand_dtype="bf16", kernel_cand_prune="16:8",
+                kernel_prune_survival=0.222,
+            ),
+            "BENCH_r06.json": _bench(
+                0.56, kernel_bytes_per_sweep=3.05e9
+            ),
+        })
+        errs, _ = check_trajectory(str(tmp_path))
+        assert errs == []
+
     def test_unknown_provenance_rejected(self, tmp_path):
         _write_history(str(tmp_path), {
             "BENCH_r04.json": _bench(0.58, provenance="vibes"),

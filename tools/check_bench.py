@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from typing import List
 
@@ -63,6 +64,10 @@ _KERNEL_REQUIRED = _ROOFLINE_FIELDS + (
 )
 _SWEEP_MS_FIELDS = ("kernel_sweep_ms_trace", "kernel_sweep_ms_loop")
 _POLISH_MODES = ("sequential", "jump", "stream")
+# Round-11 compressed-candidate schema (validated when present, so
+# pre-r11 records stay green — the round-10 memory-watermark rule).
+_CAND_DTYPES = ("bf16", "int8")
+_PRUNE_SPEC_RE = re.compile(r"^\d+:\d+$")
 
 
 def _num(v) -> bool:
@@ -199,6 +204,37 @@ def validate_bench(record: dict) -> List[str]:
         errs.append(
             f"polish_mode {mode!r} names none of {_POLISH_MODES}"
         )
+    # Round-11 compressed-candidate fields, validated when present
+    # (pre-r11 records legitimately lack them).
+    cd = record.get("kernel_cand_dtype")
+    if "kernel_cand_dtype" in record and cd not in _CAND_DTYPES:
+        errs.append(
+            f"kernel_cand_dtype {cd!r} names none of {_CAND_DTYPES}"
+        )
+    surv = record.get("kernel_prune_survival")
+    if "kernel_prune_survival" in record and not (
+        _num(surv) and 0.0 < surv <= 1.0
+    ):
+        errs.append(
+            f"kernel_prune_survival {surv!r} not in (0, 1]"
+        )
+    spec = record.get("kernel_cand_prune")
+    if "kernel_cand_prune" in record:
+        if not isinstance(spec, str) or not (
+            spec == "off" or _PRUNE_SPEC_RE.match(spec)
+        ):
+            errs.append(
+                f"kernel_cand_prune {spec!r} is neither 'off' nor 'K:M'"
+            )
+        elif _num(surv):
+            # Prune off must report full survival.  The reverse is NOT
+            # checked: a K:M spec with M == K_TOTAL legally yields
+            # survival 1.0 (a keep-all arm isolating coarse overhead).
+            if spec == "off" and surv != 1.0:
+                errs.append(
+                    f"kernel_cand_prune {spec!r} inconsistent with "
+                    f"kernel_prune_survival {surv!r}"
+                )
     p_total = record.get("kernel_bytes_per_polish")
     p_useful = record.get("kernel_bytes_per_polish_useful")
     if _num(p_total) and _num(p_useful):

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Validate a QUANT_r11.json round artifact (the compressed-candidate
+pipeline decision record) — the tools/check_polish.py discipline
+applied to the round-11 artifact, so the acceptance criteria ("a
+measured default-path bit-identity cell, per-arm proxy quality pins
+inside the dist-ratio/PSNR gates, the extended byte model with its
+>= 3x modeled reduction at 1024^2, a pre-stated kill criterion, and
+the hardware A/B recipe") are enforced by a validator instead of
+trusted to prose.
+
+Usage:
+    python tools/check_quant.py QUANT_r11.json
+
+Runs under pytest too (tests/test_check_bench.py TestCheckQuant
+validates the COMMITTED artifact) so tier-1 fails if the record is
+missing, truncated, or structurally degraded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+_CAND_DTYPES = ("bf16", "int8")
+_DIST_RATIO_MAX = 1.80
+_PSNR_MIN_DB = 35.0
+# The tentpole's acceptance floor: modeled candidate-DMA bytes/sweep
+# at 1024^2 on the compressed path, vs the round-7 packed baseline.
+_MIN_BYTES_RATIO = 3.0
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_quant(record: dict) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+
+    dec = record.get("decision")
+    if not isinstance(dec, dict):
+        errs.append("decision: missing object")
+        dec = {}
+    if dec.get("default_cand_dtype") not in _CAND_DTYPES:
+        errs.append(
+            f"decision.default_cand_dtype "
+            f"{dec.get('default_cand_dtype')!r} names none of "
+            f"{_CAND_DTYPES}"
+        )
+    dp = dec.get("default_pca_prune")
+    if not isinstance(dp, str) or not dp.strip():
+        errs.append("decision.default_pca_prune: missing/empty")
+    if not isinstance(dec.get("kill_criterion_prestated"), str) or not (
+        dec.get("kill_criterion_prestated") or ""
+    ).strip():
+        errs.append("decision.kill_criterion_prestated: missing/empty")
+
+    meas = record.get("measured_this_round")
+    if not isinstance(meas, dict):
+        errs.append("measured_this_round: missing object")
+        meas = {}
+    if meas.get("default_bit_identical") is not True:
+        errs.append(
+            "measured_this_round.default_bit_identical must be true — "
+            "the bf16/prune-off path must reproduce today's graphs "
+            "byte-for-byte"
+        )
+    arms = meas.get("arms")
+    if not isinstance(arms, list) or len(arms) < 2:
+        errs.append(
+            "measured_this_round.arms: need the baseline plus at "
+            "least one compressed arm"
+        )
+        arms = []
+    for i, arm in enumerate(arms):
+        if not isinstance(arm, dict):
+            errs.append(f"arms[{i}]: not an object")
+            continue
+        if arm.get("cand_dtype") not in _CAND_DTYPES:
+            errs.append(
+                f"arms[{i}].cand_dtype {arm.get('cand_dtype')!r} "
+                f"names none of {_CAND_DTYPES}"
+            )
+        ratio = arm.get("dist_ratio_vs_exact")
+        if not (_num(ratio) and 1.0 <= ratio <= _DIST_RATIO_MAX):
+            errs.append(
+                f"arms[{i}] ({arm.get('cand_dtype')}:"
+                f"{arm.get('pca_prune')}): dist_ratio_vs_exact "
+                f"{ratio!r} outside [1.0, {_DIST_RATIO_MAX}] — the "
+                "quality gate every arm must clear (below 1.0 means "
+                "the probe is broken)"
+            )
+        p = arm.get("psnr_db")
+        if not (_num(p) and p >= _PSNR_MIN_DB):
+            errs.append(
+                f"arms[{i}] ({arm.get('cand_dtype')}:"
+                f"{arm.get('pca_prune')}): psnr_db {p!r} below the "
+                f">= {_PSNR_MIN_DB} dB gate"
+            )
+
+    bm = record.get("byte_model")
+    if not isinstance(bm, dict):
+        errs.append("byte_model: missing object")
+        bm = {}
+    for key in ("sweep_fetch_int8_c4", "polish_fetch_int8",
+                "coarse_row"):
+        pf = bm.get(key)
+        if not isinstance(pf, dict):
+            errs.append(f"byte_model.{key}: missing object")
+            continue
+        moved, useful = pf.get("moved"), pf.get("useful")
+        if not (_num(moved) and _num(useful) and 0 < useful <= moved):
+            errs.append(
+                f"byte_model.{key} moved={moved!r} useful={useful!r} "
+                "violate 0 < useful <= moved"
+            )
+    if bm.get("int8_sweep_pad_bound_at_c4") is not True:
+        errs.append(
+            "byte_model.int8_sweep_pad_bound_at_c4 must be recorded "
+            "true — the int8 sweep fetch at 4 channels is 32-sublane-"
+            "tile-granule-bound (moved bytes equal f32's); omitting "
+            "the negative would overstate the int8 arm"
+        )
+
+    proj = record.get("projection_modeled_not_measured")
+    if not isinstance(proj, dict):
+        errs.append("projection_modeled_not_measured: missing object")
+        proj = {}
+    base = proj.get("bytes_per_sweep_1024_r7_baseline")
+    comp = proj.get("bytes_per_sweep_1024_compressed")
+    if not (_num(base) and base > 0):
+        errs.append(
+            f"projection.bytes_per_sweep_1024_r7_baseline {base!r} "
+            "not positive"
+        )
+    if not (_num(comp) and comp > 0):
+        errs.append(
+            f"projection.bytes_per_sweep_1024_compressed {comp!r} "
+            "not positive"
+        )
+    if _num(base) and _num(comp) and comp > 0:
+        ratio = base / comp
+        rec_ratio = proj.get("reduction_ratio")
+        if not (_num(rec_ratio) and abs(rec_ratio - ratio) < 0.01):
+            errs.append(
+                f"projection.reduction_ratio {rec_ratio!r} != "
+                f"baseline/compressed ({ratio:.3f}) — the headline "
+                "figure must be the recorded cells' quotient"
+            )
+        if ratio < _MIN_BYTES_RATIO:
+            errs.append(
+                f"projection reduction ratio {ratio:.3f} below the "
+                f">= {_MIN_BYTES_RATIO}x acceptance floor (ISSUE 6)"
+            )
+
+    recipe = record.get("hardware_recipe")
+    if not isinstance(recipe, dict) or not isinstance(
+        recipe.get("tool"), str
+    ):
+        errs.append("hardware_recipe.tool: missing")
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record", help="path to QUANT_r11.json")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.record) as f:
+            record = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"check_quant: cannot read {args.record}: {e}",
+              file=sys.stderr)
+        return 2
+    errs = validate_quant(record)
+    if errs:
+        for e in errs:
+            print(f"check_quant: {e}", file=sys.stderr)
+        print(
+            f"check_quant: FAIL — {len(errs)} violation(s) in "
+            f"{args.record}", file=sys.stderr,
+        )
+        return 1
+    dec = record["decision"]
+    print(
+        "check_quant: OK — default="
+        f"{dec['default_cand_dtype']}:{dec['default_pca_prune']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

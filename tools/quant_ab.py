@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Compressed-candidate A/B: int8 candidate tables and/or the PCA
+coarse pre-prune vs the uncompressed pipeline
+(`kernels/patchmatch_tile._CAND_DTYPE` / `_CAND_PRUNE`) — the round-11
+decision gate, in the tools/polish_stream_ab.py discipline.
+
+KILL CRITERION, pre-stated: a compressed mode becomes the default iff,
+on hardware at the 1024^2 headline schedule, (a) its median wall beats
+the bf16/prune-off baseline's, AND (b) its min-over-seeds
+PSNR-vs-oracle stays >= 35 dB with the scale probes' dist-ratio
+<= 1.80.  (b) is a hard veto, not a trade axis — quality inside the
+gates, then the decision rides on (a) alone: either the skipped DMA
+bytes (prune) / smaller rows (int8 polish) buy wall on real HBM, or
+they do not.  A loss is recorded as a negative and bf16/off stays.
+Note the recorded model facts the wall must overcome: at the
+headline's 4 channels the int8 SWEEP fetch is tile-granule-bound
+(2C=8 int8 sublanes pad to the 32-sublane int8 tile — moved bytes
+equal f32's; int8 pays at 2C >= 32, the steerable channel sets), so
+the sweep-side win is the prune's, and the int8 win is the polish's.
+
+No accelerator was reachable in round 11, so this tool is the
+HARDWARE RECIPE (run on the next TPU session; QUANT_r11.json carries
+the modeled projection it will confirm or kill).  On CPU the
+`--verify` arm runs the measured correctness/quality cells the round
+artifact quotes: default-path bit-identity (bf16/off == the module
+defaults, byte-for-byte) and per-arm proxy-size quality pins
+(dist-ratio vs the exact NN, PSNR vs the brute-oracle synthesis).
+
+    python tools/quant_ab.py [size]            # TPU A/B
+    python tools/quant_ab.py --verify [size]   # CPU proxy pins
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from image_analogies_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+from image_analogies_tpu import SynthConfig, create_image_analogy, psnr
+from image_analogies_tpu.utils.examples import super_resolution
+from image_analogies_tpu.utils.kernelbench import sync as _sync
+
+# The four arms: (cand_dtype, pca_prune).  16:8 is the recipe default
+# — at the 1024^2 packed C=4 geometry it models bytes/sweep at ~3.9x
+# under the r7 baseline (QUANT_r11.json projection) while keeping 8 of
+# 36 candidates per tile per sweep.
+ARMS = (
+    ("bf16", "off"),
+    ("int8", "off"),
+    ("bf16", "16:8"),
+    ("int8", "16:8"),
+)
+
+
+def _set_mode(cand_dtype, prune):
+    from image_analogies_tpu.kernels.patchmatch_tile import (
+        set_cand_compression,
+    )
+
+    set_cand_compression(cand_dtype, prune)
+
+
+def _restore_env_mode():
+    _set_mode(
+        os.environ.get("IA_CAND_DTYPE", "bf16"),
+        os.environ.get("IA_CAND_PRUNE", "off"),
+    )
+
+
+def _dist_ratio(size: int, passes: int = 3) -> float:
+    """Matcher-level dist-ratio vs the exact NN at the proxy size:
+    `passes` tile-matcher calls (interpret mode, headline pm schedule,
+    each seeding the next — the EM/pyramid warm-start the real
+    synthesis provides) on assembled features of the super-resolution
+    pair, final mean returned dist over mean exact dist — the SCALE
+    artifacts' quality ratio, self-contained at CPU cost.  The
+    uncompressed baseline measures ~1.1 here (recorded in
+    QUANT_r11.json), so a compressed arm's drift is visible long
+    before the 1.80 envelope."""
+    from image_analogies_tpu.kernels.patchmatch_tile import (
+        plan_channels,
+        prepare_a_planes,
+    )
+    from image_analogies_tpu.models.brute import exact_nn
+    from image_analogies_tpu.models.matcher import get_matcher, nnf_dist
+    from image_analogies_tpu.models.patchmatch import RawPlanes
+    from image_analogies_tpu.ops.features import assemble_features
+
+    cfg = SynthConfig(
+        levels=1, matcher="patchmatch", pallas_mode="interpret",
+        em_iters=1, pm_iters=6, pm_polish_iters=1,
+    )
+    a, ap, b = super_resolution(size)
+    a, ap, b = (jnp.asarray(x, jnp.float32) for x in (a, ap, b))
+    f_b = assemble_features(b, b, cfg, None, None)
+    f_a = assemble_features(a, ap, cfg, None, None)
+    plan = plan_channels(1, 1, cfg, False, size, size, size, size)
+    a_planes = prepare_a_planes(a, ap, None, None, plan[0])
+    raw = RawPlanes(a, ap, None, None, a_planes)
+    # The prune/int8 mode is read inside match via the module globals.
+    m = get_matcher("patchmatch")
+    nnf = jnp.zeros((size, size, 2), jnp.int32)
+    for p in range(passes):
+        nnf, _ = m.match(
+            f_b, f_a, nnf, key=jax.random.PRNGKey(p), level=0, cfg=cfg,
+            raw=raw,
+        )
+    d = f_a.shape[-1]
+    # Score the RETURNED FIELD under the exact metric (nnf_dist), not
+    # the matcher's reported dist: an int8 arm's reported metric is
+    # computed on dequantized rows, whose quantization term biases the
+    # numerator even when the assignment itself is good — the gate is
+    # about match quality, so both sides of the ratio must be the same
+    # exact metric.
+    d_field = nnf_dist(f_b, f_a.reshape(-1, d), nnf, size)
+    _, d_exact = exact_nn(
+        f_b.reshape(-1, d), f_a.reshape(-1, d), chunk=4096
+    )
+    return float(d_field.mean()) / max(float(d_exact.mean()), 1e-30)
+
+
+def verify(size: int) -> dict:
+    """CPU proxy cells for QUANT_r11.json: default-path bit-identity
+    plus per-arm dist-ratio and PSNR-vs-brute-oracle pins at the proxy
+    size (interpret mode)."""
+    a, ap, b = super_resolution(size)
+    a, ap, b = (jnp.asarray(x, jnp.float32) for x in (a, ap, b))
+    cfg = SynthConfig(
+        levels=2, matcher="patchmatch", pallas_mode="interpret",
+        em_iters=1, pm_iters=3, pm_polish_iters=1,
+    )
+    # Bit-identity: the module defaults ARE bf16/off — setting them
+    # explicitly (through the same setter the CLI uses) must reproduce
+    # the default graphs byte-for-byte.
+    out_default = np.asarray(create_image_analogy(a, ap, b, cfg))
+    _set_mode("bf16", "off")
+    out_explicit = np.asarray(create_image_analogy(a, ap, b, cfg))
+    bit_identical = bool((out_default == out_explicit).all())
+
+    oracle = np.asarray(create_image_analogy(
+        a, ap, b,
+        SynthConfig(levels=2, matcher="brute", em_iters=1),
+    ))
+    arms = []
+    for cand_dtype, prune in ARMS:
+        _set_mode(cand_dtype, prune)
+        out = np.asarray(create_image_analogy(a, ap, b, cfg))
+        # The zero-init probe needs more passes at larger A domains
+        # (the real synthesis warm-starts from the EM/pyramid): 3
+        # converge 128^2, 5 converge 192^2 — measured, not tuned to
+        # pass (the uncompressed baseline is held to the same gate).
+        arms.append({
+            "cand_dtype": cand_dtype,
+            "pca_prune": prune,
+            "psnr_db": round(psnr(out, oracle), 2),
+            "dist_ratio_vs_exact": round(
+                _dist_ratio(size, passes=3 if size <= 128 else 5), 4
+            ),
+        })
+    _restore_env_mode()
+    return {
+        "arm": "verify",
+        "size": size,
+        "backend": "cpu-interpret",
+        "default_bit_identical": bit_identical,
+        "arms": arms,
+        "gates": {"dist_ratio_max": 1.80, "psnr_min_db": 35.0},
+    }
+
+
+def measure(cand_dtype, prune, a, ap, b, oracle) -> dict:
+    _set_mode(cand_dtype, prune)
+    cfg = SynthConfig(
+        levels=5, matcher="patchmatch", em_iters=2, pm_iters=6,
+        pm_polish_iters=1,
+    )
+    run = lambda: create_image_analogy(a, ap, b, cfg)  # noqa: E731
+    _sync(run())  # compile
+    walls = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _sync(run())
+        walls.append(round(time.perf_counter() - t0, 4))
+    seeds_psnr = []
+    for seed in (0, 1, 2):
+        cfg_s = SynthConfig(
+            levels=5, matcher="patchmatch", em_iters=2, pm_iters=6,
+            pm_polish_iters=1, seed=seed,
+        )
+        o = np.asarray(create_image_analogy(a, ap, b, cfg_s))
+        seeds_psnr.append(round(psnr(o, oracle), 2))
+    return {
+        "cand_dtype": cand_dtype,
+        "pca_prune": prune,
+        "wall_median_s": statistics.median(walls),
+        "wall_runs_s": walls,
+        "psnr_seeds_db": seeds_psnr,
+        "psnr_min_db": min(seeds_psnr),
+    }
+
+
+def main():
+    args = [x for x in sys.argv[1:] if x != "--verify"]
+    size = int(args[0]) if args else 1024
+    if "--verify" in sys.argv:
+        print(json.dumps(verify(min(size, 192))), flush=True)
+        return
+    a, ap, b = super_resolution(size)
+    a, ap, b = (jnp.asarray(x, jnp.float32) for x in (a, ap, b))
+    for x in (a, ap, b):
+        _sync(x)
+    oracle = np.asarray(create_image_analogy(
+        a, ap, b, SynthConfig(levels=5, matcher="brute", em_iters=2)
+    ))
+    rows = [
+        measure(cand_dtype, prune, a, ap, b, oracle)
+        for cand_dtype, prune in ARMS
+    ]
+    base = rows[0]
+    res = {
+        "size": size,
+        "arms": rows,
+        "kill_criterion": (
+            "a compressed arm ships iff wall_median < the bf16/off "
+            "baseline's at the 1024^2 headline AND psnr_min_db >= 35 "
+            "(hard veto; dist-ratio <= 1.80 at the scale probes rides "
+            "the SCALE artifact) — wall decides, quality only vetoes"
+        ),
+        "decision": "bf16:off",
+    }
+    best = base
+    for row in rows[1:]:
+        if (
+            row["wall_median_s"] < best["wall_median_s"]
+            and row["psnr_min_db"] >= 35.0
+        ):
+            best = row
+    res["decision"] = f"{best['cand_dtype']}:{best['pca_prune']}"
+    _restore_env_mode()
+    print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()
