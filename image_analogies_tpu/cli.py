@@ -99,6 +99,39 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
         "--resume-from", default=None, metavar="DIR",
         help="resume mid-pyramid from a --save-level-artifacts directory",
     )
+    p.add_argument(
+        "--strict-resume", action="store_true",
+        help="error out (naming the directory and every rejection, "
+        "fingerprint mismatches included) when --resume-from holds no "
+        "usable checkpoint, instead of warning and recomputing from "
+        "scratch",
+    )
+    p.add_argument(
+        "--supervise", action="store_true",
+        help="run under the supervised execution layer "
+        "(runtime/supervisor.py): per-level watchdog deadlines from "
+        "the cost model, retry-with-resume from the per-level "
+        "checkpoints (save-level-artifacts is forced on), a graceful-"
+        "degradation ladder over the engine's fallback seams, and a "
+        "validated flight dump + exit != 0 when it finally gives up.  "
+        "Implies instrumentation (one host sync per level)",
+    )
+    p.add_argument(
+        "--max-retries", type=int, default=2, metavar="N",
+        help="supervised mode: retries per degradation-ladder rung "
+        "before stepping down (default 2)",
+    )
+    p.add_argument(
+        "--watchdog-slack", type=float, default=None, metavar="X",
+        help="supervised mode: level deadline = modeled cost x "
+        "calibrated rate x this slack factor (default 4.0)",
+    )
+    p.add_argument(
+        "--watchdog-static-deadline", type=float, default=None,
+        metavar="SECONDS",
+        help="supervised mode: conservative per-level bound applied "
+        "before the cost model is calibrated (default 900)",
+    )
     p.add_argument("--progress", default=None, help="JSONL progress path")
     trace = p.add_mutually_exclusive_group()
     trace.add_argument(
@@ -265,7 +298,7 @@ def cmd_synth(args) -> int:
     # NOT enable spans; --trace-dir (the telemetry layout) does.
     instrument = bool(
         args.progress or args.trace_dir or args.health
-        or args.metrics_port is not None
+        or args.metrics_port is not None or args.supervise
     )
     if args.bands > 1 and not args.spatial:
         raise SystemExit(
@@ -273,6 +306,7 @@ def cmd_synth(args) -> int:
             "the 2-D bands x slabs mesh); for A-side banding alone use "
             "--sharded-a"
         )
+    cfg, ckpt_dir, ckpt_ephemeral = _force_ckpt_dir(args, cfg)
     # Telemetry artifacts go ONLY to --trace-dir; a --profile dir is
     # device-trace-only (its documented contract).
     with telemetry_session(
@@ -285,45 +319,68 @@ def cmd_synth(args) -> int:
         events = tracer if tracer.enabled else progress
         events.emit("start", shape=list(b.shape), matcher=cfg.matcher)
         level_progress = tracer if instrument else None
-        if args.spatial:
-            import jax
-
-            from .parallel.mesh import make_mesh
-            from .parallel.spatial import synthesize_spatial
-
-            if args.bands > 1:
-                n_dev = args.n_devices or len(jax.devices())
-                if n_dev % args.bands:
-                    raise SystemExit(
-                        f"--bands {args.bands} must divide the device "
-                        f"count ({n_dev})"
-                    )
-                mesh = make_mesh(
-                    n_dev, axis_names=("bands", "slabs"),
-                    shape=(args.bands, n_dev // args.bands),
-                )
-            else:
-                mesh = make_mesh(args.n_devices)
-            bp = synthesize_spatial(
-                a, ap, b, cfg, mesh,
-                progress=level_progress,
-                resume_from=args.resume_from,
+        runner_state = {
+            "mode": (
+                "spatial" if args.spatial
+                else "sharded_a" if args.sharded_a else "single"
             )
-        elif args.sharded_a:
-            from .parallel.mesh import make_mesh
-            from .parallel.sharded_a import synthesize_sharded_a
+        }
+        strict_state = {"first": True}
 
-            bp = synthesize_sharded_a(
-                a, ap, b, cfg,
-                make_mesh(args.n_devices, axis_names=("bands",)),
-                progress=level_progress,
-                resume_from=args.resume_from,
+        def _dispatch(resume_from):
+            mode = runner_state["mode"]
+            if mode == "spatial":
+                import jax
+
+                from .parallel.mesh import make_mesh
+                from .parallel.spatial import synthesize_spatial
+
+                if args.bands > 1:
+                    n_dev = args.n_devices or len(jax.devices())
+                    if n_dev % args.bands:
+                        raise SystemExit(
+                            f"--bands {args.bands} must divide the "
+                            f"device count ({n_dev})"
+                        )
+                    mesh = make_mesh(
+                        n_dev, axis_names=("bands", "slabs"),
+                        shape=(args.bands, n_dev // args.bands),
+                    )
+                else:
+                    mesh = make_mesh(args.n_devices)
+                return synthesize_spatial(
+                    a, ap, b, cfg, mesh,
+                    progress=level_progress,
+                    resume_from=resume_from,
+                    resume_strict=_resume_strict_for(args, resume_from, strict_state),
+                )
+            if mode == "sharded_a":
+                from .parallel.mesh import make_mesh
+                from .parallel.sharded_a import synthesize_sharded_a
+
+                return synthesize_sharded_a(
+                    a, ap, b, cfg,
+                    make_mesh(args.n_devices, axis_names=("bands",)),
+                    progress=level_progress,
+                    resume_from=resume_from,
+                    resume_strict=_resume_strict_for(args, resume_from, strict_state),
+                )
+            return create_image_analogy(
+                a, ap, b, cfg, progress=level_progress,
+                resume_from=resume_from,
+                resume_strict=_resume_strict_for(args, resume_from, strict_state),
+            )
+
+        if args.supervise:
+            bp = _run_supervised(
+                args, _dispatch, runner_state, ckpt_dir, tracer,
+                ckpt_ephemeral,
             )
         else:
-            bp = create_image_analogy(
-                a, ap, b, cfg, progress=level_progress,
-                resume_from=args.resume_from,
-            )
+            try:
+                bp = _dispatch(args.resume_from)
+            except _resume_error_type() as e:
+                raise SystemExit(str(e))
         # Materialize on the host before stopping the clock: under the
         # tunnelled axon platform block_until_ready can return before
         # remote execution finishes, which would report dispatch time.
@@ -340,12 +397,129 @@ def cmd_synth(args) -> int:
     return 0
 
 
+def _force_ckpt_dir(args, cfg):
+    """Supervised mode needs checkpoints to retry from: force
+    save_level_artifacts on (the knob is stripped from jit cache keys,
+    so the graphs are unchanged — _strip_noncompute).  Shared by
+    cmd_synth and cmd_batch; returns (cfg, ckpt_dir, ephemeral) with
+    ckpt_dir None when not supervising and `ephemeral` True when the
+    dir is a run-private tempdir to remove after success."""
+    if not args.supervise:
+        return cfg, None, False
+    import dataclasses
+    import tempfile
+
+    ephemeral = False
+    ckpt_dir = cfg.save_level_artifacts
+    if not ckpt_dir and args.trace_dir:
+        ckpt_dir = os.path.join(args.trace_dir, "supervisor_ckpt")
+    elif not ckpt_dir:
+        # Nobody asked to keep these checkpoints: clean them up after
+        # a successful supervised run (a give-up keeps them — they are
+        # the manual-resume half of the post-mortem).  At the 4096^2
+        # scales each run's per-level state is multi-GB; leaking one
+        # temp dir per run would fill /tmp.
+        ckpt_dir = tempfile.mkdtemp(prefix="ia_supervisor_ckpt_")
+        ephemeral = True
+    return dataclasses.replace(
+        cfg, save_level_artifacts=ckpt_dir
+    ), ckpt_dir, ephemeral
+
+
+def _resume_error_type():
+    """Lazy ResumeError accessor (models.analogy imports jax; the CLI
+    front matter must stay import-light)."""
+    from .models.analogy import ResumeError
+
+    return ResumeError
+
+
+def _resume_strict_for(args, resume_from, state) -> bool:
+    """--strict-resume binds to the USER's resume source on the FIRST
+    attempt only (`state` is a per-command {"first": True} consumed
+    here): a supervisor-internal retry must stay lenient even when the
+    forced checkpoint dir string-equals the user's --resume-from (the
+    natural continuation invocation `--resume-from D
+    --save-level-artifacts D`), because the retry's artifacts may
+    legitimately be partial or — under an injected truncate — corrupt;
+    the loader's skip-and-warn is exactly the healing path."""
+    first = state.pop("first", False)
+    return bool(
+        first
+        and args.strict_resume
+        and resume_from is not None
+        and resume_from == args.resume_from
+    )
+
+
+def _run_supervised(args, dispatch, runner_state, ckpt_dir, tracer,
+                    ckpt_ephemeral=False):
+    """Shared synth/batch supervised entry: build the ladder (the
+    default process-seam rungs plus a mesh->single-device rung when a
+    parallel runner is active), run under `runtime.supervisor`, and
+    turn a give-up into a clean nonzero exit — the flight dump has
+    already been flushed by then."""
+    from .runtime.supervisor import (
+        STATIC_DEADLINE_S,
+        WATCHDOG_SLACK,
+        Rung,
+        SupervisorGaveUp,
+        default_ladder,
+        supervise,
+    )
+
+    ladder = default_ladder()
+    if runner_state["mode"] != "single":
+        ladder.append(Rung(
+            "mesh_to_single_device", "mesh", "single",
+            applies=lambda: runner_state["mode"] != "single",
+            apply=lambda: runner_state.update(mode="single"),
+            # The parallel runners are pinned bit-identical to
+            # single-device synthesis (spatial halo geometry; sharded-A
+            # at lean levels), so stepping off the mesh trades only
+            # wall clock.
+            bit_safe=True,
+        ))
+    try:
+        result = supervise(
+            dispatch,
+            ckpt_dir=ckpt_dir,
+            tracer=tracer,
+            initial_resume=args.resume_from,
+            max_retries=args.max_retries,
+            watchdog_slack=(
+                args.watchdog_slack if args.watchdog_slack is not None
+                else WATCHDOG_SLACK
+            ),
+            static_deadline_s=(
+                args.watchdog_static_deadline
+                if args.watchdog_static_deadline is not None
+                else STATIC_DEADLINE_S
+            ),
+            ladder=ladder,
+        )
+    except SupervisorGaveUp as e:
+        # The checkpoints stay (even an ephemeral dir): they are the
+        # manual-resume half of the post-mortem.
+        raise SystemExit(f"supervised synthesis gave up: {e}")
+    except _resume_error_type() as e:
+        # Strict-resume config error: the supervisor re-raises it
+        # instead of retrying (a retry would silently recompute from
+        # scratch — the outcome --strict-resume forbids).
+        raise SystemExit(str(e))
+    if ckpt_ephemeral:
+        import shutil
+
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return result
+
+
 def cmd_batch(args) -> int:
     _apply_cand_compression(args)
     _select_device(args.device)
     import numpy as np
 
-    from .parallel.batch import synthesize_batch
+    from .parallel.batch import ingest_frame_dir, synthesize_batch
     from .parallel.mesh import make_mesh
     from .utils.io import load_image, save_image
     from .utils.profiling import telemetry_session
@@ -354,36 +528,75 @@ def cmd_batch(args) -> int:
     progress = ProgressWriter(args.progress)
     a = load_image(args.a)
     ap = load_image(args.ap)
-    names = sorted(
-        f for f in os.listdir(args.frames)
-        if f.lower().endswith((".png", ".jpg", ".jpeg"))
+    # Per-frame fault isolation (round 12): an unreadable/undecodable
+    # frame is skipped and recorded instead of aborting the batch;
+    # --strict-frames restores abort-on-first-error.
+    frames, names, frame_failures = ingest_frame_dir(
+        args.frames, strict=args.strict_frames
     )
-    frames = np.stack([load_image(os.path.join(args.frames, f)) for f in names])
     cfg = _config_from(args)
     mesh = make_mesh(args.n_devices)
     t0 = time.perf_counter()
 
     # --profile keeps its historic un-instrumented-trace meaning (see
     # cmd_synth); only --progress / --trace-dir / --health /
-    # --metrics-port enable spans, and telemetry artifacts land only
-    # in --trace-dir.
+    # --metrics-port / --supervise enable spans, and telemetry
+    # artifacts land only in --trace-dir.
     instrument = bool(
         args.progress or args.trace_dir or args.health
-        or args.metrics_port is not None
+        or args.metrics_port is not None or args.supervise
     )
+    cfg, ckpt_dir, ckpt_ephemeral = _force_ckpt_dir(args, cfg)
     with telemetry_session(
         args.trace_dir or args.profile, sink=progress,
         enabled=instrument, artifact_dir=args.trace_dir,
         metrics_port=args.metrics_port,
     ) as tracer:
-        bps = np.asarray(
-            synthesize_batch(
-                a, ap, frames, cfg, mesh,
+        if frame_failures and tracer.enabled:
+            from .telemetry.metrics import get_registry
+
+            c = get_registry().counter(
+                "ia_frames_failed_total",
+                "batch-ingest frames skipped for per-frame faults "
+                "(unreadable/undecodable; --strict-frames aborts "
+                "instead)",
+            )
+            for rec in frame_failures:
+                c.inc(labels={
+                    "reason": rec["reason"].split(":", 1)[0],
+                })
+            tracer.emit(
+                "frame_failures",
+                n=len(frame_failures),
+                frames=[rec["path"] for rec in frame_failures],
+            )
+        runner_state = {"mode": "mesh" if mesh.devices.size > 1 else "single"}
+        strict_state = {"first": True}
+
+        def _dispatch(resume_from):
+            run_mesh = (
+                mesh if runner_state["mode"] == "mesh" else make_mesh(1)
+            )
+            return synthesize_batch(
+                a, ap, frames, cfg, run_mesh,
                 progress=tracer if instrument else None,
                 frames_per_step=args.frames_per_step,
-                resume_from=args.resume_from,
+                resume_from=resume_from,
+                resume_strict=_resume_strict_for(args, resume_from, strict_state),
             )
-        )
+
+        if args.supervise:
+            bps = np.asarray(
+                _run_supervised(
+                    args, _dispatch, runner_state, ckpt_dir, tracer,
+                    ckpt_ephemeral,
+                )
+            )
+        else:
+            try:
+                bps = np.asarray(_dispatch(args.resume_from))
+            except _resume_error_type() as e:
+                raise SystemExit(str(e))
     os.makedirs(args.out, exist_ok=True)
     for name, bp in zip(names, bps):
         save_image(os.path.join(args.out, name), bp)
@@ -391,6 +604,15 @@ def cmd_batch(args) -> int:
         f"wrote {len(names)} frames to {args.out} "
         f"({time.perf_counter() - t0:.2f}s on {mesh.devices.size} devices)"
     )
+    # Batch epilogue: the per-frame fault ledger (path + reason), so a
+    # partially-ingested batch is explicit in the run's own output.
+    for rec in frame_failures:
+        print(f"frame FAILED (skipped): {rec['path']} — {rec['reason']}")
+    if frame_failures:
+        print(
+            f"{len(frame_failures)} frame(s) skipped; rerun with "
+            "--strict-frames to abort on ingest errors instead"
+        )
     # Sentinel epilogue after the frames are on disk (see cmd_synth).
     if args.health:
         _emit_health(tracer, args.trace_dir, "batch")
@@ -525,6 +747,12 @@ def main(argv=None) -> int:
         help="process frames in sequential microbatches of this size "
         "(bounds HBM on small meshes; full-scale 8x1024 budgets one "
         "frame per chip)",
+    )
+    p.add_argument(
+        "--strict-frames", action="store_true",
+        help="abort on the first unreadable/undecodable frame instead "
+        "of skipping it with a recorded per-frame status (the round-12 "
+        "fault-isolation default)",
     )
     _add_synth_flags(p)
     p.set_defaults(fn=cmd_batch)

@@ -225,24 +225,54 @@ def set_cand_compression(cand_dtype: Optional[str] = None,
         parse_prune(prune)  # validate before assigning
         _CAND_PRUNE = prune
     if cand_dtype is not None or prune is not None:
-        from ..models import analogy as _an
-        from ..parallel import batch as _pb
-        from ..parallel import sharded_a as _psa
-        from ..parallel import spatial as _psp
+        clear_compiled_level_caches()
 
-        # EVERY cached level/EM compilation resolves the mode at trace
-        # time, so all of them must drop — the parallel runners' lru
-        # entries included, or a flipped mode would silently reuse a
-        # stale arm's graphs (no dtype assert fires there: the cached
-        # fn prepared its own planes under the old mode).
-        for fn in (
-            _an._level_fn, _an._em_step_fn,
-            _pb._batch_step_fn_cached, _pb._lean_step_fn_cached,
-            _pb._batch_prologue_fn_cached, _pb._batch_level_fn_cached,
-            _psa._band_assemble_fn, _psa._sharded_level_fn,
-            _psp._reslab_fn, _psp._banded_lean_step_fn,
-        ):
-            fn.cache_clear()
+
+def clear_compiled_level_caches() -> None:
+    """Drop every cached level/EM compilation across all four runners.
+
+    EVERY cached level/EM compilation resolves the process-wide kernel
+    modes (_CAND_DTYPE/_CAND_PRUNE/_PACKED_DEFAULT here,
+    models/patchmatch._POLISH_MODE) at trace time, so a mode flip must
+    drop all of them — the parallel runners' lru entries included, or
+    a flipped mode would silently reuse a stale arm's graphs (no dtype
+    assert fires there: the cached fn prepared its own planes under
+    the old mode).  Shared by `set_cand_compression`,
+    `set_packed_layout`, and `models/patchmatch.set_polish_mode` (the
+    round-12 degradation-ladder setters)."""
+    from ..models import analogy as _an
+    from ..parallel import batch as _pb
+    from ..parallel import sharded_a as _psa
+    from ..parallel import spatial as _psp
+
+    for fn in (
+        _an._level_fn, _an._em_step_fn,
+        _pb._batch_step_fn_cached, _pb._lean_step_fn_cached,
+        _pb._batch_prologue_fn_cached, _pb._batch_level_fn_cached,
+        _psa._band_assemble_fn, _psa._sharded_level_fn,
+        _psp._reslab_fn, _psp._banded_lean_step_fn,
+    ):
+        fn.cache_clear()
+
+
+def set_packed_layout(layout: str) -> None:
+    """Install an A-plane layout process-wide (round 12: the
+    supervisor's packed->unpacked degradation rung; also the layout
+    A/B's programmatic entry): validates, assigns the module default,
+    and clears the compiled level/EM caches — packed and unpacked are
+    bit-identical through the full matcher path (round 7, test-pinned)
+    so the rung is bit-safe; only the DMA geometry changes."""
+    global _PACKED_DEFAULT
+    if layout not in ("packed", "unpacked"):
+        raise ValueError(
+            f"A-plane layout {layout!r} names neither 'packed' nor "
+            "'unpacked'"
+        )
+    packed = layout != "unpacked"
+    if packed == _PACKED_DEFAULT:
+        return
+    _PACKED_DEFAULT = packed
+    clear_compiled_level_caches()
 # Tile geometry: the padded tile is exactly one lane block wide so the
 # separable window never needs lane slicing.  P is the union halo of the
 # fine window (patch//2) and the dilated coarse window (2*(coarse//2)).

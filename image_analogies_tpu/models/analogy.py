@@ -1244,6 +1244,7 @@ def create_image_analogy(
     return_aux: bool = False,
     progress=None,
     resume_from: Optional[str] = None,
+    resume_strict: bool = False,
 ):
     """Synthesize B' such that A : A' :: B : B'.
 
@@ -1265,6 +1266,9 @@ def create_image_analogy(
     Synthesis restarts from the finest completed level's (nnf, B') state;
     with the same cfg/seed the result is identical to an uninterrupted
     run (per-level keys derive from the level index, not the path here).
+    `resume_strict=True` turns an unusable `resume_from` (missing
+    directory, zero intact artifacts, every fingerprint mismatched)
+    into a `ResumeError` instead of a warned from-scratch recompute.
     """
     cfg = cfg or SynthConfig()
     tracer = as_tracer(progress)
@@ -1280,13 +1284,20 @@ def create_image_analogy(
         shape=[int(s) for s in b.shape[:2]],
     ):
         return _synthesize_single(
-            a, ap, b, cfg, levels, return_aux, tracer, resume_from
+            a, ap, b, cfg, levels, return_aux, tracer, resume_from,
+            resume_strict,
         )
 
 
 def _synthesize_single(a, ap, b, cfg: SynthConfig, levels: int,
-                       return_aux: bool, tracer, resume_from):
+                       return_aux: bool, tracer, resume_from,
+                       resume_strict: bool = False):
     """`create_image_analogy` body, running under its `run` span."""
+    from ..runtime.faults import fire as _fault_fire
+
+    # xfer injection point: the prologue dispatch is the run's
+    # host->device transfer boundary (runtime/faults.py).
+    _fault_fire("xfer", 0)
     prologue_t0 = time.perf_counter()
     (
         pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, pyr_raw_b, yiq_b
@@ -1299,7 +1310,9 @@ def _synthesize_single(a, ap, b, cfg: SynthConfig, levels: int,
     nnf = None
 
     start_level = levels - 1
-    resumed = resume_prologue(resume_from, levels, cfg, b.shape, tracer)
+    resumed = resume_prologue(
+        resume_from, levels, cfg, b.shape, tracer, strict=resume_strict
+    )
     if resumed is not None:
         start_level, nnf, bp, aux_fill = resumed
         if return_aux:
@@ -1320,6 +1333,8 @@ def _synthesize_single(a, ap, b, cfg: SynthConfig, levels: int,
     )
 
     for level in range(start_level, -1, -1):
+        # level injection point + supervisor abort checkpoint.
+        _fault_fire("level", level)
         with tracer.span("level", level=level) as lvl_span:
             h, w = pyr_src_b[level].shape[:2]
             ha, wa = pyr_src_a[level].shape[:2]
@@ -1345,6 +1360,9 @@ def _synthesize_single(a, ap, b, cfg: SynthConfig, levels: int,
                 cfg, level, has_coarse, plan.lean, plan.prev_kind,
                 plan.fa_external, plan.fuse,
             )
+            # kernel injection point: the compiled level executable is
+            # about to launch.
+            _fault_fire("kernel", level)
             nnf, dist, bp = run(
                 pyr_src_a[level],
                 pyr_flt_a[level],
@@ -1463,6 +1481,13 @@ def _save_level(path: str, level: int, nnf, dist, bp, cfg, b_shape) -> None:
     Written to a temp file and renamed so a kill mid-write never leaves a
     truncated .npz where resume would trip over it; stamped with the run
     fingerprint so resume can reject stale/mismatched checkpoints."""
+    from ..runtime.faults import fire as _fault_fire
+
+    # ckpt injection point (runtime/faults.py): 'raise'/'hang' fire
+    # here before the write; 'truncate' is interpreted below, after
+    # the atomic rename — the partial-write-survived-on-disk case the
+    # resume loader must skip.
+    act = _fault_fire("ckpt", level)
     os.makedirs(path, exist_ok=True)
     final = os.path.join(path, f"level_{level}.npz")
     tmp = f"{final}.{os.getpid()}.tmp"
@@ -1475,21 +1500,52 @@ def _save_level(path: str, level: int, nnf, dist, bp, cfg, b_shape) -> None:
             fingerprint=np.asarray(_ckpt_fingerprint(cfg, b_shape)),
         )
     os.replace(tmp, final)
+    if act == "truncate":
+        size = os.path.getsize(final)
+        with open(final, "r+b") as f:
+            f.truncate(max(1, size // 3))
 
 
-def resume_prologue(resume_from, levels: int, cfg, b_shape, progress):
+class ResumeError(RuntimeError):
+    """An explicitly-requested resume found nothing usable and the
+    caller demanded strictness (round-12 hardening): the message names
+    the directory and every rejection — including fingerprint
+    mismatches — so the operator can tell a wrong path from a stale
+    checkpoint without re-running."""
+
+
+def resume_prologue(resume_from, levels: int, cfg, b_shape, progress,
+                    strict: bool = False):
     """Shared resume entry for every synthesis runner.
 
     Returns None (no usable checkpoint — start fresh) or
     (start_level, nnf, bp, {level: (nnf, dist)}): start from
     `start_level` (-1 = every level was checkpointed; finalize `bp`
-    directly) with the loaded state as the incoming coarse state."""
+    directly) with the loaded state as the incoming coarse state.
+
+    `strict=True` (the CLI's --strict-resume): an unusable
+    `resume_from` raises `ResumeError` naming the directory and each
+    rejection reason instead of warning and recomputing from scratch —
+    the explicit outcome a multi-hour resume deserves."""
     if not resume_from:
         return None
+    reasons: List[str] = []
     loaded = _load_resume_state(
-        resume_from, levels, _ckpt_fingerprint(cfg, b_shape), cfg
+        resume_from, levels, _ckpt_fingerprint(cfg, b_shape), cfg,
+        reasons=reasons,
     )
     if loaded is None:
+        if not os.path.isdir(resume_from):
+            reasons.insert(
+                0, f"directory {resume_from!r} does not exist"
+            )
+        elif not reasons:
+            reasons.insert(0, "no level_*.npz artifacts found")
+        if strict:
+            raise ResumeError(
+                f"resume: no usable checkpoint under {resume_from!r}: "
+                + "; ".join(reasons)
+            )
         # ADVICE r2: an explicitly-requested resume that silently
         # recomputes from scratch hides a multi-hour surprise — corrupt
         # or mismatched files warn inside _load_resume_state, but an
@@ -1498,9 +1554,8 @@ def resume_prologue(resume_from, levels: int, cfg, b_shape, progress):
         import logging
 
         logging.getLogger("image_analogies_tpu").warning(
-            "resume: no usable checkpoint under %r (missing directory, "
-            "no level_*.npz, or all artifacts rejected) — recomputing "
-            "from scratch", resume_from,
+            "resume: no usable checkpoint under %r (%s) — recomputing "
+            "from scratch", resume_from, "; ".join(reasons),
         )
         return None
     resumed_level, nnf, _dist, bp, aux_fill = loaded
@@ -1509,7 +1564,8 @@ def resume_prologue(resume_from, levels: int, cfg, b_shape, progress):
     return resumed_level - 1, nnf, bp, aux_fill
 
 
-def _load_resume_state(path: str, levels: int, fingerprint: str, cfg):
+def _load_resume_state(path: str, levels: int, fingerprint: str, cfg,
+                       reasons: Optional[List[str]] = None):
     """Resume state from a checkpoint dir: (finest_loadable_level, nnf,
     dist, bp, {level: (nnf, dist)} for every loadable level), or None
     when nothing usable exists.
@@ -1519,12 +1575,16 @@ def _load_resume_state(path: str, levels: int, fingerprint: str, cfg):
     must survive exactly the crashes it exists for — or when their
     fingerprint does not match the current run (different input shape,
     seed, matcher, or any other result-shaping knob): silently resuming
-    a stale checkpoint would produce a wrong image with exit code 0."""
+    a stale checkpoint would produce a wrong image with exit code 0.
+    `reasons` (round-12 hardening) collects one line per rejection so
+    strict callers can raise an actionable error."""
     import logging
     import re
     import zipfile
 
     log = logging.getLogger("image_analogies_tpu")
+    if reasons is None:
+        reasons = []
     loadable = {}
     if os.path.isdir(path):
         for name in os.listdir(path):
@@ -1540,12 +1600,17 @@ def _load_resume_state(path: str, levels: int, fingerprint: str, cfg):
                         "by an older version; re-save to make it resumable)",
                         name,
                     )
+                    reasons.append(f"{name}: no run fingerprint")
                     continue
                 saved_fp = str(data["fingerprint"])
                 if not _fingerprint_matches(saved_fp, fingerprint, cfg):
                     log.warning(
                         "resume: skipping %s (checkpoint from a different "
                         "run: %s != %s)", name, saved_fp, fingerprint,
+                    )
+                    reasons.append(
+                        f"{name}: fingerprint mismatch (saved "
+                        f"{saved_fp!r} != expected {fingerprint!r})"
                     )
                     continue
                 loadable[lvl] = (
@@ -1555,6 +1620,7 @@ def _load_resume_state(path: str, levels: int, fingerprint: str, cfg):
                 )
             except (OSError, ValueError, KeyError, zipfile.BadZipFile):
                 log.warning("resume: skipping unreadable artifact %s", name)
+                reasons.append(f"{name}: unreadable/corrupt artifact")
                 continue
     if not loadable:
         return None

@@ -514,6 +514,31 @@ def _pm_iters_for(cfg: SynthConfig, ha: int, wa: int) -> int:
 # edit.  Tests may mock.patch any mode.
 _POLISH_MODE = os.environ.get("IA_POLISH_MODE", "sequential")
 
+_POLISH_MODES = ("sequential", "jump", "stream")
+
+
+def set_polish_mode(mode: str) -> None:
+    """Install a polish engine process-wide (round 12: the
+    supervisor's stream->sequential degradation rung; also usable by
+    the hardware A/B): validates, assigns the module global, and
+    clears the driver's cached level/EM compilations — the
+    `set_cand_compression` discipline, because every cached level
+    function resolved the mode at trace time and a flip must never
+    reuse a stale graph.  The stream and sequential engines are
+    bit-identical (tests/test_polish_stream.py), so this rung of the
+    degradation ladder is bit-safe by construction."""
+    global _POLISH_MODE
+    if mode not in _POLISH_MODES:
+        raise ValueError(
+            f"polish mode {mode!r} names none of {_POLISH_MODES}"
+        )
+    if mode == _POLISH_MODE:
+        return
+    _POLISH_MODE = mode
+    from ..kernels.patchmatch_tile import clear_compiled_level_caches
+
+    clear_compiled_level_caches()
+
 # Scale-aware polish budget (round 8, the other half of VERDICT r5
 # task 4): the polish's shrinking-radius random probes re-search
 # globally at 12-gather prices, duplicating work the kernel's bulk

@@ -322,6 +322,76 @@ def _mesh_token(mesh) -> tuple:
     return token
 
 
+def ingest_frame_dir(path: str, *, strict: bool = False):
+    """Load a directory of frames with PER-FRAME fault isolation
+    (round 12): one unreadable or undecodable frame is skipped with a
+    recorded status instead of aborting the whole batch.
+
+    Returns (frames, names, failures): `frames` a (F, H, W[, 3]) f32
+    stack of the frames that loaded, `names` their filenames in sorted
+    order, `failures` a list of {"path", "reason"} records for the
+    skipped ones (the CLI prints them in the batch epilogue and books
+    `ia_frames_failed_total{reason}`).  `strict=True` (the CLI's
+    --strict-frames) restores abort-on-first-error.  Zero loadable
+    frames raise regardless — there is no batch to run."""
+    import numpy as np
+
+    from ..utils.io import load_image
+
+    names = sorted(
+        f for f in os.listdir(path)
+        if f.lower().endswith((".png", ".jpg", ".jpeg"))
+    )
+    decoded, failures = [], []
+    for name in names:
+        fpath = os.path.join(path, name)
+        try:
+            img = load_image(fpath)
+        except Exception as e:  # noqa: BLE001 - isolate, record, go on
+            if strict:
+                raise RuntimeError(
+                    f"batch ingest: frame {fpath!r} failed "
+                    f"({e}) and --strict-frames is set"
+                ) from e
+            failures.append({
+                "path": fpath,
+                "reason": f"{type(e).__name__}: {e}",
+            })
+            continue
+        decoded.append((name, fpath, img))
+    if not decoded:
+        raise RuntimeError(
+            f"batch ingest: no loadable frames in {path!r} "
+            f"({len(failures)} failed, {len(names)} candidates)"
+        )
+    # Shape reference: the MAJORITY shape of the decoded frames (ties
+    # -> first seen), not the lexically-first frame — a stray odd-sized
+    # thumbnail sorting first must be the skipped outlier, not the
+    # reference that silently discards the whole real batch with
+    # exit 0.
+    counts: dict = {}
+    for _name, _fpath, img in decoded:
+        counts[img.shape] = counts.get(img.shape, 0) + 1
+    ref_shape = max(counts, key=lambda s: counts[s])
+    loaded, ok_names = [], []
+    for name, fpath, img in decoded:
+        if img.shape != ref_shape:
+            reason = (
+                f"ValueError: frame shape {img.shape} != the batch's "
+                f"majority shape {ref_shape}"
+            )
+            if strict:
+                raise RuntimeError(
+                    f"batch ingest: frame {fpath!r} failed ({reason}) "
+                    "and --strict-frames is set"
+                )
+            failures.append({"path": fpath, "reason": reason})
+            continue
+        loaded.append(img)
+        ok_names.append(name)
+    return np.stack(loaded), ok_names, failures
+
+
 def synthesize_batch(
     a,
     ap,
@@ -331,6 +401,7 @@ def synthesize_batch(
     progress=None,
     frames_per_step: Optional[int] = None,
     resume_from: Optional[str] = None,
+    resume_strict: bool = False,
     _b_stats=None,
     _frame_offset: int = 0,
     _n_stack: Optional[int] = None,
@@ -355,11 +426,14 @@ def synthesize_batch(
     `cfg.save_level_artifacts` (SURVEY.md §5 checkpoint/resume) —
     restarts from the finest completed level's whole-batch (nnf, B')
     state, exactly the single-image scheme.  The fingerprint covers the
-    *padded* frame-stack shape plus the whole-stack identity (total
-    frame count, chunk offset), so checkpoints resume only onto the same
-    mesh / frames_per_step padding grain AND the same overall stack —
-    appending frames changes the whole-stack remap statistics, so a
-    per-chunk checkpoint from the shorter stack must not be reused.
+    *unpadded* frame-stack shape plus the whole-stack identity (total
+    frame count, chunk offset), so checkpoints bind to the same frames
+    AND the same overall stack — appending frames changes the
+    whole-stack remap statistics, so a per-chunk checkpoint from the
+    shorter stack must not be reused — but NOT to the mesh's padding
+    grain: saves trim the padding duplicates and resumes re-pad for
+    their own device count (round 12; the supervisor's mesh->single
+    degradation rung resumes mesh-written checkpoints this way).
     Chunked runs write (and resume) per-chunk subdirectories.
 
     `_b_stats` / `_frame_offset` / `_n_stack` are the internal
@@ -437,6 +511,7 @@ def synthesize_batch(
                     synthesize_batch(
                         a, ap, chunk, chunk_cfg, mesh, progress,
                         resume_from=chunk_resume,
+                        resume_strict=resume_strict,
                         _b_stats=_b_stats, _frame_offset=i, _n_stack=n,
                     )
                 )[:n_chunk]
@@ -446,6 +521,10 @@ def synthesize_batch(
     n_frames = frames.shape[0]
     n_pad = (-n_frames) % mesh.devices.size
 
+    from ..runtime.faults import fire as _fault_fire
+
+    # xfer injection point: the frame stack's host->device transfer.
+    _fault_fire("xfer", 0)
     a = jnp.asarray(a, jnp.float32)
     ap = jnp.asarray(ap, jnp.float32)
     frames = jnp.asarray(frames, jnp.float32)
@@ -464,16 +543,41 @@ def synthesize_batch(
     # bit-identically to the old host-side frame_keys helper).
     frame_idx = jnp.arange(frames.shape[0]) + _frame_offset
 
-    # Checkpoint identity: the padded chunk shape plus the whole-stack
-    # length and this chunk's offset — per-chunk state depends on the
-    # whole stack through the shared remap statistics, so a checkpoint
-    # from a different overall stack must not be resumed.
-    fp_shape = tuple(frames.shape) + (n_stack, _frame_offset)
+    # Checkpoint identity: the UNPADDED chunk shape plus the
+    # whole-stack length and this chunk's offset — per-chunk state
+    # depends on the whole stack through the shared remap statistics,
+    # so a checkpoint from a different overall stack must not be
+    # resumed.  The mesh's padding grain is deliberately NOT part of
+    # the identity (round 12): checkpoints save the real frames only
+    # and resumes re-pad below, so a run can resume onto a different
+    # device count — the supervisor's mesh->single-device degradation
+    # rung depends on exactly that.
+    fp_shape = (
+        (n_frames,) + tuple(frames.shape[1:]) + (n_stack, _frame_offset)
+    )
 
     start_level = levels - 1
-    resumed = resume_prologue(resume_from, levels, cfg, fp_shape, tracer)
+    resumed = resume_prologue(
+        resume_from, levels, cfg, fp_shape, tracer, strict=resume_strict
+    )
     if resumed is not None:
         start_level, nnf, bp, _aux = resumed
+        if n_pad:
+            # Re-pad the resumed whole-batch state to THIS mesh's
+            # grain.  Padded frames are synthesis ballast trimmed from
+            # every output and the vmapped step is per-frame
+            # independent, so seeding them with the last real frame's
+            # state changes no real frame's result.
+            def _pad_tail(x):
+                return jnp.concatenate(
+                    [x, jnp.repeat(x[-1:], n_pad, axis=0)], axis=0
+                )
+
+            nnf = (
+                tuple(_pad_tail(p) for p in nnf)
+                if isinstance(nnf, tuple) else _pad_tail(nnf)
+            )
+            bp = _pad_tail(bp)
         if start_level < 0:
             # Fully-checkpointed run: skip feature/pyramid construction
             # entirely — only the chroma planes are needed to finalize.
@@ -499,6 +603,8 @@ def synthesize_batch(
     )
 
     for level in range(start_level, -1, -1):
+        # level injection point + supervisor abort checkpoint.
+        _fault_fire("level", level)
         level_t0 = time.perf_counter()
         h, w = pyr_src_b[level].shape[1:3]
         has_coarse = level < levels - 1
@@ -532,6 +638,8 @@ def synthesize_batch(
             cfg, level, has_coarse, token, plan.fa_external, plan.lean,
             plan.prev_kind, plan.fuse,
         )
+        # kernel injection point: the compiled batch level launch.
+        _fault_fire("kernel", level)
         nnf, dist, bp = run(
             pyr_src_a[level],
             pyr_flt_a[level],
@@ -572,9 +680,13 @@ def synthesize_batch(
                 shard_walls=walls, shard_axis=BATCH_AXIS,
             )
         if cfg.save_level_artifacts:
-            # Whole-batch per-level state through the single-image writer:
-            # atomic tmp+rename and a fingerprint covering the padded
-            # frame-stack shape (the arrays just carry a frame axis).
+            # Whole-batch per-level state through the single-image
+            # writer: atomic tmp+rename and a fingerprint covering the
+            # UNPADDED frame-stack shape (the arrays just carry a
+            # frame axis).  Mesh-padding duplicates are trimmed before
+            # saving — they are recomputable ballast, and keeping them
+            # out of the artifact is what makes the checkpoint
+            # mesh-invariant (resume re-pads for its own grain).
             nnf_save = nnf
             if isinstance(nnf, tuple):
                 # Lean plane pair stacked on the HOST, exactly as the
@@ -587,8 +699,8 @@ def synthesize_batch(
                     [_np.asarray(nnf[0]), _np.asarray(nnf[1])], axis=-1
                 )
             _save_level(
-                cfg.save_level_artifacts, level, nnf_save, dist, bp, cfg,
-                fp_shape,
+                cfg.save_level_artifacts, level, nnf_save[:n_frames],
+                dist[:n_frames], bp[:n_frames], cfg, fp_shape,
             )
 
     return _finalize_batch(bp, yiq_b, frames, cfg)[:n_frames]

@@ -328,6 +328,7 @@ def synthesize_sharded_a(
     mesh=None,
     progress=None,
     resume_from: Optional[str] = None,
+    resume_strict: bool = False,
 ):
     """B' for one (b) against a style pair whose A-side lean tables are
     BAND-SHARDED across the mesh — per-device A residency is 1/n of the
@@ -384,6 +385,11 @@ def synthesize_sharded_a(
         raise ValueError(f"A {a.shape} and A' {ap.shape} must match")
 
     levels = cfg.clamp_levels(a.shape[:2], b.shape[:2])
+    # xfer injection point: the prologue dispatch is the run's
+    # host->device transfer boundary (runtime/faults.py).
+    from ..runtime.faults import fire as _fault_fire
+
+    _fault_fire("xfer", 0)
     prologue_t0 = time.perf_counter()
     (
         pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, pyr_raw_b, yiq_b
@@ -406,7 +412,9 @@ def synthesize_sharded_a(
     nnf = None  # stacked array (replicated levels) or (py, px) planes
     n_sharded_levels = 0
     start_level = levels - 1
-    resumed = resume_prologue(resume_from, levels, cfg, b.shape, tracer)
+    resumed = resume_prologue(
+        resume_from, levels, cfg, b.shape, tracer, strict=resume_strict
+    )
     if resumed is not None:
         start_level, nnf, bp, _aux = resumed
         if start_level < 0:
@@ -416,6 +424,9 @@ def synthesize_sharded_a(
         # suppress the warning on resumed runs (the prior run warned).
         n_sharded_levels = levels - 1 - start_level
     for level in range(start_level, -1, -1):
+        # level injection point + supervisor abort checkpoint
+        # (runtime/faults.py).
+        _fault_fire("level", level)
         level_t0 = time.perf_counter()
         shard_walls = None  # set on lean (band-sharded) levels only
         h, w = pyr_src_b[level].shape[:2]
@@ -432,6 +443,9 @@ def synthesize_sharded_a(
             h, w, prev_nnf=nnf, brute_lean=False,
         )
         lean = plan.lean
+        # kernel injection point: the level's compiled work (band
+        # assembly + sharded/stock level dispatch) starts past here.
+        _fault_fire("kernel", level)
         if lean:
             if ha % n_dev:
                 raise ValueError(

@@ -232,6 +232,7 @@ def synthesize_spatial(
     mesh=None,
     progress=None,
     resume_from: Optional[str] = None,
+    resume_strict: bool = False,
 ):
     """B' for one (large) `b`, rows sharded over the mesh's batch axis.
 
@@ -299,6 +300,11 @@ def synthesize_spatial(
     # bit-identical leaves to create_image_analogy's (the parity tests
     # compare the two runners exactly; separate compilations of the
     # reduction-bearing prologue ops could legally round differently).
+    # xfer injection point: the prologue dispatch is the run's
+    # host->device transfer boundary (runtime/faults.py).
+    from ..runtime.faults import fire as _fault_fire
+
+    _fault_fire("xfer", 0)
     prologue_t0 = time.perf_counter()
     (
         pyr_src_a, pyr_flt_a, pyr_src_b, pyr_copy_a, pyr_raw_b, yiq_b
@@ -320,7 +326,9 @@ def synthesize_spatial(
     bp = flt_bp = nnf = None  # global (H_l, W[, C]) state per level
 
     start_level = levels - 1
-    resumed = resume_prologue(resume_from, levels, cfg, b.shape, tracer)
+    resumed = resume_prologue(
+        resume_from, levels, cfg, b.shape, tracer, strict=resume_strict
+    )
     if resumed is not None:
         start_level, nnf, bp, _aux = resumed
         flt_bp = bp
@@ -328,6 +336,9 @@ def synthesize_spatial(
             return _finalize(bp, yiq_b, b, cfg)[:h0]
 
     for level in range(start_level, -1, -1):
+        # level injection point + supervisor abort checkpoint
+        # (runtime/faults.py).
+        _fault_fire("level", level)
         level_t0 = time.perf_counter()
         f_a_src = pyr_src_a[level]
         h, w = pyr_src_b[level].shape[:2]
@@ -362,6 +373,9 @@ def synthesize_spatial(
             prev_nnf=nnf, eligible_shape=slab_shape, brute_lean=False,
         )
         lean = plan.lean
+        # kernel injection point: the level's compiled work (assembly
+        # + slab/band dispatch) starts past this line.
+        _fault_fire("kernel", level)
 
         banded = lean and n_bands > 1
         if banded and not hasattr(jax, "shard_map"):

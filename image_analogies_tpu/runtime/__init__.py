@@ -1,0 +1,30 @@
+"""Supervised execution layer (round 12).
+
+`runtime/faults.py` — deterministic fault injection: named injection
+points the engine's eager glue calls at level start, kernel launch,
+checkpoint write, and device transfer, armed by `IA_FAULT_PLAN` so
+tests and the chaos suite (tools/chaos_suite.py) can prove each fault
+class either heals or produces a clean post-mortem.
+
+`runtime/supervisor.py` — the supervisor itself: per-level watchdog
+deadlines from the round-10 cost model, retry-with-resume from the
+bit-exact per-level checkpoints, a config-ordered graceful-degradation
+ladder over the engine's existing default-off seams, and a validated
+flight dump when it finally gives up.  Wired as `synth|batch
+--supervise` (cli.py).
+"""
+
+from .faults import (  # noqa: F401
+    FaultPlan,
+    InjectedFault,
+    LevelAborted,
+    fire,
+    resolve_fault_plan,
+    set_fault_plan,
+)
+from .supervisor import (  # noqa: F401
+    Rung,
+    SupervisorGaveUp,
+    default_ladder,
+    supervise,
+)

@@ -1,0 +1,547 @@
+"""Supervised synthesis — runs that SURVIVE, not just runs that are
+observable (round 12 tentpole, with runtime/faults.py).
+
+Before this round a hung level, a failed kernel launch, or a mid-level
+crash left only a flight dump and a manual restart.  The engine already
+had every ingredient a supervisor needs: bit-exact per-level
+checkpoint/resume (models/analogy.py — a resumed run is bit-identical
+to an uninterrupted one because per-level PRNG keys derive from the
+level index), a per-level cost model with calibrated seconds-per-unit
+(round 10's `run_plan` mark, the live /progress ETA), and a fleet of
+default-off fallback seams each pinned bit-safe or quality-bounded.
+This module composes them into four cooperating pieces:
+
+1. WATCHDOG — each pyramid level gets a deadline
+       max(min_deadline_s,
+           eta_cost_units[level] x seconds_per_unit x slack)
+   where seconds_per_unit is calibrated from the walls of the levels
+   this run has already completed (exactly the /progress ETA's rate);
+   before any level completes, the conservative static bound
+   `static_deadline_s` applies instead (and also bounds a run that
+   hangs before its first level opens).  The watchdog is a tracer
+   OBSERVER (the flight recorder's hook): it learns the plan from the
+   `run_plan` mark and level walls from level span open/close, so
+   supervision adds ZERO graph changes and no extra device syncs.  A
+   breach flushes the flight recorder with a `watchdog` reason, books
+   `ia_watchdog_breaches_total{level}`, and aborts the attempt.
+
+2. RETRY-WITH-RESUME — supervised mode forces `save_level_artifacts`
+   on, so on any attempt failure (exception, watchdog breach, injected
+   fault) the supervisor retries with exponential backoff, resuming
+   from the last intact checkpoint: the retried run replays only the
+   failed level, and — when the ladder never steps — stays
+   bit-identical to an undisturbed run (the resume path's existing
+   path-independence guarantee).  Every failure books
+   `ia_retries_total{stage, reason}`.
+
+3. DEGRADATION LADDER — after `max_retries` failures at one mode the
+   supervisor steps down a pre-declared, config-ordered ladder of the
+   engine's EXISTING seams (`default_ladder`: stream->sequential
+   polish, int8->bf16 candidate tables, pruned->full candidates,
+   packed->unpacked A-plane layout; the CLI appends mesh->
+   single-device for parallel runners), applying each through its
+   single-point setter (which clears the compiled level/EM caches so
+   a flipped mode can never reuse a stale graph), records a
+   `degradation` mark + `ia_degradations_total{from, to}`, resets the
+   retry budget, and tries again.  Rung order is safest-first: the
+   first four rungs are bit-identical or strictly-quality-improving
+   fallbacks (stream==sequential and packed==unpacked are test-pinned
+   bit-identical; bf16 tables and full candidate sets are the exact
+   historical path the compressed modes approximate), so a healed-
+   but-degraded run is never WORSE than the uncompressed baseline —
+   only slower.
+
+4. GIVE-UP — with the ladder exhausted and the retry budget spent, the
+   supervisor flushes a final validated flight dump and raises
+   `SupervisorGaveUp`; the CLI turns that into exit != 0.  A
+   supervised run therefore ends in exactly one of: healed (output
+   bit-identical when the ladder never stepped), degraded (recorded,
+   never silent — the sentinel's `recovery` check refuses to grade a
+   degraded run clean), or a clean post-mortem death.
+
+Attempts run on daemon WORKER THREADS: a hung attempt cannot be killed
+in-process, so a breached attempt is ABANDONED — its thread-local
+abort token (runtime/faults.set_abort_token) makes the injected-hang
+sleep and the next level boundary raise `LevelAborted`, unwinding the
+worker promptly; the supervisor waits up to `abort_grace_s` for that
+unwind before retrying (a truly wedged device call may outlive the
+grace window — the retry still proceeds; checkpoint writes are atomic
+and bit-identical across attempts, so a late write from a zombie
+attempt is content-equal to the retry's own).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from . import faults
+
+# Conservative pre-calibration per-level bound: long enough that no
+# legitimate compile+execute of one level at the published scales trips
+# it, short enough that an operator's "it's been stuck for a quarter
+# hour" intuition is automated.  Post-calibration deadlines come from
+# the cost model instead; min_deadline_s floors them so a 64^2 coarse
+# level's microsecond-scale units can't produce a hair-trigger.
+STATIC_DEADLINE_S = 900.0
+MIN_DEADLINE_S = 10.0
+WATCHDOG_SLACK = 4.0
+
+
+class SupervisorGaveUp(RuntimeError):
+    """Retries and ladder exhausted; the flight dump is the
+    post-mortem.  Carries the last attempt's error as __cause__."""
+
+
+class AbortToken:
+    """Per-attempt abort flag shared between the watchdog (setter),
+    the supervisor loop (reader), and the attempt's injection points
+    (runtime/faults.fire raises LevelAborted when set)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.reason: Optional[str] = None
+
+    def set(self, reason: str) -> None:
+        self.reason = reason
+        self._event.set()
+
+    def is_set(self) -> bool:
+        return self._event.is_set()
+
+
+@dataclass(frozen=True)
+class Rung:
+    """One degradation-ladder step over an existing seam.
+
+    `applies()` answers "is the process currently in the mode this
+    rung steps DOWN from?"; `apply()` installs the degraded mode
+    through the seam's single-point setter (which owns the compiled-
+    cache invalidation).  `bit_safe` documents whether the step
+    preserves bit-identity to the pre-step mode (ARCHITECTURE.md
+    carries the per-rung rationale)."""
+
+    name: str
+    from_label: str
+    to_label: str
+    applies: Callable[[], bool]
+    apply: Callable[[], None]
+    bit_safe: bool = True
+
+
+def default_ladder() -> List[Rung]:
+    """The config-ordered ladder over the engine's process-wide seams,
+    safest/cheapest first.  Each rung only engages when the process is
+    actually in its from-mode (a default-mode run has at most the
+    packed->unpacked rung available)."""
+    from ..kernels import patchmatch_tile as _pt
+    from ..models import patchmatch as _pm
+
+    return [
+        Rung(
+            "polish_stream_to_sequential", "stream", "sequential",
+            applies=lambda: _pm._POLISH_MODE == "stream",
+            apply=lambda: _pm.set_polish_mode("sequential"),
+            bit_safe=True,  # pinned bit-identical (round 8)
+        ),
+        Rung(
+            "cand_int8_to_bf16", "int8", "bf16",
+            applies=lambda: _pt.resolve_cand_dtype() == "int8",
+            apply=lambda: _pt.set_cand_compression(cand_dtype="bf16"),
+            bit_safe=False,  # bf16 IS the exact historical path —
+            # quality-improving, but not bit-equal to the int8 arm
+        ),
+        Rung(
+            "cand_pruned_to_full", "pruned", "full",
+            applies=lambda: _pt.resolve_prune() is not None,
+            apply=lambda: _pt.set_cand_compression(prune="off"),
+            bit_safe=False,  # full candidate set >= pruned set
+        ),
+        Rung(
+            "a_plane_packed_to_unpacked", "packed", "unpacked",
+            applies=lambda: _pt.resolve_packed(),
+            apply=lambda: _pt.set_packed_layout("unpacked"),
+            bit_safe=True,  # pinned bit-identical (round 7)
+        ),
+    ]
+
+
+class _Watchdog:
+    """Tracer-observer deadline monitor for one supervise() call.
+
+    State is reset per attempt (`arm`); the observer ignores events
+    from threads other than the current attempt's worker, so a zombie
+    abandoned attempt can neither calibrate nor false-trigger the
+    fresh one."""
+
+    def __init__(self, tracer, registry, slack: float,
+                 static_deadline_s: float, min_deadline_s: float):
+        self.tracer = tracer
+        self.registry = registry
+        self.slack = float(slack)
+        self.static_deadline_s = float(static_deadline_s)
+        self.min_deadline_s = float(min_deadline_s)
+        self._lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        self._token: Optional[AbortToken] = None
+        self._reset_state()
+
+    def _reset_state(self) -> None:
+        self.units: Dict[int, float] = {}
+        self.done_wall_s = 0.0
+        self.done_units = 0.0
+        self.open_level: Optional[int] = None
+        self.open_t: Optional[float] = None
+        self.last_level: Optional[int] = None
+        self.attempt_t0 = time.perf_counter()
+        # Last forward progress: any level close restarts this clock,
+        # so the BETWEEN-levels window (where the engine's eager glue,
+        # checkpoint writes, and the parallel runners' whole level
+        # bodies live — their level spans are recorded close-only,
+        # after the fact) is monitored too, against the NEXT level's
+        # deadline.
+        self.last_progress_t = self.attempt_t0
+
+    # -- observer (runs on the worker thread) -------------------------
+    def observe(self, kind: str, sp) -> None:
+        if self._worker is not threading.current_thread():
+            return
+        with self._lock:
+            if kind == "mark" and sp.name == "run_plan":
+                raw = (sp.attrs or {}).get("eta_cost_units") or {}
+                try:
+                    self.units = {int(k): float(v) for k, v in raw.items()}
+                except (TypeError, ValueError):
+                    self.units = {}
+            elif sp.name == "level":
+                lvl = (sp.attrs or {}).get("level")
+                if kind == "open":
+                    self.open_level = lvl
+                    self.open_t = time.perf_counter()
+                    self.last_level = lvl
+                elif kind == "close":
+                    if sp.wall_ms is not None and lvl is not None:
+                        u = self.units.get(int(lvl))
+                        if u:
+                            self.done_wall_s += sp.wall_ms / 1000.0
+                            self.done_units += u
+                    if lvl is not None:
+                        self.last_level = lvl
+                    self.open_level = None
+                    self.open_t = None
+                    self.last_progress_t = time.perf_counter()
+
+    # -- per-attempt lifecycle ---------------------------------------
+    def arm(self, worker: threading.Thread, token: AbortToken) -> None:
+        with self._lock:
+            self._worker = worker
+            self._token = token
+            self._reset_state()
+
+    def level_deadline_s(self, level: Optional[int]) -> float:
+        """The breach bound for the currently-open level (or for the
+        pre-first-level window when `level` is None)."""
+        if level is None:
+            return self.static_deadline_s
+        if self.done_units > 0 and self.done_wall_s > 0:
+            rate = self.done_wall_s / self.done_units
+            u = self.units.get(int(level))
+            if u:
+                return max(self.min_deadline_s, u * rate * self.slack)
+        return self.static_deadline_s
+
+    def check(self) -> bool:
+        """Poll once; returns True (and aborts the attempt) on a
+        breach."""
+        with self._lock:
+            token = self._token
+            if token is None or token.is_set():
+                return False
+            if self.open_t is not None:
+                level, elapsed = (
+                    self.open_level,
+                    time.perf_counter() - self.open_t,
+                )
+            else:
+                # No open span: the pre-first-level window (prologue /
+                # transfer), the between-levels glue, or a parallel
+                # runner's level body (their spans record close-only).
+                # The clock is time-since-last-progress; the bound is
+                # the NEXT level's deadline once one is known.
+                level = (
+                    self.last_level - 1
+                    if self.last_level is not None and self.last_level > 0
+                    else None
+                )
+                elapsed = time.perf_counter() - self.last_progress_t
+            deadline = self.level_deadline_s(level)
+        if elapsed <= deadline:
+            return False
+        self.registry.counter(
+            "ia_watchdog_breaches_total",
+            "supervised level deadlines breached (cost-model deadline "
+            "x slack, or the static pre-calibration bound)",
+        ).inc(labels={"level": str(level if level is not None else "prologue")})
+        recorder = getattr(self.tracer, "flight_recorder", None)
+        if recorder is not None:
+            recorder.flush("watchdog")
+        import logging
+
+        logging.getLogger("image_analogies_tpu").warning(
+            "watchdog: level %s exceeded its %.1f s deadline "
+            "(%.1f s elapsed) — aborting the attempt",
+            level if level is not None else "prologue", deadline, elapsed,
+        )
+        token.set("watchdog")
+        return True
+
+
+def _has_checkpoint(ckpt_dir: str) -> bool:
+    """Whether the supervisor's checkpoint dir holds ANY per-level
+    artifact yet (chunked batch runs write level files into frames_*
+    subdirectories, so the walk covers those too).  Until it does, a
+    retry must fall back to the caller's original resume source — a
+    failure at the coarsest level would otherwise resume from an empty
+    directory, discarding a user-supplied --resume-from's progress
+    (and, under --strict-resume, deterministically erroring every
+    retry into a spurious give-up)."""
+    import re
+
+    try:
+        for _root, _dirs, files in os.walk(ckpt_dir):
+            if any(re.fullmatch(r"level_\d+\.npz", f) for f in files):
+                return True
+    except OSError:
+        pass
+    return False
+
+
+def _drain_span_stack(tracer) -> None:
+    """Pop every open span off the shared tracer's stack after an
+    abandoned attempt outlived its abort grace: the zombie thread can
+    create no further spans (its next fault checkpoint raises
+    LevelAborted before any span opens), but its still-open run/level
+    spans would otherwise become the PARENT of the fresh attempt's
+    spans, mis-rooting the tree and the /progress stack.  List ops are
+    GIL-atomic (the stack_snapshot pattern), and Tracer._close pops
+    only when its own span is top-of-stack, so the zombie's eventual
+    unwinding closes its (already-recorded) spans without touching the
+    fresh attempt's.  A zombie that NEVER unwinds leaves its spans
+    open and the sentinel's span_tree check flags the run — an honest
+    signal that a wedged thread is still holding a device call."""
+    while getattr(tracer, "_stack", None):
+        try:
+            tracer._stack.pop()
+        except IndexError:
+            break
+
+
+def _failure_reason(token: AbortToken, error: Optional[BaseException]
+                    ) -> str:
+    if token.is_set() and token.reason == "watchdog":
+        return "watchdog"
+    if isinstance(error, faults.InjectedTransferError):
+        return "transfer"
+    if isinstance(error, faults.InjectedFault):
+        return "injected"
+    return "exception"
+
+
+def supervise(
+    attempt_fn: Callable[[Optional[str]], Any],
+    *,
+    ckpt_dir: str,
+    tracer=None,
+    registry=None,
+    initial_resume: Optional[str] = None,
+    max_retries: int = 2,
+    watchdog_slack: float = WATCHDOG_SLACK,
+    static_deadline_s: float = STATIC_DEADLINE_S,
+    min_deadline_s: float = MIN_DEADLINE_S,
+    backoff_s: float = 0.5,
+    max_backoff_s: float = 30.0,
+    ladder: Optional[List[Rung]] = None,
+    abort_grace_s: float = 10.0,
+    poll_s: float = 0.05,
+):
+    """Run `attempt_fn` under supervision and return its result.
+
+    `attempt_fn(resume_from)` is one synthesis attempt — a closure the
+    CLI builds around the chosen runner, whose cfg has
+    `save_level_artifacts=ckpt_dir` forced on.  The first attempt gets
+    `initial_resume` (the user's --resume-from, usually None); every
+    retry resumes from `ckpt_dir`, the checkpoints the failed attempts
+    left behind.
+
+    `ladder=None` installs `default_ladder()`; pass [] for no ladder
+    (clean-death after the retry budget).  `max_retries` is the retry
+    budget PER LADDER RUNG — stepping down a rung resets it.
+    """
+    from ..telemetry.metrics import get_registry
+
+    if registry is None:
+        registry = (
+            tracer.registry
+            if tracer is not None and getattr(tracer, "registry", None)
+            is not None
+            else get_registry()
+        )
+    rungs = list(default_ladder() if ladder is None else ladder)
+    watch = _Watchdog(
+        tracer, registry, watchdog_slack, static_deadline_s,
+        min_deadline_s,
+    )
+    observing = (
+        tracer is not None and getattr(tracer, "enabled", False)
+    )
+    if observing:
+        tracer.add_observer(watch.observe)
+    attempts_c = registry.counter(
+        "ia_supervisor_attempts_total",
+        "supervised synthesis attempts started (first try + retries)",
+    )
+    retries_c = registry.counter(
+        "ia_retries_total",
+        "supervised attempt failures, by failing stage (pyramid level "
+        "or 'prologue'/'run') and reason",
+    )
+    degr_c = registry.counter(
+        "ia_degradations_total",
+        "graceful-degradation ladder steps taken {from, to}",
+    )
+
+    failures_at_rung = 0
+    attempt_idx = 0
+    last_error: Optional[BaseException] = None
+    try:
+        while True:
+            token = AbortToken()
+            box: Dict[str, Any] = {}
+            # Retries resume from the supervisor's checkpoints once any
+            # exist; before that (a coarsest-level/prologue failure)
+            # the caller's original resume source still applies.
+            resume = (
+                ckpt_dir
+                if attempt_idx > 0 and _has_checkpoint(ckpt_dir)
+                else initial_resume
+            )
+
+            def _body(resume=resume, token=token, box=box):
+                faults.set_abort_token(token)
+                try:
+                    box["result"] = attempt_fn(resume)
+                except BaseException as e:  # noqa: BLE001 - reaped below
+                    box["error"] = e
+
+            worker = threading.Thread(
+                target=_body, name=f"ia-supervised-attempt-{attempt_idx}",
+                daemon=True,
+            )
+            watch.arm(worker, token)
+            attempts_c.inc()
+            attempt_idx += 1
+            worker.start()
+            while worker.is_alive() and not token.is_set():
+                worker.join(poll_s)
+                if worker.is_alive() and observing:
+                    # No observer -> no event source: a watchdog that
+                    # cannot see levels would clock a healthy long run
+                    # against the static bound and falsely breach it.
+                    # Without a tracer the supervisor still retries on
+                    # exceptions; only deadline enforcement is off.
+                    watch.check()
+            if token.is_set() and worker.is_alive():
+                # Breached: give the abandoned attempt a bounded window
+                # to unwind through its abort checkpoints.
+                worker.join(abort_grace_s)
+                if worker.is_alive():
+                    # Truly wedged (a hung device call the abort token
+                    # cannot interrupt): clear its open spans off the
+                    # shared stack so the retry's tree roots correctly
+                    # (_drain_span_stack docstring has the safety
+                    # argument).
+                    import logging
+
+                    logging.getLogger("image_analogies_tpu").warning(
+                        "supervisor: abandoned attempt still alive "
+                        "after %.0f s grace — proceeding; its open "
+                        "spans are detached from the live stack",
+                        abort_grace_s,
+                    )
+                    _drain_span_stack(tracer)
+            if "result" in box and not token.is_set():
+                return box["result"]
+
+            error = box.get("error")
+            if isinstance(error, (KeyboardInterrupt, SystemExit)):
+                raise error
+            from ..models.analogy import ResumeError
+
+            if isinstance(error, ResumeError):
+                # A strict-resume failure is a CONFIG error, not a
+                # transient fault: retrying would recompute from
+                # scratch and exit 0 — the exact outcome the flag
+                # exists to forbid.
+                raise error
+            last_error = error or SupervisorGaveUp(
+                f"attempt aborted: {token.reason}"
+            )
+            reason = _failure_reason(token, error)
+            stage = (
+                str(watch.last_level)
+                if watch.last_level is not None else "prologue"
+            )
+            retries_c.inc(labels={"stage": stage, "reason": reason})
+            failures_at_rung += 1
+            import logging
+
+            log = logging.getLogger("image_analogies_tpu")
+            if failures_at_rung > max_retries:
+                # Retry budget spent at this mode: step the ladder.
+                rung = next((r for r in rungs if r.applies()), None)
+                if rung is None:
+                    recorder = getattr(tracer, "flight_recorder", None)
+                    if recorder is not None:
+                        recorder.flush("violation")
+                    raise SupervisorGaveUp(
+                        f"supervised synthesis failed after "
+                        f"{attempt_idx} attempts (retries and "
+                        "degradation ladder exhausted) — see the "
+                        "flight dump"
+                    ) from last_error
+                rung.apply()
+                degr_c.inc(labels={
+                    "from": rung.from_label, "to": rung.to_label,
+                })
+                if tracer is not None and getattr(
+                    tracer, "enabled", False
+                ):
+                    tracer.annotate(
+                        "degradation", rung=rung.name,
+                        from_mode=rung.from_label, to_mode=rung.to_label,
+                        bit_safe=rung.bit_safe,
+                    )
+                log.warning(
+                    "supervisor: stepping degradation ladder %s "
+                    "(%s -> %s) after %d failures",
+                    rung.name, rung.from_label, rung.to_label,
+                    failures_at_rung,
+                )
+                failures_at_rung = 0
+            else:
+                log.warning(
+                    "supervisor: attempt %d failed at stage %s "
+                    "(%s: %s) — retrying from %s",
+                    attempt_idx, stage, reason, last_error, ckpt_dir,
+                )
+            if backoff_s > 0:
+                time.sleep(min(
+                    max_backoff_s,
+                    backoff_s * (2.0 ** max(0, failures_at_rung - 1)),
+                ))
+    finally:
+        if observing:
+            tracer.remove_observer(watch.observe)

@@ -64,7 +64,8 @@ FLIGHT_FILE = "flight.json"
 FLIGHT_SCHEMA_VERSION = 1
 
 FLUSH_REASONS = (
-    "sigterm", "sigint", "atexit", "violation", "session-end", "manual",
+    "sigterm", "sigint", "atexit", "violation", "watchdog",
+    "session-end", "manual",
 )
 
 class FlightRecorder:
@@ -167,7 +168,7 @@ class FlightRecorder:
         from ..utils.io import atomic_write_json
 
         self._n_flushes += 1
-        if reason in ("sigterm", "sigint", "violation"):
+        if reason in ("sigterm", "sigint", "violation", "watchdog"):
             self._sticky_reason = reason
         elif self._sticky_reason is not None and reason in (
             "session-end", "atexit"

@@ -56,6 +56,14 @@ Checks (each -> ok | degraded | violated | skipped):
                         degrades the verdict (load imbalance is a
                         performance fact, never a correctness
                         violation)
+  recovery              supervised runs only (round 12): the retry /
+                        degradation / watchdog-breach counters priced
+                        against the fault injections that fired
+                        (`ia_fault_injections_total`); any
+                        degradation-ladder step degrades the verdict
+                        — a healed-by-degrading run never grades
+                        clean — and unaccounted breaches/injections
+                        violate
   instrument_drift      bench records only: |loop - trace| sweep-time
                         divergence beyond INSTRUMENT_DRIFT_FRAC is
                         flagged (VERDICT r5 weak 6, now enforced —
@@ -114,6 +122,10 @@ OVERHEAD_BUDGET_FRAC = 0.02
 _OVERHEAD_GAUGES = (
     "ia_telemetry_overhead_frac",
     "ia_live_telemetry_overhead_frac",
+    # Round 12: the supervised-execution layer (watchdog observer +
+    # worker thread + forced checkpoints), measured by
+    # tests/test_supervisor.py's min-paired-delta pin.
+    "ia_supervisor_overhead_frac",
 )
 
 # Straggler watch (round 10): a level whose slowest shard finishes
@@ -523,6 +535,122 @@ def check_straggler_skew(metrics: Optional[dict]) -> Dict:
     )
 
 
+def check_recovery(metrics: Optional[dict]) -> Dict:
+    """Supervised-run recovery accounting (round 12): the retry /
+    degradation / watchdog counters priced against the fault
+    injections that fired (runtime/faults.py books
+    `ia_fault_injections_total{point, action}` per firing).
+
+    Invariants, enforced only when a supervisor actually ran
+    (`ia_supervisor_attempts_total` present — an unsupervised run with
+    an armed fault plan legitimately records injections and nothing
+    else):
+
+      - attempts == failures + 1 (a returned run) or == failures (a
+        run that died at give-up): anything else means the supervisor
+        lost an attempt's accounting — violated.
+      - every watchdog breach is an observed failure:
+        breaches <= retries{reason=watchdog} — violated otherwise.
+      - every fired always-raising injection (`raise`, `fail`) is an
+        observed failure: fired <= total retries — violated otherwise
+        (a fault that "healed" without a recorded retry is a fault
+        that was silently swallowed).  `hang` injections are excluded
+        (a hang shorter than the deadline legitimately heals without
+        failing), as is `truncate` (healed by the resume loader
+        skipping the artifact, not by a retry).
+      - ANY degradation degrades the verdict — a run that stepped the
+        ladder finished in a different mode than it started and must
+        never grade clean (the DMA/collective ledger checks above
+        still hold it exact for the modes actually executed: they are
+        priced per compression mode from trace-time counters, so a
+        mid-run mode flip prices each arm's traffic under its own
+        label)."""
+    attempts = sum(
+        _counter_values(metrics, "ia_supervisor_attempts_total").values()
+    )
+    retries = _counter_values(metrics, "ia_retries_total")
+    degr = _counter_values(metrics, "ia_degradations_total")
+    breaches = sum(
+        _counter_values(metrics, "ia_watchdog_breaches_total").values()
+    )
+    inj = _counter_values(metrics, "ia_fault_injections_total")
+    if not attempts and not retries and not degr and not breaches \
+            and not inj:
+        return _check(
+            "recovery", "skipped",
+            detail="no supervised run and no fault injections in this "
+            "session",
+        )
+    observed = {
+        "attempts": attempts,
+        "retries": {
+            ",".join(f"{k}={v}" for k, v in key): n
+            for key, n in retries.items()
+        },
+        "degradations": {
+            ",".join(f"{k}={v}" for k, v in key): n
+            for key, n in degr.items()
+        },
+        "watchdog_breaches": breaches,
+        "injections_fired": {
+            ",".join(f"{k}={v}" for k, v in key): n
+            for key, n in inj.items()
+        },
+    }
+    if not attempts:
+        # Fault plan armed without a supervisor: nothing to price —
+        # the injections are the experiment, not a recovery claim.
+        return _check(
+            "recovery", "skipped", detail="fault injections fired but "
+            "no supervised run in this session (nothing to price)",
+        )
+    n_retries = sum(retries.values())
+    n_watchdog_retries = sum(
+        n for key, n in retries.items()
+        if dict(key).get("reason") == "watchdog"
+    )
+    n_raising = sum(
+        n for key, n in inj.items()
+        if dict(key).get("action") in ("raise", "fail")
+    )
+    problems = []
+    if attempts - n_retries not in (0, 1):
+        problems.append(
+            f"attempts ({attempts}) - failures ({n_retries}) is "
+            "neither 0 (give-up) nor 1 (healed) — attempt accounting "
+            "lost"
+        )
+    if breaches > n_watchdog_retries:
+        problems.append(
+            f"watchdog breaches ({breaches}) exceed watchdog-reason "
+            f"failures ({n_watchdog_retries}) — a breach was never "
+            "handled"
+        )
+    if n_raising > n_retries:
+        problems.append(
+            f"always-raising injections fired ({n_raising}) exceed "
+            f"observed failures ({n_retries}) — a fault was silently "
+            "swallowed"
+        )
+    if problems:
+        status = "violated"
+    elif degr:
+        status = "degraded"  # never clean after a ladder step
+    else:
+        status = "ok"
+    return _check(
+        "recovery", status,
+        expected="attempts == failures (+1 if healed); breaches and "
+        "raise/fail injections all accounted as failures; zero ladder "
+        "steps for a clean verdict",
+        observed=observed,
+        detail="supervised recovery counters priced against the fault "
+        "plan" + ("" if not problems else " — " + "; ".join(problems))
+        + ("" if not degr or problems else " — run healed only by "
+           "degrading; output mode differs from the requested one"),
+    )
+
+
 def check_instrument_drift(record: Optional[dict]) -> Dict:
     """Bench records: the host-differenced loop figure diverging more
     than INSTRUMENT_DRIFT_FRAC from the trace-derived figure is
@@ -577,6 +705,7 @@ def evaluate_health(
         check_span_tree(spans),
         check_telemetry_overhead(metrics),
         check_straggler_skew(metrics),
+        check_recovery(metrics),
     ]
     if bench_record is not None:
         checks.append(check_instrument_drift(bench_record))

@@ -1,0 +1,614 @@
+"""Supervised-execution tests (round 12): deterministic fault
+injection (runtime/faults.py), the supervisor's watchdog / retry /
+degradation-ladder / give-up paths (runtime/supervisor.py), the
+sentinel's `recovery` check, and the supervised-path overhead +
+bit-identity pin (ISSUE 7 satellite: --supervise with no faults adds
+< 2% wall and zero graph changes).
+
+The e2e arms run the SAME (levels=3 -> clamped 2, em_iters=2,
+pm_iters=3) patchmatch config tests/test_resume.py uses, so one
+compile cache serves both files in a full tier-1 run; the expensive
+ladder arm (its rung clears the compiled caches, forcing a recompile)
+is slow-marked per the round-8 budget rule.
+"""
+
+import dataclasses
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from check_report import validate_flight  # noqa: E402
+
+from image_analogies_tpu import SynthConfig, create_image_analogy  # noqa: E402
+from image_analogies_tpu.runtime import faults, supervisor  # noqa: E402
+from image_analogies_tpu.runtime.faults import (  # noqa: E402
+    FaultPlan,
+    InjectedFault,
+    InjectedTransferError,
+    LevelAborted,
+)
+from image_analogies_tpu.runtime.supervisor import (  # noqa: E402
+    AbortToken,
+    SupervisorGaveUp,
+)
+from image_analogies_tpu.telemetry import (  # noqa: E402
+    MetricsRegistry,
+    Tracer,
+    evaluate_health,
+)
+from image_analogies_tpu.telemetry.flight import FlightRecorder  # noqa: E402
+from image_analogies_tpu.telemetry.metrics import set_registry  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_modes():
+    """Every test leaves the process seams exactly as it found them:
+    no armed plan, packed layout, sequential polish."""
+    from image_analogies_tpu.kernels.patchmatch_tile import (
+        set_packed_layout,
+    )
+    from image_analogies_tpu.models.patchmatch import set_polish_mode
+
+    yield
+    faults.set_fault_plan(None)
+    set_packed_layout("packed")
+    set_polish_mode("sequential")
+
+
+# ------------------------------------------------------- fault plan
+class TestFaultPlan:
+    def test_parse_grammar(self):
+        plan = FaultPlan.parse(
+            "level:2:raise, level:1:hang:30; ckpt:1:truncate,"
+            "xfer:0:fail,kernel:0:raise:3"
+        )
+        assert [(e.point, e.key, e.action) for e in plan.entries] == [
+            ("level", 2, "raise"), ("level", 1, "hang"),
+            ("ckpt", 1, "truncate"), ("xfer", 0, "fail"),
+            ("kernel", 0, "raise"),
+        ]
+        assert plan.entries[1].arg == 30.0
+        assert plan.entries[4].remaining == 3
+
+    def test_parse_empty_is_none(self):
+        assert FaultPlan.parse(None) is None
+        assert FaultPlan.parse("") is None
+        assert FaultPlan.parse("   ") is None
+
+    @pytest.mark.parametrize("bad", [
+        "level:2",                 # missing action
+        "nowhere:0:raise",         # unknown point
+        "level:0:explode",         # unknown action
+        "level:x:raise",           # non-integer key
+        "level:0:raise:zero",      # non-integer count
+        "level:0:raise:0",         # count < 1
+        "level:0:truncate",        # truncate off the ckpt point
+        "level:0:hang:soon",       # non-numeric seconds
+    ])
+    def test_parse_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+    def test_match_disarms(self):
+        plan = FaultPlan.parse("level:1:raise:2")
+        assert plan.match("level", 0) is None
+        assert plan.match("level", 1) is not None
+        assert plan.match("level", 1) is not None
+        assert plan.match("level", 1) is None  # count exhausted
+        assert plan.armed() == []
+
+
+class TestFire:
+    def test_unarmed_fast_path(self):
+        faults.set_fault_plan(None)
+        assert faults.fire("level", 0) is None
+
+    def test_raise_fires_once_and_counts(self):
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        try:
+            faults.set_fault_plan("level:1:raise")
+            with pytest.raises(InjectedFault):
+                faults.fire("level", 1)
+            assert faults.fire("level", 1) is None  # disarmed
+        finally:
+            set_registry(prev)
+        vals = reg.counter("ia_fault_injections_total", "")._values
+        assert vals == {
+            (("action", "raise"), ("point", "level")): 1.0
+        }
+
+    def test_fail_raises_transfer_error(self):
+        faults.set_fault_plan("xfer:0:fail")
+        with pytest.raises(InjectedTransferError):
+            faults.fire("xfer", 0)
+
+    def test_truncate_returned_to_caller(self):
+        faults.set_fault_plan("ckpt:1:truncate")
+        assert faults.fire("ckpt", 1) == "truncate"
+
+    def test_abort_token_raises_at_level_point(self):
+        token = AbortToken()
+        faults.set_abort_token(token)
+        try:
+            faults.set_fault_plan(None)
+            assert faults.fire("level", 0) is None
+            token.set("watchdog")
+            with pytest.raises(LevelAborted):
+                faults.fire("level", 0)
+            # Non-level points stay silent (only the level boundary is
+            # the abandonment point).
+            assert faults.fire("ckpt", 0) is None
+        finally:
+            faults.set_abort_token(None)
+
+    def test_hang_interrupted_by_abort(self):
+        token = AbortToken()
+        faults.set_abort_token(token)
+        try:
+            faults.set_fault_plan("level:0:hang:30")
+            token.set("watchdog")
+            t0 = time.perf_counter()
+            with pytest.raises(LevelAborted):
+                faults.fire("level", 0)
+            assert time.perf_counter() - t0 < 5.0
+        finally:
+            faults.set_abort_token(None)
+
+
+# ----------------------------------------------------- e2e supervised
+def _inputs(n=32):
+    rng = np.random.default_rng(0)
+    a = rng.random((n, n)).astype(np.float32)
+    ap = np.clip(a * 0.5 + 0.2, 0, 1).astype(np.float32)
+    b = rng.random((n, n)).astype(np.float32)
+    return a, ap, b
+
+
+# Same knobs as tests/test_resume.py -> shared compile cache in a full
+# tier-1 run (levels=3 clamps to 2 at 32^2).
+_E2E_CFG = dict(levels=3, matcher="patchmatch", em_iters=2, pm_iters=3)
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    a, ap, b = _inputs()
+    bp = np.asarray(create_image_analogy(a, ap, b, SynthConfig(**_E2E_CFG)))
+    return a, ap, b, bp
+
+
+def _supervised(oracle, plan, **kw):
+    """One supervised run against an armed plan; returns
+    (result|None, gave_up_error|None, registry, tracer, flight_path,
+    ckpt_dir)."""
+    a, ap, b, _ = oracle
+    ckpt = tempfile.mkdtemp(prefix="ia_sup_test_ckpt_")
+    flight_dir = tempfile.mkdtemp(prefix="ia_sup_test_flight_")
+    cfg = SynthConfig(**_E2E_CFG, save_level_artifacts=ckpt)
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    tracer = Tracer(registry=reg)
+    rec = FlightRecorder(
+        tracer, reg, os.path.join(flight_dir, "flight.json")
+    )
+    rec.install()
+    tracer.flight_recorder = rec
+    faults.set_fault_plan(plan)
+    out = err = None
+    try:
+        out = supervisor.supervise(
+            lambda resume: create_image_analogy(
+                a, ap, b, cfg, progress=tracer, resume_from=resume
+            ),
+            ckpt_dir=ckpt, tracer=tracer, backoff_s=0.0, **kw,
+        )
+    except SupervisorGaveUp as e:
+        err = e
+    finally:
+        faults.set_fault_plan(None)
+        rec.uninstall()
+        set_registry(prev)
+    return out, err, reg, tracer, os.path.join(flight_dir, "flight.json"), ckpt
+
+
+def _counter(reg, name):
+    return dict(reg.counter(name, "")._values)
+
+
+class TestSupervisedHeal:
+    def test_injected_raise_heals_bit_identical(self, oracle):
+        """ISSUE 7 acceptance: a raise fault under --supervise heals
+        with output bit-identical to the undisturbed run (the ladder
+        never steps), the retry is booked, the checkpoint replayed
+        only the failed level, and the sentinel recovery check grades
+        the healed run ok."""
+        out, err, reg, tracer, _, ckpt = _supervised(
+            oracle, "level:0:raise"
+        )
+        assert err is None
+        np.testing.assert_array_equal(np.asarray(out), oracle[3])
+        retries = _counter(reg, "ia_retries_total")
+        assert sum(retries.values()) == 1
+        ((labels, _),) = retries.items()
+        assert dict(labels)["reason"] == "injected"
+        # The coarsest level was checkpointed before the fault: the
+        # retry resumed rather than recomputing it.
+        assert "level_1.npz" in os.listdir(ckpt)
+        health = evaluate_health(
+            spans=tracer.to_dict(), metrics=reg.to_dict()
+        )
+        by_name = {c["name"]: c for c in health["checks"]}
+        assert by_name["recovery"]["status"] == "ok"
+        assert health["verdict"] == "ok"
+
+    def test_kernel_and_transfer_faults_heal(self, oracle):
+        out, err, reg, _, _, _ = _supervised(oracle, "kernel:0:raise")
+        assert err is None
+        np.testing.assert_array_equal(np.asarray(out), oracle[3])
+        out, err, reg, _, _, _ = _supervised(oracle, "xfer:0:fail")
+        assert err is None
+        np.testing.assert_array_equal(np.asarray(out), oracle[3])
+        retries = _counter(reg, "ia_retries_total")
+        ((labels, _),) = retries.items()
+        assert dict(labels)["reason"] == "transfer"
+
+    def test_truncated_checkpoint_healed_by_resume(self, oracle):
+        """ckpt:truncate corrupts the artifact AFTER the atomic rename
+        (the partial-write-survived case); the retry's resume loader
+        must skip it and still converge bit-identically."""
+        out, err, _, _, _, ckpt = _supervised(
+            oracle, "ckpt:1:truncate,level:0:raise"
+        )
+        assert err is None
+        np.testing.assert_array_equal(np.asarray(out), oracle[3])
+
+    def test_watchdog_breach_heals(self, oracle):
+        """A hung level breaches the (tiny, test-scaled) deadline: the
+        breach is booked, the flight recorder flushes with the
+        `watchdog` reason, the attempt is abandoned, and the retry
+        heals bit-identically."""
+        out, err, reg, _, flight_path, _ = _supervised(
+            oracle, "level:0:hang:60",
+            static_deadline_s=2.0, min_deadline_s=0.2,
+            watchdog_slack=2.0,
+        )
+        assert err is None
+        np.testing.assert_array_equal(np.asarray(out), oracle[3])
+        breaches = _counter(reg, "ia_watchdog_breaches_total")
+        assert sum(breaches.values()) >= 1
+        retries = _counter(reg, "ia_retries_total")
+        assert any(
+            dict(k)["reason"] == "watchdog" for k in retries
+        )
+        with open(flight_path) as f:
+            dump = json.load(f)
+        # The watchdog reason is sticky: the session-end re-flush must
+        # not relabel the breach.
+        assert dump["flushed_on"] == "watchdog"
+        assert validate_flight(dump) == []
+
+    def test_give_up_leaves_validated_dump(self, oracle):
+        """Retries + ladder exhausted -> SupervisorGaveUp with a
+        check_report-validated flight dump (the clean-death half of
+        the acceptance matrix; the CLI maps this to exit != 0)."""
+        out, err, reg, _, flight_path, _ = _supervised(
+            oracle, "level:1:raise:99", max_retries=0, ladder=[],
+        )
+        assert out is None and err is not None
+        with open(flight_path) as f:
+            dump = json.load(f)
+        assert dump["flushed_on"] == "violation"
+        assert validate_flight(dump) == []
+
+    @pytest.mark.slow  # the rung's cache clear forces a recompile
+    def test_ladder_degrades_then_heals(self, oracle):
+        """Persistent failures step the ladder: under default modes the
+        first applicable rung is packed->unpacked (bit-safe, round 7),
+        after which the run heals — still bit-identical — and the
+        degradation is recorded; the sentinel grades the run degraded,
+        never clean."""
+        from image_analogies_tpu.kernels.patchmatch_tile import (
+            resolve_packed,
+        )
+
+        out, err, reg, tracer, _, _ = _supervised(
+            oracle, "level:0:raise:3", max_retries=1,
+        )
+        assert err is None
+        np.testing.assert_array_equal(np.asarray(out), oracle[3])
+        assert not resolve_packed()  # the rung actually stepped
+        degr = _counter(reg, "ia_degradations_total")
+        assert degr == {(("from", "packed"), ("to", "unpacked")): 1.0}
+        # The degradation is on the span tree too.
+        marks = tracer.find("degradation")
+        assert len(marks) == 1
+        assert marks[0].attrs["rung"] == "a_plane_packed_to_unpacked"
+        health = evaluate_health(
+            spans=tracer.to_dict(), metrics=reg.to_dict()
+        )
+        by_name = {c["name"]: c for c in health["checks"]}
+        assert by_name["recovery"]["status"] == "degraded"
+        assert health["verdict"] == "degraded"
+
+
+class TestFrameIngest:
+    def _write_png(self, path, n):
+        from image_analogies_tpu.utils.io import save_image
+
+        save_image(path, np.random.default_rng(0).random((n, n)))
+
+    def test_bad_frame_skipped_and_recorded(self, tmp_path):
+        from image_analogies_tpu.parallel.batch import ingest_frame_dir
+
+        d = str(tmp_path)
+        self._write_png(os.path.join(d, "a.png"), 32)
+        self._write_png(os.path.join(d, "b.png"), 32)
+        with open(os.path.join(d, "broken.png"), "w") as f:
+            f.write("not an image")
+        frames, names, failures = ingest_frame_dir(d)
+        assert names == ["a.png", "b.png"]
+        assert frames.shape[0] == 2
+        assert len(failures) == 1
+        assert failures[0]["path"].endswith("broken.png")
+        with pytest.raises(RuntimeError, match="strict-frames"):
+            ingest_frame_dir(d, strict=True)
+
+    def test_majority_shape_wins_over_lexical_order(self, tmp_path):
+        """A stray odd-sized frame sorting FIRST must be the skipped
+        outlier — not the shape reference that silently discards the
+        whole real batch with exit 0."""
+        from image_analogies_tpu.parallel.batch import ingest_frame_dir
+
+        d = str(tmp_path)
+        self._write_png(os.path.join(d, "0000_thumb.png"), 16)
+        self._write_png(os.path.join(d, "a.png"), 32)
+        self._write_png(os.path.join(d, "b.png"), 32)
+        frames, names, failures = ingest_frame_dir(d)
+        assert names == ["a.png", "b.png"]
+        assert frames.shape[1:3] == (32, 32)  # load_image round-trips RGB
+        assert len(failures) == 1
+        assert "majority shape" in failures[0]["reason"]
+
+    def test_all_frames_bad_raises(self, tmp_path):
+        from image_analogies_tpu.parallel.batch import ingest_frame_dir
+
+        d = str(tmp_path)
+        with open(os.path.join(d, "x.png"), "w") as f:
+            f.write("nope")
+        with pytest.raises(RuntimeError, match="no loadable frames"):
+            ingest_frame_dir(d)
+
+
+class TestRetryResumeSource:
+    def test_retry_falls_back_to_initial_resume_until_ckpt_exists(
+        self, tmp_path
+    ):
+        """A failure BEFORE the first checkpoint lands (coarsest level
+        / prologue) must retry from the caller's original resume
+        source, not the still-empty ckpt_dir — resuming from the empty
+        dir would discard a user --resume-from's progress (and under
+        --strict-resume would error every retry into a spurious
+        give-up).  Once the supervisor's own checkpoints exist, they
+        take over."""
+        import numpy as _np
+
+        ckpt = str(tmp_path / "ck")
+        calls = []
+
+        def attempt(resume):
+            calls.append(resume)
+            if len(calls) == 1:
+                raise RuntimeError("fail before any checkpoint")
+            if len(calls) == 2:
+                os.makedirs(ckpt, exist_ok=True)
+                _np.savez(os.path.join(ckpt, "level_1.npz"), x=1)
+                raise RuntimeError("fail after checkpointing")
+            return "done"
+
+        out = supervisor.supervise(
+            attempt, ckpt_dir=ckpt, initial_resume="user_dir",
+            backoff_s=0.0, max_retries=5, ladder=[],
+        )
+        assert out == "done"
+        assert calls == ["user_dir", "user_dir", ckpt]
+
+    def test_chunked_batch_subdir_checkpoints_are_seen(self, tmp_path):
+        import numpy as _np
+
+        ckpt = str(tmp_path / "ck")
+        os.makedirs(os.path.join(ckpt, "frames_00000"))
+        _np.savez(
+            os.path.join(ckpt, "frames_00000", "level_0.npz"), x=1
+        )
+        assert supervisor._has_checkpoint(ckpt)
+        assert not supervisor._has_checkpoint(str(tmp_path / "nope"))
+
+
+# --------------------------------------------------- recovery check
+def _metrics_with(attempts=0, retries=(), degr=(), breaches=0, inj=()):
+    reg = MetricsRegistry()
+    if attempts:
+        reg.counter("ia_supervisor_attempts_total", "").inc(attempts)
+    for stage, reason, n in retries:
+        reg.counter("ia_retries_total", "").inc(
+            n, labels={"stage": stage, "reason": reason}
+        )
+    for frm, to, n in degr:
+        reg.counter("ia_degradations_total", "").inc(
+            n, labels={"from": frm, "to": to}
+        )
+    if breaches:
+        reg.counter("ia_watchdog_breaches_total", "").inc(
+            breaches, labels={"level": "0"}
+        )
+    for point, action, n in inj:
+        reg.counter("ia_fault_injections_total", "").inc(
+            n, labels={"point": point, "action": action}
+        )
+    return reg.to_dict()
+
+
+def _recovery(metrics):
+    health = evaluate_health(metrics=metrics)
+    return next(
+        c for c in health["checks"] if c["name"] == "recovery"
+    )
+
+
+class TestRecoveryCheck:
+    def test_skipped_without_supervisor_or_faults(self):
+        assert _recovery(_metrics_with())["status"] == "skipped"
+
+    def test_skipped_when_faults_but_no_supervisor(self):
+        c = _recovery(_metrics_with(inj=[("level", "raise", 1)]))
+        assert c["status"] == "skipped"
+
+    def test_healed_run_ok(self):
+        c = _recovery(_metrics_with(
+            attempts=2, retries=[("0", "injected", 1)],
+            inj=[("level", "raise", 1)],
+        ))
+        assert c["status"] == "ok"
+
+    def test_clean_run_ok(self):
+        assert _recovery(_metrics_with(attempts=1))["status"] == "ok"
+
+    def test_degradation_always_degrades(self):
+        c = _recovery(_metrics_with(
+            attempts=3, retries=[("0", "injected", 2)],
+            degr=[("packed", "unpacked", 1)],
+        ))
+        assert c["status"] == "degraded"
+
+    def test_swallowed_injection_violates(self):
+        c = _recovery(_metrics_with(
+            attempts=2, retries=[("0", "injected", 1)],
+            inj=[("level", "raise", 2)],
+        ))
+        assert c["status"] == "violated"
+
+    def test_hang_injection_without_failure_is_legal(self):
+        # A hang shorter than the deadline heals without a retry.
+        c = _recovery(_metrics_with(
+            attempts=1, inj=[("level", "hang", 1)],
+        ))
+        assert c["status"] == "ok"
+
+    def test_unhandled_breach_violates(self):
+        c = _recovery(_metrics_with(attempts=2, breaches=1))
+        assert c["status"] == "violated"
+
+    def test_lost_attempt_accounting_violates(self):
+        c = _recovery(_metrics_with(
+            attempts=4, retries=[("0", "exception", 1)],
+        ))
+        assert c["status"] == "violated"
+
+    def test_sentinel_watches_supervisor_overhead_gauge(self):
+        from image_analogies_tpu.telemetry.sentinel import (
+            _OVERHEAD_GAUGES,
+        )
+
+        assert "ia_supervisor_overhead_frac" in _OVERHEAD_GAUGES
+
+
+# ------------------------------------------------------ overhead pin
+class TestSupervisorOverhead:
+    def test_supervised_overhead_under_budget_and_bit_identical(
+        self, tmp_path
+    ):
+        """ISSUE 7 satellite: --supervise with no faults injected adds
+        < 2% wall (min-paired-delta harness, the round-9 discipline:
+        load spikes on this 1-core box are one-sided, so the MIN
+        paired delta bounds the real layer cost) and ZERO graph
+        changes — pinned as bit-identity between the supervised and
+        unsupervised outputs.  Publishes
+        `ia_supervisor_overhead_frac`, which the sentinel's
+        telemetry_overhead check watches."""
+        import jax.numpy as jnp
+
+        from image_analogies_tpu.telemetry.metrics import get_registry
+        from image_analogies_tpu.telemetry.sentinel import (
+            OVERHEAD_BUDGET_FRAC,
+        )
+        from image_analogies_tpu.utils.examples import texture_by_numbers
+
+        # Same config as tests/test_live.py / test_sentinel.py's
+        # overhead arms: one compile cache serves all three pins.
+        cfg = SynthConfig(
+            levels=2, matcher="patchmatch", pallas_mode="off",
+            em_iters=1, pm_iters=3, pm_polish_iters=1,
+            pm_polish_random=1,
+        )
+        a, ap, b = texture_by_numbers(128)
+        a, ap, b = (jnp.asarray(x, jnp.float32) for x in (a, ap, b))
+
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        try:
+            tracer = Tracer(registry=reg)
+            ckpt = str(tmp_path / "sup_ckpt")
+            sup_cfg = dataclasses.replace(
+                cfg, save_level_artifacts=ckpt
+            )
+
+            def run_plain():
+                out = create_image_analogy(
+                    a, ap, b, cfg, progress=tracer
+                )
+                return np.asarray(out)
+
+            def run_supervised():
+                out = supervisor.supervise(
+                    lambda resume: create_image_analogy(
+                        a, ap, b, sup_cfg, progress=tracer,
+                        resume_from=resume,
+                    ),
+                    ckpt_dir=ckpt, tracer=tracer, backoff_s=0.0,
+                )
+                return np.asarray(out)
+
+            base_out = run_plain()  # compile/warm
+            sup_out = run_supervised()
+            # Zero graph changes: supervised output bit-identical.
+            np.testing.assert_array_equal(sup_out, base_out)
+
+            deltas, bases = [], []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                run_plain()
+                base = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                run_supervised()
+                full = time.perf_counter() - t0
+                bases.append(base)
+                deltas.append(full - base)
+        finally:
+            set_registry(prev)
+        overhead = max(0.0, min(deltas) / statistics.median(bases))
+        get_registry().gauge(
+            "ia_supervisor_overhead_frac",
+            "measured supervised-execution layer cost (watchdog "
+            "observer + worker thread + forced checkpoints) as a "
+            "fraction of the synth wall (min paired delta, identical "
+            "instrumentation on both arms)",
+        ).set(round(overhead, 4))
+        assert overhead < OVERHEAD_BUDGET_FRAC, (
+            f"supervised layer measured at {overhead:.2%} of wall — "
+            f"budget is {OVERHEAD_BUDGET_FRAC:.0%}"
+        )
+        health = evaluate_health(metrics=get_registry().to_dict())
+        by_name = {c["name"]: c for c in health["checks"]}
+        assert by_name["telemetry_overhead"]["status"] == "ok"
+        assert (
+            "ia_supervisor_overhead_frac"
+            in by_name["telemetry_overhead"]["observed"]
+        )

@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""Chaos suite — the fault x recovery matrix, run at a small proxy
+size, producing the FAULTS_r12.json round artifact (round 12
+tentpole).
+
+Each arm arms one `IA_FAULT_PLAN` class (runtime/faults.py grammar)
+and runs one SUPERVISED synthesis (runtime/supervisor.py) against it,
+recording how the run ended:
+
+    healed       the supervisor retried/resumed back to success with
+                 the ladder never stepping — output must be
+                 BIT-IDENTICAL to the undisturbed run
+    degraded     the run survived only by stepping the degradation
+                 ladder (recorded, never silent; the sentinel's
+                 recovery check grades such a run degraded)
+    clean_death  retries + ladder exhausted: SupervisorGaveUp with a
+                 `check_report`-validated flight dump (the acceptance
+                 bar: no fault class may end in an UNVALIDATED death)
+
+plus the recovery overhead (arm wall vs the undisturbed supervised
+wall) and the full counter ledger (retries / degradations / watchdog
+breaches / injections fired), each arm's health verdict included.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/chaos_suite.py [--out FAULTS_r12.json]
+        [--size 32]
+
+tools/check_faults.py validates the artifact's schema and asserts the
+no-unvalidated-death rule; tests/test_faults.py wraps both into tier-1
+(the committed artifact) with the matrix itself slow-marked per the
+round-8 budget rule.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+FAULTS_SCHEMA_VERSION = 1
+
+# The matrix: every IA_FAULT_PLAN action class at every engine
+# injection point family, plus the ladder and clean-death arms.
+# (plan, supervise-kwargs, expect) triples; `hang` uses tiny watchdog
+# bounds so the proxy run breaches in milliseconds, not the production
+# 900 s static bound.
+_TINY_WATCHDOG = dict(
+    static_deadline_s=2.0, min_deadline_s=0.2, watchdog_slack=2.0
+)
+
+
+def _arms():
+    return [
+        dict(name="level_raise", plan="level:0:raise", kw={},
+             expect="healed"),
+        dict(name="kernel_raise", plan="kernel:0:raise", kw={},
+             expect="healed"),
+        dict(name="level_hang_watchdog", plan="level:0:hang:60",
+             kw=dict(_TINY_WATCHDOG), expect="healed"),
+        dict(name="ckpt_truncate", plan="ckpt:1:truncate,level:0:raise",
+             kw={}, expect="healed"),
+        dict(name="xfer_fail", plan="xfer:0:fail", kw={},
+             expect="healed"),
+        dict(name="ladder_degrade", plan="level:0:raise:3",
+             kw=dict(max_retries=1), expect="degraded"),
+        dict(name="clean_death", plan="level:1:raise:99",
+             kw=dict(max_retries=0, ladder=[]), expect="clean_death"),
+    ]
+
+
+def _proxy_inputs(size: int):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    a = rng.random((size, size)).astype(np.float32)
+    ap = np.clip(a * 0.5 + 0.2, 0, 1).astype(np.float32)
+    b = rng.random((size, size)).astype(np.float32)
+    return a, ap, b
+
+
+def _snapshot_modes():
+    """Capture every process-wide seam a ladder step may flip, so each
+    arm can be restored to the CALLER'S configuration (which may be a
+    non-default env arm: IA_CAND_DTYPE=int8, IA_POLISH_MODE=stream,
+    IA_A_PLANE_LAYOUT=unpacked) — not to hard-coded defaults."""
+    from image_analogies_tpu.kernels import patchmatch_tile as pt
+    from image_analogies_tpu.models import patchmatch as pm
+
+    prune = pt.resolve_prune()
+    return {
+        "packed": pt.resolve_packed(),
+        "polish": pm._POLISH_MODE,
+        "cand_dtype": pt.resolve_cand_dtype(),
+        "prune": "off" if prune is None else f"{prune[0]}:{prune[1]}",
+    }
+
+
+def _restore_modes(snap):
+    """Reset every process-wide seam a ladder step may have flipped —
+    arms must not leak state into each other (or into the caller).
+    Each setter is invoked only on an actual difference: the cand
+    setter clears ALL compiled caches unconditionally, and a no-op
+    clear after every arm would recompile the whole proxy pipeline."""
+    from image_analogies_tpu.kernels.patchmatch_tile import (
+        set_cand_compression,
+        set_packed_layout,
+    )
+    from image_analogies_tpu.models.patchmatch import set_polish_mode
+    from image_analogies_tpu.runtime.faults import set_fault_plan
+
+    set_fault_plan(None)
+    set_packed_layout("packed" if snap["packed"] else "unpacked")
+    set_polish_mode(snap["polish"])
+    now = _snapshot_modes()
+    if (now["cand_dtype"], now["prune"]) != (
+        snap["cand_dtype"], snap["prune"]
+    ):
+        set_cand_compression(snap["cand_dtype"], snap["prune"])
+
+
+def run_chaos(size: int = 32):
+    """Run the matrix; returns the FAULTS record (not yet written)."""
+    import numpy as np
+
+    from image_analogies_tpu import SynthConfig, create_image_analogy
+    from image_analogies_tpu.runtime import faults, supervisor
+    from image_analogies_tpu.telemetry import MetricsRegistry, Tracer
+    from image_analogies_tpu.telemetry.flight import FlightRecorder
+    from image_analogies_tpu.telemetry.metrics import set_registry
+    from image_analogies_tpu.telemetry.sentinel import evaluate_health
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from check_report import validate_flight
+
+    a, ap, b = _proxy_inputs(size)
+    cfg0 = SynthConfig(
+        levels=2, matcher="patchmatch", em_iters=2, pm_iters=3
+    )
+    # The undisturbed oracle + compile warm-up (shared jit caches make
+    # every arm's wall a retry/recovery measurement, not a compile
+    # one).
+    bp_ref = np.asarray(create_image_analogy(a, ap, b, cfg0))
+
+    def one_supervised(plan, **kw):
+        ckpt = tempfile.mkdtemp(prefix="ia_chaos_ckpt_")
+        flight_dir = tempfile.mkdtemp(prefix="ia_chaos_flight_")
+        cfg = dataclasses.replace(cfg0, save_level_artifacts=ckpt)
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        tracer = Tracer(registry=reg)
+        rec = FlightRecorder(
+            tracer, reg, os.path.join(flight_dir, "flight.json")
+        )
+        rec.install()
+        tracer.flight_recorder = rec
+        faults.set_fault_plan(plan)
+        out = err = None
+        t0 = time.perf_counter()
+        try:
+            out = supervisor.supervise(
+                lambda resume: create_image_analogy(
+                    a, ap, b, cfg, progress=tracer, resume_from=resume
+                ),
+                ckpt_dir=ckpt, tracer=tracer, backoff_s=0.0, **kw,
+            )
+        except supervisor.SupervisorGaveUp as e:
+            err = e
+        wall = time.perf_counter() - t0
+        faults.set_fault_plan(None)
+        rec.uninstall()
+        set_registry(prev)
+        flight_path = os.path.join(flight_dir, "flight.json")
+        flight = None
+        if os.path.exists(flight_path):
+            with open(flight_path) as f:
+                flight = json.load(f)
+        health = evaluate_health(
+            spans=tracer.to_dict(), metrics=reg.to_dict(),
+            context="chaos",
+        )
+        return out, err, reg, wall, flight, health
+
+    def counter_total(reg, name):
+        return sum(reg.counter(name, "")._values.values())
+
+    mode_snap = _snapshot_modes()
+    # Baseline: a supervised run with NO faults (same forced-checkpoint
+    # config) — the denominator for each arm's recovery overhead.
+    out, err, _, base_wall, _, _ = one_supervised(None)
+    assert err is None and np.array_equal(np.asarray(out), bp_ref), (
+        "undisturbed supervised run must heal-free reproduce the "
+        "oracle"
+    )
+    _restore_modes(mode_snap)
+
+    arms_out = []
+    classes = set()
+    for arm in _arms():
+        out, err, reg, wall, flight, health = one_supervised(
+            arm["plan"], **arm["kw"]
+        )
+        degradations = counter_total(reg, "ia_degradations_total")
+        if err is not None:
+            outcome = "clean_death"
+        elif degradations:
+            outcome = "degraded"
+        else:
+            outcome = "healed"
+        bit_identical = (
+            bool(np.array_equal(np.asarray(out), bp_ref))
+            if out is not None else None
+        )
+        rec_check = next(
+            c for c in health["checks"] if c["name"] == "recovery"
+        )
+        arms_out.append({
+            "name": arm["name"],
+            "fault_plan": arm["plan"],
+            "expected_outcome": arm["expect"],
+            "outcome": outcome,
+            "bit_identical": bit_identical,
+            "retries": counter_total(reg, "ia_retries_total"),
+            "degradations": degradations,
+            "watchdog_breaches": counter_total(
+                reg, "ia_watchdog_breaches_total"
+            ),
+            "injections_fired": counter_total(
+                reg, "ia_fault_injections_total"
+            ),
+            "recovery_overhead_frac": round(
+                max(0.0, wall / base_wall - 1.0), 4
+            ),
+            "flight_flushed_on": (
+                flight.get("flushed_on") if flight else None
+            ),
+            "flight_validated": (
+                validate_flight(flight) == [] if flight else False
+            ),
+            "gave_up": err is not None,
+            "health_verdict": health["verdict"],
+            "recovery_check": rec_check["status"],
+        })
+        for act in ("raise", "hang", "truncate", "fail"):
+            if f":{act}" in arm["plan"]:
+                classes.add(act)
+        if err is not None:
+            classes.add("clean_death")
+        _restore_modes(mode_snap)
+
+    return {
+        "schema_version": FAULTS_SCHEMA_VERSION,
+        "kind": "faults",
+        "round": 12,
+        "generated_by": "tools/chaos_suite.py",
+        "proxy_size": size,
+        "config": {
+            "levels": 2, "matcher": "patchmatch", "em_iters": 2,
+            "pm_iters": 3,
+        },
+        "baseline_supervised_wall_s": round(base_wall, 3),
+        "classes_covered": sorted(classes),
+        "arms": arms_out,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="FAULTS_r12.json")
+    ap.add_argument("--size", type=int, default=32)
+    args = ap.parse_args(argv)
+    record = run_chaos(args.size)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    n_bad = sum(
+        1 for arm in record["arms"]
+        if arm["outcome"] != arm["expected_outcome"]
+    )
+    for arm in record["arms"]:
+        print(
+            f"{arm['name']:>22}: {arm['outcome']:<11} "
+            f"(expected {arm['expected_outcome']}; retries "
+            f"{arm['retries']:.0f}, degr {arm['degradations']:.0f}, "
+            f"breaches {arm['watchdog_breaches']:.0f}, overhead "
+            f"{arm['recovery_overhead_frac']:.2f})"
+        )
+    print(f"wrote {args.out}")
+    return 1 if n_bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Validate a FAULTS_r12.json chaos-suite artifact (round 12).
+
+The supervised-execution acceptance bar, enforced by a validator
+instead of trusted to prose: every fault class in the matrix must end
+in exactly one of the three declared outcomes, a healed arm must be
+bit-identical to the undisturbed run, a degraded arm must have
+RECORDED its ladder steps (and its health verdict must say degraded —
+a degradation that grades clean is the silent-quality-loss failure
+mode this round exists to prevent), and NO fault class may end in an
+unvalidated death: a gave-up arm without a schema-valid flight dump is
+a run that died without a post-mortem.
+
+Usage:
+    python tools/check_faults.py FAULTS_r12.json
+
+Runs under pytest too (tests/test_faults.py validates the COMMITTED
+artifact) so tier-1 fails if the record is missing, truncated, or
+structurally degraded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+FAULTS_SCHEMA_VERSION = 1
+_OUTCOMES = ("healed", "degraded", "clean_death")
+# Every IA_FAULT_PLAN action class must appear in the matrix, plus at
+# least one arm that exercises the give-up path end-to-end.
+_REQUIRED_CLASSES = ("raise", "hang", "truncate", "fail", "clean_death")
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_faults(record: dict) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if record.get("schema_version") != FAULTS_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {record.get('schema_version')!r} != "
+            f"{FAULTS_SCHEMA_VERSION}"
+        )
+    if record.get("kind") != "faults":
+        errs.append(f"kind {record.get('kind')!r} != 'faults'")
+    size = record.get("proxy_size")
+    if not (_num(size) and size >= 16):
+        errs.append(f"proxy_size {size!r} is not a size >= 16")
+
+    classes = record.get("classes_covered")
+    if not isinstance(classes, list):
+        errs.append("classes_covered: missing list")
+        classes = []
+    for cls in _REQUIRED_CLASSES:
+        if cls not in classes:
+            errs.append(
+                f"classes_covered is missing {cls!r} — the matrix "
+                "must exercise every fault class"
+            )
+
+    arms = record.get("arms")
+    if not isinstance(arms, list) or not arms:
+        errs.append("arms: missing/empty list")
+        arms = []
+    for i, arm in enumerate(arms):
+        if not isinstance(arm, dict):
+            errs.append(f"arms[{i}]: not an object")
+            continue
+        name = arm.get("name", f"arms[{i}]")
+        outcome = arm.get("outcome")
+        if outcome not in _OUTCOMES:
+            errs.append(
+                f"{name}: outcome {outcome!r} names none of "
+                f"{_OUTCOMES} — an undeclared ending is an "
+                "unvalidated death"
+            )
+            continue
+        if arm.get("expected_outcome") not in _OUTCOMES:
+            errs.append(
+                f"{name}: expected_outcome "
+                f"{arm.get('expected_outcome')!r} names none of "
+                f"{_OUTCOMES}"
+            )
+        elif outcome != arm["expected_outcome"]:
+            errs.append(
+                f"{name}: outcome {outcome!r} != expected "
+                f"{arm['expected_outcome']!r}"
+            )
+        if not isinstance(arm.get("fault_plan"), str) or not arm.get(
+            "fault_plan"
+        ):
+            errs.append(f"{name}: fault_plan missing/empty")
+        if outcome == "healed":
+            if arm.get("bit_identical") is not True:
+                errs.append(
+                    f"{name}: healed but bit_identical is "
+                    f"{arm.get('bit_identical')!r} — a heal that "
+                    "changes the output is not a heal"
+                )
+            if arm.get("recovery_check") not in ("ok",):
+                errs.append(
+                    f"{name}: healed but the sentinel recovery check "
+                    f"graded {arm.get('recovery_check')!r}"
+                )
+        if outcome == "degraded":
+            d = arm.get("degradations")
+            if not (_num(d) and d >= 1):
+                errs.append(
+                    f"{name}: degraded with degradations={d!r} — a "
+                    "ladder step must be recorded, never silent"
+                )
+            if arm.get("recovery_check") != "degraded":
+                errs.append(
+                    f"{name}: degraded arm's recovery check graded "
+                    f"{arm.get('recovery_check')!r} — a degradation "
+                    "must never pass as clean"
+                )
+        if outcome == "clean_death":
+            if arm.get("gave_up") is not True:
+                errs.append(
+                    f"{name}: clean_death without gave_up=true"
+                )
+            if arm.get("flight_validated") is not True:
+                errs.append(
+                    f"{name}: clean_death WITHOUT a validated flight "
+                    "dump — an unvalidated death, the one ending the "
+                    "acceptance criteria forbid"
+                )
+        else:
+            # Survivors: overhead must be a recorded non-negative
+            # fraction (the recovery price is part of the artifact's
+            # claim).
+            ov = arm.get("recovery_overhead_frac")
+            if not (_num(ov) and ov >= 0):
+                errs.append(
+                    f"{name}: recovery_overhead_frac {ov!r} is not a "
+                    "non-negative number"
+                )
+        # Any arm that died must carry a validated dump, whatever the
+        # outcome label claims (belt and braces for hand-edited
+        # records).
+        if arm.get("gave_up") is True and arm.get(
+            "flight_validated"
+        ) is not True:
+            errs.append(
+                f"{name}: gave_up without a validated flight dump"
+            )
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="FAULTS_r12.json to validate")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"check_faults: cannot read {args.path}: {e}")
+        return 1
+    errs = validate_faults(record)
+    if errs:
+        print(f"check_faults: {args.path} INVALID:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print(
+        f"check_faults: {args.path} OK "
+        f"({len(record.get('arms', []))} arms, classes: "
+        f"{', '.join(record.get('classes_covered', []))})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

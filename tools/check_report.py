@@ -46,7 +46,8 @@ HEALTH_SCHEMA_VERSION = 1
 FLIGHT_SCHEMA_VERSION = 1
 
 _FLIGHT_REASONS = (
-    "sigterm", "sigint", "atexit", "violation", "session-end", "manual",
+    "sigterm", "sigint", "atexit", "violation", "watchdog",
+    "session-end", "manual",
 )
 _FLIGHT_EVENT_KINDS = ("open", "close", "mark")
 
