@@ -619,6 +619,89 @@ def cmd_batch(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Synthesis-as-a-service (round 13, serving/): a long-lived
+    daemon over one style pair, serving POST /synthesize with a
+    compiled-executable cache, continuous batching, and admission
+    control — on the same HTTP server and telemetry surface
+    (/metrics, /healthz, live.json rendezvous) every traced run uses."""
+    _apply_cand_compression(args)
+    _select_device(args.device)
+    import dataclasses
+
+    from .parallel.mesh import make_mesh
+    from .serving.daemon import SynthDaemon
+    from .serving.excache import load_warmup_manifest
+    from .utils.io import load_image
+    from .utils.profiling import telemetry_session
+
+    a = load_image(args.a)
+    ap = load_image(args.ap)
+    cfg = _config_from(args)
+    if cfg.save_level_artifacts:
+        # The daemon owns per-dispatch checkpoint dirs (retry-with-
+        # resume inside one dispatch); a shared user path would make
+        # concurrent dispatches clobber each other's state.
+        print(
+            "serve: ignoring --save-level-artifacts (checkpoints are "
+            "per-dispatch-managed)", file=sys.stderr,
+        )
+        cfg = dataclasses.replace(cfg, save_level_artifacts=None)
+    warm_entries = None
+    if args.warmup:
+        try:
+            warm_entries = load_warmup_manifest(args.warmup)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"serve: --warmup: {e}")
+    mesh = make_mesh(args.n_devices)
+    # Daemon-lifetime telemetry session: trace_dir=None (no device
+    # trace over an unbounded lifetime), artifacts + flight recorder
+    # into --trace-dir.  SIGTERM flushes flight.json then re-delivers
+    # (telemetry/flight.py), so a killed daemon leaves a post-mortem.
+    with telemetry_session(
+        None, enabled=True, artifact_dir=args.trace_dir,
+        metrics_port=None,
+    ) as tracer:
+        daemon = SynthDaemon(
+            a, ap, cfg,
+            registry=tracer.registry,
+            tracer=tracer,
+            mesh=mesh,
+            host=args.host,
+            port=args.port,
+            max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            max_queue_depth=args.max_queue_depth,
+            cache_capacity=args.cache_capacity,
+            max_retries=args.max_retries,
+            flight=getattr(tracer, "flight_recorder", None),
+        ).start()
+        try:
+            if warm_entries:
+                report = daemon.warmup(warm_entries)
+                for rec in report:
+                    print(
+                        f"warmup: {rec['key']} compiled in "
+                        f"{rec['wall_ms']:.0f} ms"
+                    )
+            # Rendezvous AFTER warmup: a live.json reader may assume
+            # the manifest's shapes are already warm.
+            if args.trace_dir:
+                daemon.live.announce(args.trace_dir)
+            print(
+                f"serving on {daemon.url} (POST /synthesize; GET "
+                "/serving /metrics /healthz /progress)",
+                flush=True,
+            )
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            print("serve: interrupted, draining")
+        finally:
+            daemon.stop()
+    return 0
+
+
 def cmd_examples(args) -> int:
     import numpy as np
 
@@ -756,6 +839,55 @@ def main(argv=None) -> int:
     )
     _add_synth_flags(p)
     p.set_defaults(fn=cmd_batch)
+
+    p = sub.add_parser(
+        "serve",
+        help="synthesis-as-a-service daemon: request queue + "
+        "compiled-executable cache + continuous batching + admission "
+        "control over HTTP (serving/)",
+    )
+    p.add_argument("--a", required=True)
+    p.add_argument("--ap", required=True)
+    p.add_argument(
+        "--port", type=int, default=0,
+        help="bind port (0 = ephemeral; the bound endpoint prints on "
+        "stdout and announces in <trace-dir>/live.json)",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--n-devices", type=int, default=None)
+    p.add_argument(
+        "--max-batch", type=int, default=None, metavar="N",
+        help="continuous-batching dispatch grain (default: device "
+        "count).  Every dispatch pads to exactly this many frames so "
+        "repeat request shapes share one compiled executable",
+    )
+    p.add_argument(
+        "--max-wait-ms", type=float, default=25.0, metavar="MS",
+        help="longest the queue head waits for co-batchable requests "
+        "before a partial batch flushes (default 25)",
+    )
+    p.add_argument(
+        "--max-queue-depth", type=int, default=32, metavar="N",
+        help="admission limit: requests beyond this backlog "
+        "(queued + in-flight) are shed with 429 + Retry-After; the "
+        "limit halves while the backend's straggler/degradation "
+        "gauges read degraded (default 32)",
+    )
+    p.add_argument(
+        "--cache-capacity", type=int, default=8, metavar="N",
+        help="compiled-executable cache residency (default 8).  "
+        "Eviction is epoch-grained (serving/excache.py): size this "
+        "so eviction is rare",
+    )
+    p.add_argument(
+        "--warmup", default=None, metavar="MANIFEST",
+        help="serve_warmup JSON manifest of expected request shapes, "
+        "compiled through the real dispatch path before the endpoint "
+        "announces — the first client request of each listed shape "
+        "is then a cache hit",
+    )
+    _add_synth_flags(p)
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("examples", help="generate procedural example assets")
     _add_common_flags(p)

@@ -364,17 +364,31 @@ def ingest_frame_dir(path: str, *, strict: bool = False):
             f"batch ingest: no loadable frames in {path!r} "
             f"({len(failures)} failed, {len(names)} candidates)"
         )
-    # Shape reference: the MAJORITY shape of the decoded frames (ties
-    # -> first seen), not the lexically-first frame — a stray odd-sized
-    # thumbnail sorting first must be the skipped outlier, not the
-    # reference that silently discards the whole real batch with
-    # exit 0.
+    stack, ok_names = _majority_shape_filter(
+        [(name, fpath, img) for name, fpath, img in decoded],
+        strict, failures, "--strict-frames is set",
+    )
+    return stack, ok_names, failures
+
+
+def _majority_shape_filter(decoded, strict, failures, strict_hint):
+    """Shared shape-consistency pass for both ingest front-ends.
+
+    Shape reference: the MAJORITY shape of the decoded frames (ties
+    -> first seen), not the first frame — a stray odd-sized
+    thumbnail sorting first must be the skipped outlier, not the
+    reference that silently discards the whole real batch with
+    exit 0.  `decoded` is (label, ident, img) triples; `ident` is what
+    failure records and strict errors name (a file path, or an
+    in-memory index label)."""
+    import numpy as np
+
     counts: dict = {}
-    for _name, _fpath, img in decoded:
+    for _name, _ident, img in decoded:
         counts[img.shape] = counts.get(img.shape, 0) + 1
     ref_shape = max(counts, key=lambda s: counts[s])
     loaded, ok_names = [], []
-    for name, fpath, img in decoded:
+    for name, ident, img in decoded:
         if img.shape != ref_shape:
             reason = (
                 f"ValueError: frame shape {img.shape} != the batch's "
@@ -382,14 +396,69 @@ def ingest_frame_dir(path: str, *, strict: bool = False):
             )
             if strict:
                 raise RuntimeError(
-                    f"batch ingest: frame {fpath!r} failed ({reason}) "
-                    "and --strict-frames is set"
+                    f"batch ingest: frame {ident!r} failed ({reason}) "
+                    f"and {strict_hint}"
                 )
-            failures.append({"path": fpath, "reason": reason})
+            failures.append({"path": ident, "reason": reason})
             continue
         loaded.append(img)
         ok_names.append(name)
-    return np.stack(loaded), ok_names, failures
+    return np.stack(loaded), ok_names
+
+
+def ingest_frames(arrays, *, strict: bool = False):
+    """In-memory twin of `ingest_frame_dir` (round 13: the serving
+    daemon dispatches request payloads without tempfile round-trips).
+
+    `arrays` is a sequence of per-frame arrays (H, W[, 3]) — or one
+    already-stacked (F, H, W[, 3]) array, accepted as the trivial
+    fast path.  Applies the same per-frame fault isolation and
+    majority-shape rule as the file front-end: a non-array entry, a
+    non-2D/3D shape, or a shape-minority frame is skipped with a
+    recorded {"path": "frames[i]", "reason"} failure (`strict=True`
+    raises on the first).  Returns (frames, names, failures) with
+    `frames` a float32 stack and `names` the "frames[i]" labels of the
+    kept entries.  Zero usable frames raise regardless."""
+    import numpy as np
+
+    if isinstance(arrays, np.ndarray) and arrays.ndim in (3, 4):
+        arrays = list(arrays) if arrays.ndim == 4 else [arrays]
+    decoded, failures = [], []
+    for i, arr in enumerate(arrays):
+        label = f"frames[{i}]"
+        try:
+            img = np.asarray(arr, dtype=np.float32)
+            if img.ndim not in (2, 3) or min(img.shape[:2]) < 1:
+                raise ValueError(
+                    f"frame array has shape {img.shape}, expected "
+                    "(H, W) or (H, W, C)"
+                )
+            if img.ndim == 3 and img.shape[2] not in (1, 3):
+                raise ValueError(
+                    f"frame array has {img.shape[2]} channels, "
+                    "expected 1 or 3"
+                )
+        except Exception as e:  # noqa: BLE001 - isolate, record, go on
+            if strict:
+                raise RuntimeError(
+                    f"batch ingest: frame {label!r} failed "
+                    f"({e}) and strict ingest is set"
+                ) from e
+            failures.append({
+                "path": label,
+                "reason": f"{type(e).__name__}: {e}",
+            })
+            continue
+        decoded.append((label, label, img))
+    if not decoded:
+        raise RuntimeError(
+            f"batch ingest: no usable in-memory frames "
+            f"({len(failures)} failed)"
+        )
+    stack, ok_names = _majority_shape_filter(
+        decoded, strict, failures, "strict ingest is set"
+    )
+    return stack, ok_names, failures
 
 
 def synthesize_batch(
@@ -402,6 +471,7 @@ def synthesize_batch(
     frames_per_step: Optional[int] = None,
     resume_from: Optional[str] = None,
     resume_strict: bool = False,
+    frame_indices=None,
     _b_stats=None,
     _frame_offset: int = 0,
     _n_stack: Optional[int] = None,
@@ -435,6 +505,17 @@ def synthesize_batch(
     their own device count (round 12; the supervisor's mesh->single
     degradation rung resumes mesh-written checkpoints this way).
     Chunked runs write (and resume) per-chunk subdirectories.
+
+    `frame_indices` (round 13, the serving daemon's isolation knob)
+    overrides the PRNG identity of each frame: by default frame i's
+    key stream derives from its global stack position (temporal
+    batches — a rerun of the same video must reproduce itself), but a
+    serving batch coalesces UNRELATED requests, and each request's
+    output must match what a solo dispatch of that request would
+    produce regardless of co-tenants.  Passing `frame_indices=[0]*F`
+    gives every frame the key stream of a single-frame run, making
+    outputs batch-composition-independent.  Length must equal the
+    frame count; entries need not be distinct.
 
     `_b_stats` / `_frame_offset` / `_n_stack` are the internal
     whole-stack stats / global-frame-index / total-stack-length
@@ -471,6 +552,13 @@ def synthesize_batch(
                 "(was %s) and unfused level dispatch", frames_per_step,
             )
             frames_per_step = 1
+    if frame_indices is not None:
+        frame_indices = [int(i) for i in frame_indices]
+        if len(frame_indices) != frames.shape[0]:
+            raise ValueError(
+                f"frame_indices has {len(frame_indices)} entries for "
+                f"{frames.shape[0]} frames"
+            )
     n_stack = _n_stack if _n_stack is not None else frames.shape[0]
     if _b_stats is None and cfg.color_mode == "luminance" and cfg.luminance_remap:
         # One style normalization for the WHOLE (unpadded) stack: temporal
@@ -512,6 +600,15 @@ def synthesize_batch(
                         a, ap, chunk, chunk_cfg, mesh, progress,
                         resume_from=chunk_resume,
                         resume_strict=resume_strict,
+                        frame_indices=(
+                            # Ragged final chunks pad with the last
+                            # frame; its index rides along (ballast
+                            # rows are trimmed above).
+                            (lambda ch: ch + [ch[-1]] * (
+                                frames_per_step - len(ch)
+                            ))(frame_indices[i : i + frames_per_step])
+                            if frame_indices is not None else None
+                        ),
                         _b_stats=_b_stats, _frame_offset=i, _n_stack=n,
                     )
                 )[:n_chunk]
@@ -540,8 +637,16 @@ def synthesize_batch(
     # Global frame indices (offset by the chunk position) make per-frame
     # keys — and therefore outputs — invariant to frames_per_step (the
     # fused level function derives the per-frame key streams from these,
-    # bit-identically to the old host-side frame_keys helper).
-    frame_idx = jnp.arange(frames.shape[0]) + _frame_offset
+    # bit-identically to the old host-side frame_keys helper).  An
+    # explicit frame_indices overrides the positional identity (serving
+    # batches of unrelated requests, each keyed as its own frame 0);
+    # mesh-padding ballast rows repeat the last real index, matching
+    # the repeated last frame they carry.
+    if frame_indices is not None:
+        idx_list = frame_indices + [frame_indices[-1]] * n_pad
+        frame_idx = jnp.asarray(idx_list)
+    else:
+        frame_idx = jnp.arange(frames.shape[0]) + _frame_offset
 
     # Checkpoint identity: the UNPADDED chunk shape plus the
     # whole-stack length and this chunk's offset — per-chunk state
@@ -555,6 +660,11 @@ def synthesize_batch(
     fp_shape = (
         (n_frames,) + tuple(frames.shape[1:]) + (n_stack, _frame_offset)
     )
+    if frame_indices is not None:
+        # Overridden PRNG identities are part of the checkpoint's
+        # identity too: state computed under one index assignment must
+        # not resume under another.
+        fp_shape = fp_shape + tuple(frame_indices)
 
     start_level = levels - 1
     resumed = resume_prologue(

@@ -399,6 +399,15 @@ def supervise(
     )
     if observing:
         tracer.add_observer(watch.observe)
+    # One booking per supervise() CALL (round 13): a serving daemon
+    # makes one call per dispatch, so the sentinel's recovery ledger
+    # scales its attempts-vs-retries invariant by this count instead
+    # of assuming the one-call-per-run CLI shape.
+    registry.counter(
+        "ia_supervisor_invocations_total",
+        "supervise() invocations (one per supervised run or serving "
+        "dispatch)",
+    ).inc()
     attempts_c = registry.counter(
         "ia_supervisor_attempts_total",
         "supervised synthesis attempts started (first try + retries)",

@@ -1,0 +1,38 @@
+"""Synthesis-as-a-service (round 13): a request-queue daemon over the
+existing runners, with a compiled-executable cache, continuous
+batching, and admission control.  `ia-synth serve` is the front door;
+serving/daemon.py documents the architecture."""
+
+from .daemon import SynthDaemon
+from .excache import (
+    ExecutableCache,
+    compression_mode,
+    config_fingerprint,
+    exec_key,
+    load_warmup_manifest,
+    run_warmup,
+)
+from .queueing import (
+    AdmissionController,
+    BatchingPolicy,
+    RequestQueue,
+    ServeRequest,
+    coalesce,
+    demux,
+)
+
+__all__ = [
+    "SynthDaemon",
+    "ExecutableCache",
+    "compression_mode",
+    "config_fingerprint",
+    "exec_key",
+    "load_warmup_manifest",
+    "run_warmup",
+    "AdmissionController",
+    "BatchingPolicy",
+    "RequestQueue",
+    "ServeRequest",
+    "coalesce",
+    "demux",
+]

@@ -1,0 +1,574 @@
+"""The synthesis daemon — synthesis-as-a-service over the existing
+runners (round 13 tentpole; serving/excache.py holds the compiled-
+executable cache, serving/queueing.py the batching/admission policy,
+and `ia-synth serve` in cli.py the front door).
+
+One long-lived process, one style pair: the daemon loads (A, A') at
+startup (matching the batch runner's shared-style contract) and serves
+`POST /synthesize` requests carrying a B image each, on the SAME HTTP
+server the per-run exporter uses (`telemetry/live.py`, generalized
+this round to take injected routes and a health callback) — so
+`/metrics`, `/healthz`, and the `live.json` rendezvous file work
+identically for a daemon and a run.
+
+Request lifecycle (the span names, in order):
+
+    queued      handler thread validated + enqueued the request
+    admitted    dispatcher popped it into a batch
+    cache-hit | compiled
+                the executable cache's verdict for the dispatch
+    executed    the batch dispatch returned
+    demuxed     this request's output row was fanned back out
+
+Isolation contract — a request's output NEVER depends on its
+co-tenants.  Two constructions enforce it:
+
+  - PRNG: every dispatch passes `frame_indices=[0]*grain` to
+    `synthesize_batch`, so each frame gets the key stream of a solo
+    single-frame run regardless of batch position.
+  - Luminance statistics: the batch runner normalizes style luminance
+    over the whole stack, which would leak co-tenant statistics into
+    every output.  The daemon instead computes each request's (mu,
+    sigma) at admission, quantizes both to 1/32 buckets, makes the
+    bucket part of the batching-compatibility key, and passes the
+    BUCKET CENTER as the dispatch's canonical stats — so a request's
+    remap depends only on its own bucket, not on who shared its
+    batch.  (The quantization perturbs the remap by at most half a
+    bucket — the price of batchability, stated here rather than
+    hidden.)
+
+Static batch grain: every dispatch is padded (last frame repeated) to
+exactly `max_batch` frames, because the batch runner's executables are
+shape-specialized over the frame axis — variable batch sizes would
+give each occupancy level its own compile and make the executable
+cache's "repeat shape = hit" claim false.  The ballast rows are
+trimmed before demux; the waste is bounded by (max_batch - 1) frames
+per dispatch and shrinks to zero at full occupancy.
+
+Failure containment: each dispatch runs under
+`runtime/supervisor.supervise` with `tracer=None` (exception-retry
+only — the watchdog's deadline model is calibrated for full runs, not
+sub-second serving dispatches) and `ladder=[]` (NO degradation ladder:
+every rung flips process-wide kernel modes, which would silently
+change co-tenant and future-request outputs).  A give-up maps to HTTP
+500 for that batch's requests; the daemon keeps serving.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .excache import ExecutableCache, exec_key, key_str, run_warmup
+from .queueing import (
+    AdmissionController,
+    BatchingPolicy,
+    RequestQueue,
+    ServeRequest,
+    demux,
+)
+
+# Luminance-stats quantization grain (buckets of 1/32 in both mu and
+# sigma): fine enough that the canonical-stats remap is visually
+# indistinguishable from exact stats, coarse enough that same-source
+# request streams actually coalesce.
+LUMA_BUCKET = 32.0
+
+REQUEST_TIMEOUT_S = 600.0
+
+
+def _luma_bucket(frame: np.ndarray) -> Optional[Tuple[float, float]]:
+    """(mu, sigma) of the frame's luminance, quantized to LUMA_BUCKET
+    bucket CENTERS — the canonical statistics this request will be
+    remapped under (and batched by)."""
+    if frame.ndim == 3 and frame.shape[2] == 3:
+        y = (
+            0.299 * frame[..., 0] + 0.587 * frame[..., 1]
+            + 0.114 * frame[..., 2]
+        )
+    else:
+        y = frame[..., 0] if frame.ndim == 3 else frame
+    mu, sigma = float(np.mean(y)), float(np.std(y))
+    return (
+        (np.floor(mu * LUMA_BUCKET) + 0.5) / LUMA_BUCKET,
+        (np.floor(sigma * LUMA_BUCKET) + 0.5) / LUMA_BUCKET,
+    )
+
+
+class SynthDaemon:
+    """The daemon: queue + dispatcher + executable cache + HTTP front
+    end, all instrumented into one injected registry.
+
+    `start()` binds the (generalized) live-telemetry server with the
+    serving routes mounted, runs the warmup manifest, and starts the
+    dispatcher thread; `stop()` drains.  The caller owns process-level
+    wiring (installing the registry as process default so engine
+    counters land in it, flight-recorder signal hooks, live.json
+    announcement) — cli.cmd_serve is the reference harness."""
+
+    def __init__(
+        self,
+        a,
+        ap,
+        cfg,
+        *,
+        registry,
+        tracer=None,
+        mesh=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_batch: Optional[int] = None,
+        max_wait_ms: float = 25.0,
+        max_queue_depth: int = 32,
+        cache_capacity: int = 8,
+        max_retries: int = 1,
+        flight=None,
+        work_dir: Optional[str] = None,
+    ):
+        from ..parallel.batch import make_mesh
+
+        self.a = np.asarray(a, np.float32)
+        self.ap = np.asarray(ap, np.float32)
+        self.cfg = cfg
+        self.registry = registry
+        self.tracer = tracer
+        self.flight = flight
+        self.mesh = mesh or make_mesh()
+        if max_batch is None:
+            max_batch = max(1, int(self.mesh.devices.size))
+        self.policy = BatchingPolicy(
+            max_batch=max_batch, max_wait_ms=max_wait_ms
+        )
+        self.admission = AdmissionController(
+            max_depth=max_queue_depth, registry=registry
+        )
+        self.cache = ExecutableCache(
+            capacity=cache_capacity, registry=registry
+        )
+        self.queue = RequestQueue()
+        self.max_retries = int(max_retries)
+        self.host = host
+        self._requested_port = port
+        self.live = None  # LiveTelemetryServer after start()
+        self._work_dir = work_dir
+        self._own_work_dir = work_dir is None
+        self._inflight = 0
+        self._stop = threading.Event()
+        self._dispatcher: Optional[threading.Thread] = None
+        self._init_metrics()
+
+    # ------------------------------------------------------- metrics
+    def _init_metrics(self) -> None:
+        r = self.registry
+        self._c_requests = r.counter(
+            "ia_serve_requests_total",
+            "well-formed synthesis requests received (before the "
+            "admission decision; booked first so admitted + shed can "
+            "never outrun it)",
+        )
+        self._c_admitted = r.counter(
+            "ia_serve_admitted_total", "requests admitted to the queue"
+        )
+        self._c_shed = r.counter(
+            "ia_serve_shed_total",
+            "requests shed with 429 + Retry-After (admission control)",
+        )
+        self._c_completed = r.counter(
+            "ia_serve_completed_total", "requests answered 200"
+        )
+        self._c_failed = r.counter(
+            "ia_serve_failed_total",
+            "admitted requests answered 5xx (supervisor give-up or "
+            "dispatch error)",
+        )
+        self._c_dispatches = r.counter(
+            "ia_serve_dispatches_total",
+            "batch dispatches onto the engine, by kind "
+            "(client/warmup); every dispatch consults the executable "
+            "cache exactly once",
+        )
+        self._g_depth = r.gauge(
+            "ia_serve_queue_depth", "requests waiting in the queue"
+        )
+        self._g_inflight = r.gauge(
+            "ia_serve_inflight",
+            "requests inside the currently-executing dispatch",
+        )
+        self._h_latency = r.histogram(
+            "ia_serve_request_ms",
+            "serving request latency by lifecycle phase (ms): queued "
+            "= enqueue->admitted, service = admitted->done, total = "
+            "enqueue->done",
+        )
+        self._g_depth.set(0)
+        self._g_inflight.set(0)
+
+    # ------------------------------------------------------ lifecycle
+    def start(self) -> "SynthDaemon":
+        from ..telemetry.live import LiveTelemetryServer
+        from ..telemetry.spans import as_tracer
+
+        if self.tracer is None:
+            self.tracer = as_tracer(None)
+        if self._own_work_dir:
+            self._work_dir = tempfile.mkdtemp(prefix="ia-serve-")
+        self.live = LiveTelemetryServer(
+            self.tracer,
+            self.registry,
+            port=self._requested_port,
+            host=self.host,
+            flight=self.flight,
+            health_cb=self.health,
+            routes={
+                ("POST", "/synthesize"): self._route_synthesize,
+                ("GET", "/serving"): self._route_serving,
+            },
+        ).start()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="ia-serve-dispatch",
+            daemon=True,
+        )
+        self._dispatcher.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for req in self.queue.drain():
+            req.status = "failed"
+            req.error = "daemon shutting down"
+            self._c_failed.inc()
+            req.done.set()
+        self._g_depth.set(0)
+        if self._dispatcher is not None:
+            self._dispatcher.join(timeout=30.0)
+            self._dispatcher = None
+        if self.live is not None:
+            self.live.stop()
+            self.live = None
+        if self._own_work_dir and self._work_dir:
+            shutil.rmtree(self._work_dir, ignore_errors=True)
+
+    @property
+    def url(self) -> str:
+        return self.live.url
+
+    # -------------------------------------------------------- warmup
+    def warmup(self, entries: List[Dict[str, Any]]) -> List[Dict]:
+        """Compile the manifest's shapes through the real dispatch
+        path BEFORE announcing the endpoint (cli.cmd_serve orders it
+        so): rendezvous implies warm."""
+
+        def dispatch(shape):
+            frame = np.zeros(shape, np.float32)
+            req = self._make_request(frame)
+            self._execute([req], kind="warmup")
+            if req.status != "ok":
+                raise RuntimeError(
+                    f"warmup dispatch failed for shape {shape}: "
+                    f"{req.error}"
+                )
+
+        return run_warmup(
+            entries, dispatch, self.cache,
+            lambda shape: exec_key(shape, self.cfg, self.policy.max_batch),
+        )
+
+    # ------------------------------------------------------- serving
+    def _make_request(self, frame: np.ndarray) -> ServeRequest:
+        key = exec_key(frame.shape, self.cfg, self.policy.max_batch)
+        bucket = None
+        if self.cfg.color_mode == "luminance" and \
+                self.cfg.luminance_remap:
+            bucket = _luma_bucket(frame)
+        return ServeRequest(
+            frame=frame, key=key, compat=key + (bucket,),
+            b_stats=bucket,
+        )
+
+    def _route_synthesize(self, body: Optional[bytes]):
+        """POST /synthesize handler (runs on an HTTP handler thread):
+        validate -> admit-or-shed -> enqueue -> block on completion."""
+        try:
+            frame = _decode_request(body)
+        except ValueError as e:
+            return (
+                400,
+                _json_bytes({"status": "rejected", "error": str(e)}),
+                "application/json",
+            )
+        req = self._make_request(frame)
+        req.span("queued")
+        # Requests books FIRST (the serving sentinel check's ordering
+        # contract), then exactly one of admitted/shed.
+        self._c_requests.inc()
+        ok, retry_after = self.admission.admit(
+            len(self.queue), self._inflight
+        )
+        if not ok:
+            self._c_shed.inc()
+            return (
+                429,
+                _json_bytes({
+                    "status": "shed",
+                    "request_id": req.req_id,
+                    "retry_after_s": retry_after,
+                }),
+                "application/json",
+                {"Retry-After": str(int(np.ceil(retry_after)))},
+            )
+        self._c_admitted.inc()
+        self.queue.put(req)
+        self._g_depth.set(len(self.queue))
+        if not req.done.wait(REQUEST_TIMEOUT_S):
+            # The client gives up, but the request is still queued or
+            # in flight: the DISPATCHER still owns its ledger entry
+            # and will book completed/failed when it settles — booking
+            # failed here too would double-count the admission ledger
+            # the serving sentinel check balances.
+            req.error = "request timed out in the daemon"
+            return (
+                504,
+                _json_bytes({
+                    "status": "failed", "request_id": req.req_id,
+                    "error": req.error,
+                }),
+                "application/json",
+            )
+        total_ms = (time.monotonic() - req.enqueue_t) * 1000.0
+        self._h_latency.observe(total_ms, labels={"phase": "total"})
+        if req.status != "ok":
+            return (
+                500,
+                _json_bytes({
+                    "status": "failed", "request_id": req.req_id,
+                    "error": req.error, "spans": req.spans,
+                }),
+                "application/json",
+            )
+        out = np.asarray(req.result, np.float32)
+        return (
+            200,
+            _json_bytes({
+                "status": "ok",
+                "request_id": req.req_id,
+                "cache": req.cache,
+                "batch_size": req.batch_size,
+                "wall_ms": round(total_ms, 3),
+                "spans": req.spans,
+                "shape": list(out.shape),
+                "dtype": "float32",
+                "image_b64": base64.b64encode(
+                    np.ascontiguousarray(out).tobytes()
+                ).decode(),
+            }),
+            "application/json",
+        )
+
+    def _route_serving(self, _body):
+        """GET /serving: the operator's one-look snapshot — queue /
+        in-flight occupancy, cache residency, and the SLO quantiles."""
+        snap = {
+            "queue_depth": len(self.queue),
+            "inflight": self._inflight,
+            "policy": {
+                "max_batch": self.policy.max_batch,
+                "max_wait_ms": self.policy.max_wait_ms,
+                "max_queue_depth": self.admission.max_depth,
+                "effective_queue_depth": self.admission.effective_depth(),
+            },
+            "cache": self.cache.snapshot(),
+            "slo_ms": {
+                phase: {
+                    "p50": self._h_latency.quantile(
+                        0.5, labels={"phase": phase}
+                    ),
+                    "p99": self._h_latency.quantile(
+                        0.99, labels={"phase": phase}
+                    ),
+                }
+                for phase in ("queued", "service", "total")
+            },
+        }
+        return 200, _json_bytes(snap), "application/json"
+
+    def health(self) -> Dict[str, Any]:
+        """/healthz callback: the full sentinel evaluation (which now
+        includes the serving ledger check) against the daemon's
+        registry."""
+        from ..telemetry.sentinel import evaluate_health
+
+        return evaluate_health(
+            metrics=self.registry.to_dict(), context="serving"
+        )
+
+    # ---------------------------------------------------- dispatcher
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self.queue.next_batch(self.policy, timeout=0.25)
+            if batch is None:
+                continue
+            self._g_depth.set(len(self.queue))
+            try:
+                self._execute(batch, kind="client")
+            except BaseException as e:  # noqa: BLE001 - daemon survives
+                import logging
+
+                logging.getLogger("image_analogies_tpu").exception(
+                    "serving dispatch error"
+                )
+                for req in batch:
+                    if not req.done.is_set():
+                        req.status = "failed"
+                        req.error = f"{type(e).__name__}: {e}"
+                        self._c_failed.inc()
+                        req.done.set()
+
+    def _execute(self, batch: List[ServeRequest],
+                 kind: str = "client") -> None:
+        """One dispatch: cache verdict -> pad to the static grain ->
+        supervised `synthesize_batch` -> demux -> settle requests."""
+        import dataclasses
+
+        from ..parallel.batch import synthesize_batch
+        from ..runtime.supervisor import SupervisorGaveUp, supervise
+
+        grain = self.policy.max_batch
+        admit_t = time.monotonic()
+        for req in batch:
+            req.span("admitted")
+            req.batch_size = len(batch)
+            self._h_latency.observe(
+                (admit_t - req.enqueue_t) * 1000.0,
+                labels={"phase": "queued"},
+            )
+        self._inflight = len(batch)
+        self._g_inflight.set(len(batch))
+        self._c_dispatches.inc(labels={"kind": kind})
+        cache_status = self.cache.lookup(batch[0].key, kind=kind)
+        span_name = "cache-hit" if cache_status == "hit" else "compiled"
+        for req in batch:
+            req.cache = cache_status
+            req.span(span_name)
+
+        frames = np.stack([r.frame for r in batch])
+        if frames.shape[0] < grain:
+            frames = np.concatenate(
+                [frames]
+                + [frames[-1:]] * (grain - frames.shape[0]), axis=0
+            )
+        b_stats = batch[0].b_stats
+        ckpt_dir = tempfile.mkdtemp(
+            prefix="dispatch-", dir=self._work_dir
+        )
+        cfg = dataclasses.replace(
+            self.cfg, save_level_artifacts=ckpt_dir
+        )
+
+        def attempt(resume_from):
+            return synthesize_batch(
+                self.a, self.ap, frames, cfg, self.mesh,
+                resume_from=resume_from,
+                frame_indices=[0] * grain,
+                _b_stats=b_stats,
+            )
+
+        try:
+            out = supervise(
+                attempt,
+                ckpt_dir=ckpt_dir,
+                tracer=None,
+                registry=self.registry,
+                max_retries=self.max_retries,
+                ladder=[],
+                backoff_s=0.05,
+                max_backoff_s=1.0,
+            )
+            out = np.asarray(out, np.float32)
+            for req in batch:
+                req.span("executed")
+            demux(batch, out[: len(batch)])
+            for req in batch:
+                if kind == "client":
+                    self._c_completed.inc()
+        except SupervisorGaveUp as e:
+            for req in batch:
+                req.status = "failed"
+                req.error = f"supervisor gave up: {e}"
+                if kind == "client":
+                    self._c_failed.inc()
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+            service_ms = (time.monotonic() - admit_t) * 1000.0
+            for req in batch:
+                self._h_latency.observe(
+                    service_ms, labels={"phase": "service"}
+                )
+                req.done.set()
+            self._inflight = 0
+            self._g_inflight.set(0)
+
+
+# ------------------------------------------------------------- payloads
+def _json_bytes(obj) -> bytes:
+    return (json.dumps(obj) + "\n").encode()
+
+
+def _decode_request(body: Optional[bytes]) -> np.ndarray:
+    """Parse a /synthesize payload into one float32 (H, W, C) frame.
+
+    Wire format: JSON {"image_b64": base64 of the raw pixel buffer,
+    "shape": [H, W, C], "dtype": "float32"|"uint8"} — raw buffers
+    rather than PNG so the daemon has zero image-codec dependencies
+    on the hot path (uint8 payloads are scaled to [0, 1]).  Raises
+    ValueError (-> HTTP 400) on any malformation."""
+    if not body:
+        raise ValueError("empty request body")
+    try:
+        manifest = json.loads(body.decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"request body is not JSON: {e}") from None
+    if not isinstance(manifest, dict):
+        raise ValueError("request body is not a JSON object")
+    shape = manifest.get("shape")
+    if (
+        not isinstance(shape, list) or len(shape) != 3
+        or not all(isinstance(d, int) and d >= 1 for d in shape)
+        or shape[2] not in (1, 3)
+    ):
+        raise ValueError(
+            f"shape {shape!r} is not [H, W, C] with C in (1, 3)"
+        )
+    dtype = manifest.get("dtype", "float32")
+    if dtype not in ("float32", "uint8"):
+        raise ValueError(f"dtype {dtype!r} not in ('float32', 'uint8')")
+    b64 = manifest.get("image_b64")
+    if not isinstance(b64, str):
+        raise ValueError("image_b64 missing")
+    try:
+        raw = base64.b64decode(b64, validate=True)
+    except Exception as e:  # noqa: BLE001 - malformed base64
+        raise ValueError(f"image_b64 does not decode: {e}") from None
+    want = shape[0] * shape[1] * shape[2] * (4 if dtype == "float32"
+                                             else 1)
+    if len(raw) != want:
+        raise ValueError(
+            f"payload is {len(raw)} bytes; shape {shape} x {dtype} "
+            f"needs {want}"
+        )
+    frame = np.frombuffer(raw, np.float32 if dtype == "float32"
+                          else np.uint8).reshape(shape)
+    if dtype == "uint8":
+        frame = frame.astype(np.float32) / 255.0
+    else:
+        frame = frame.astype(np.float32)
+    if shape[2] == 1:
+        frame = frame[..., 0]
+    return frame

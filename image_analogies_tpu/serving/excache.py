@@ -1,0 +1,306 @@
+"""Compiled-executable cache — the serving tier's accounting layer
+over the engine's jit caches (round 13 tentpole, with
+serving/queueing.py and serving/daemon.py).
+
+The engine already caches compiled executables process-wide
+(`parallel/batch._batch_prologue_fn_cached` / `_batch_level_fn_cached`
+and friends are `functools.lru_cache`s keyed on (cfg, level, mesh)),
+so a repeat-shape dispatch skips the ~140 ms prologue compile
+automatically.  What serving needs on top is the part functools cannot
+give:
+
+  - an ADMISSION-VISIBLE key: one record per (pyramid shape, config
+    fingerprint, matcher, compression mode) so the daemon can answer
+    "will this request compile or reuse?" BEFORE dispatching, label
+    the request's span `cache-hit` vs `compiled`, and expose
+    hit/miss/evict counters a scraper can watch;
+  - a WARMUP path: a manifest of expected shapes compiled at daemon
+    start, so the first paying request of each shape is a hit;
+  - honest EVICTION: `functools.lru_cache` offers no per-key eviction,
+    so capacity eviction here is EPOCH-grained — evicting one entry
+    calls `kernels.patchmatch_tile.clear_compiled_level_caches()`
+    (the mode-flip setters' invalidation hook, which drops every
+    cached level/prologue/step function across all four runners) and
+    demotes every other resident entry to cold.  The next use of a
+    demoted key is counted (and priced) as a miss.  Capacity should
+    therefore be sized so eviction is rare (default 8 resident
+    shapes); the counters make an undersized cache visible as an
+    eviction rate, not a silent recompile storm.
+
+The cache key deliberately matches the jit keys' own identity: the
+config fingerprint hashes `models.analogy._strip_noncompute(cfg)` (the
+same stripping the jit caches apply, so two configs differing only in
+`save_level_artifacts` share one executable AND one cache entry), and
+the compression mode captures the process-wide kernel knobs
+(`IA_CAND_DTYPE` / `IA_CAND_PRUNE` / packed layout) that shape traced
+graphs without living in the config.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+WARMUP_SCHEMA_VERSION = 1
+
+ExecKey = Tuple[tuple, str, str, str]
+
+
+def config_fingerprint(cfg) -> str:
+    """Stable digest of the COMPUTE-shaping config fields — the same
+    identity the jit caches key on (`_strip_noncompute` removes the
+    host-side checkpoint path), so the serving cache can never split or
+    alias entries the engine's own caches share."""
+    import dataclasses
+
+    from ..models.analogy import _strip_noncompute
+
+    return hashlib.sha1(
+        repr(dataclasses.astuple(_strip_noncompute(cfg))).encode()
+    ).hexdigest()[:12]
+
+
+def compression_mode() -> str:
+    """The process-wide kernel-compression knobs as one label: these
+    are module globals, not config fields (the `_POLISH_MODE`
+    rationale), but they shape every traced graph — a mode flip (a
+    supervisor ladder step, a `set_cand_compression` call) must change
+    the executable identity."""
+    from ..kernels.patchmatch_tile import (
+        resolve_cand_dtype,
+        resolve_packed,
+        resolve_prune,
+    )
+
+    prune = resolve_prune()
+    return "|".join((
+        resolve_cand_dtype(),
+        "full" if prune is None else f"prune{prune[0]}:{prune[1]}",
+        "packed" if resolve_packed() else "unpacked",
+    ))
+
+
+def exec_key(b_shape, cfg, batch_size: int = 1) -> ExecKey:
+    """The executable identity of one dispatch: (stacked pyramid-input
+    shape, config fingerprint, matcher, compression mode).  The
+    leading `batch_size` is part of the shape because the batch
+    runner's vmapped executables are shape-specialized over the frame
+    axis — which is why the daemon pads every dispatch to one static
+    batch grain (serving/daemon.py)."""
+    return (
+        (int(batch_size),) + tuple(int(d) for d in b_shape),
+        config_fingerprint(cfg),
+        cfg.matcher,
+        compression_mode(),
+    )
+
+
+def key_str(key: ExecKey) -> str:
+    shape, fp, matcher, comp = key
+    return f"{'x'.join(map(str, shape))}/{matcher}/{comp}/{fp}"
+
+
+class _Entry:
+    __slots__ = ("key", "warm", "hits", "compiles", "last_used_t",
+                 "compile_ms")
+
+    def __init__(self, key: ExecKey):
+        self.key = key
+        self.warm = False
+        self.hits = 0
+        self.compiles = 0
+        self.last_used_t = time.monotonic()
+        self.compile_ms: Optional[float] = None
+
+
+class ExecutableCache:
+    """LRU accounting cache over the engine's compiled executables.
+
+    `lookup(key)` returns "hit" (resident and warm) or "miss" (new, or
+    demoted to cold by an epoch eviction), admitting/evicting as
+    needed and booking `ia_serve_excache_{hits,misses,evictions}_total`
+    (hits/misses carry a {kind} label so warmup traffic never inflates
+    the client ledger the sentinel's serving check prices)."""
+
+    def __init__(self, capacity: int = 8, registry=None):
+        if capacity < 1:
+            raise ValueError(f"cache capacity must be >= 1 ({capacity})")
+        self.capacity = int(capacity)
+        self._registry = registry
+        self._entries: "OrderedDict[ExecKey, _Entry]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.evictions = 0
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from ..telemetry.metrics import get_registry
+
+        return get_registry()
+
+    def _count(self, which: str, kind: str) -> None:
+        self._reg().counter(
+            f"ia_serve_excache_{which}_total",
+            f"serving executable-cache {which} by request kind "
+            "(client vs warmup)",
+        ).inc(labels={"kind": kind})
+
+    def lookup(self, key: ExecKey, kind: str = "client") -> str:
+        """Admit `key`, return "hit" or "miss", and book the counters.
+
+        A miss either admits a new entry (evicting the LRU entry at
+        capacity — an EPOCH eviction, see the module docstring) or
+        re-warms a demoted one.  The caller dispatches either way; the
+        engine's jit caches do the actual reuse/compile."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                entry.last_used_t = time.monotonic()
+                if entry.warm:
+                    entry.hits += 1
+                    self._count("hits", kind)
+                    return "hit"
+                # Demoted by an epoch eviction: the engine caches were
+                # cleared, so this use recompiles — an honest miss.
+                entry.warm = True
+                entry.compiles += 1
+                self._count("misses", kind)
+                return "miss"
+            entry = _Entry(key)
+            entry.warm = True
+            entry.compiles = 1
+            self._entries[key] = entry
+            if len(self._entries) > self.capacity:
+                self._evict_lru()
+            self._count("misses", kind)
+            return "miss"
+
+    def _evict_lru(self) -> None:
+        """Capacity eviction (caller holds the lock): drop the LRU
+        entry, clear the engine's compiled-function caches, and demote
+        every remaining entry to cold — selective per-key eviction is
+        impossible over `functools.lru_cache`, so eviction is honest
+        at epoch granularity rather than fictitious at key
+        granularity."""
+        evicted_key, _ = self._entries.popitem(last=False)
+        self.evictions += 1
+        self._reg().counter(
+            "ia_serve_excache_evictions_total",
+            "serving executable-cache capacity evictions (epoch-"
+            "grained: one eviction clears the engine's jit caches and "
+            "demotes every resident entry to cold)",
+        ).inc()
+        from ..kernels.patchmatch_tile import clear_compiled_level_caches
+
+        clear_compiled_level_caches()
+        for entry in self._entries.values():
+            entry.warm = False
+        import logging
+
+        logging.getLogger("image_analogies_tpu").info(
+            "serving excache: evicted %s (epoch eviction: %d resident "
+            "entries demoted to cold)",
+            key_str(evicted_key), len(self._entries),
+        )
+
+    def note_compile_ms(self, key: ExecKey, wall_ms: float) -> None:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                entry.compile_ms = round(float(wall_ms), 3)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "resident": len(self._entries),
+                "evictions": self.evictions,
+                "entries": [
+                    {
+                        "key": key_str(e.key),
+                        "warm": e.warm,
+                        "hits": e.hits,
+                        "compiles": e.compiles,
+                        "compile_ms": e.compile_ms,
+                    }
+                    for e in self._entries.values()
+                ],
+            }
+
+
+# ---------------------------------------------------------------- warmup
+def load_warmup_manifest(path: str) -> List[Dict[str, Any]]:
+    """Parse a warmup manifest: {"schema_version": 1, "kind":
+    "serve_warmup", "entries": [{"height": H, "width": W,
+    "channels": C}, ...]} — the shapes the operator expects traffic
+    at, compiled at daemon start so the first client request of each
+    shape is a hit.  Malformed manifests raise ValueError at startup
+    (a typo'd manifest must fail the daemon's launch, not silently
+    leave it cold)."""
+    with open(path) as f:
+        manifest = json.load(f)
+    if not isinstance(manifest, dict) or manifest.get(
+        "schema_version"
+    ) != WARMUP_SCHEMA_VERSION:
+        raise ValueError(
+            f"warmup manifest {path}: schema_version "
+            f"{manifest.get('schema_version') if isinstance(manifest, dict) else None!r}"
+            f" != {WARMUP_SCHEMA_VERSION}"
+        )
+    if manifest.get("kind") != "serve_warmup":
+        raise ValueError(
+            f"warmup manifest {path}: kind "
+            f"{manifest.get('kind')!r} != 'serve_warmup'"
+        )
+    entries = manifest.get("entries")
+    if not isinstance(entries, list) or not entries:
+        raise ValueError(f"warmup manifest {path}: empty 'entries'")
+    out = []
+    for i, e in enumerate(entries):
+        try:
+            h, w = int(e["height"]), int(e["width"])
+            c = int(e.get("channels", 3))
+        except (TypeError, KeyError, ValueError):
+            raise ValueError(
+                f"warmup manifest {path}: entries[{i}] needs integer "
+                "height/width (+ optional channels)"
+            ) from None
+        if h < 8 or w < 8 or c not in (1, 3):
+            raise ValueError(
+                f"warmup manifest {path}: entries[{i}] shape "
+                f"{h}x{w}x{c} out of range (min 8x8, channels 1|3)"
+            )
+        out.append({"height": h, "width": w, "channels": c})
+    return out
+
+
+def run_warmup(entries: List[Dict[str, Any]],
+               dispatch_fn: Callable[[tuple], Any],
+               cache: "ExecutableCache", key_fn) -> List[Dict[str, Any]]:
+    """Drive each manifest entry's shape through the daemon's dispatch
+    path (a synthetic zero image; `dispatch_fn` performs the cache
+    lookup itself, exactly as a client dispatch would, with
+    kind="warmup" so warmup traffic stays out of the client ledger).
+    Entries are deduplicated by executable key so a manifest that
+    repeats a shape never books a warmup "hit" (the sentinel's
+    `cache hits <= requests` ledger is a claim about CLIENT traffic).
+    Returns per-entry {key, wall_ms} records."""
+    done = set()
+    report = []
+    for e in entries:
+        shape = (e["height"], e["width"], e["channels"])
+        key = key_fn(shape)
+        if key in done:
+            continue
+        done.add(key)
+        t0 = time.perf_counter()
+        dispatch_fn(shape)
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        cache.note_compile_ms(key, wall_ms)
+        report.append({"key": key_str(key), "wall_ms": round(wall_ms, 1)})
+    return report

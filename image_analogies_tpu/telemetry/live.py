@@ -126,18 +126,38 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: D102
         pass
 
-    def _send(self, code: int, body: bytes, ctype: str) -> None:
+    def _send(self, code: int, body: bytes, ctype: str,
+              headers: Optional[Dict[str, str]] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
+
+    def _dispatch_route(self, method: str, path: str,
+                        body: Optional[bytes]) -> bool:
+        """Injected-route dispatch (round 13: the serving daemon mounts
+        its endpoints on this same server).  A route handler returns
+        (code, body_bytes, ctype[, headers]); True = handled."""
+        live = self.server.live  # type: ignore[attr-defined]
+        handler = live.routes.get((method, path))
+        if handler is None:
+            return False
+        out = handler(body)
+        code, payload, ctype = out[0], out[1], out[2]
+        headers = out[3] if len(out) > 3 else None
+        self._send(code, payload, ctype, headers)
+        return True
 
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         live = self.server.live  # type: ignore[attr-defined]
         try:
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
-            if path == "/metrics":
+            if self._dispatch_route("GET", path, None):
+                pass
+            elif path == "/metrics":
                 self._send(
                     200,
                     live.registry.to_prometheus().encode(),
@@ -167,6 +187,22 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:  # noqa: BLE001 - client went away
                 pass
 
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        try:
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            if not self._dispatch_route("POST", path, body):
+                self._send(404, b"not found\n", "text/plain")
+        except Exception as e:  # noqa: BLE001 - never kill the server
+            try:
+                self._send(
+                    500, f"live telemetry error: {e}\n".encode(),
+                    "text/plain",
+                )
+            except Exception:  # noqa: BLE001 - client went away
+                pass
+
 
 class LiveTelemetryServer:
     """The exporter: bind, serve on a daemon thread, announce, stop.
@@ -177,11 +213,23 @@ class LiveTelemetryServer:
     ephemeral endpoint without parsing stdout."""
 
     def __init__(self, tracer, registry, port: int = 0,
-                 host: str = "127.0.0.1", flight=None):
+                 host: str = "127.0.0.1", flight=None,
+                 health_cb=None, routes=None):
+        """`health_cb` / `routes` (round 13): the serving daemon reuses
+        this server rather than growing a second HTTP stack.
+        `health_cb() -> health dict` replaces the default sentinel
+        evaluation for /healthz (the 503-on-violated and
+        flush-on-violated behaviors still apply to whatever it
+        returns); `routes` maps (method, path) -> handler(body) ->
+        (code, body_bytes, ctype[, headers]) and takes precedence over
+        the built-in endpoints.  Both default to the per-run behavior
+        every existing caller gets."""
         self.tracer = tracer
         self.registry = registry
         self.flight = flight
         self.host = host
+        self._health_cb = health_cb
+        self.routes = dict(routes or {})
         self._requested_port = int(port)
         self.port: Optional[int] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -190,12 +238,16 @@ class LiveTelemetryServer:
     def evaluate_live_health(self) -> Dict[str, Any]:
         """The sentinel's registry-joinable checks against the live
         registry (module docstring: span-tree completeness is
-        end-of-run-only, so spans stay out of the live verdict)."""
-        from .sentinel import evaluate_health
+        end-of-run-only, so spans stay out of the live verdict) — or
+        the injected health_cb's verdict."""
+        if self._health_cb is not None:
+            health = self._health_cb()
+        else:
+            from .sentinel import evaluate_health
 
-        health = evaluate_health(
-            metrics=self.registry.to_dict(), context="live"
-        )
+            health = evaluate_health(
+                metrics=self.registry.to_dict(), context="live"
+            )
         if self.flight is not None and health["verdict"] == "violated":
             self.flight.flush("violation")
         return health
@@ -241,7 +293,8 @@ class LiveTelemetryServer:
                 "host": self.host,
                 "port": self.port,
                 "pid": os.getpid(),
-                "endpoints": ["/metrics", "/healthz", "/progress"],
+                "endpoints": ["/metrics", "/healthz", "/progress"]
+                + sorted({p for _m, p in self.routes}),
             },
         )
 

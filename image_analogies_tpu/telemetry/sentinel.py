@@ -614,7 +614,26 @@ def check_recovery(metrics: Optional[dict]) -> Dict:
         if dict(key).get("action") in ("raise", "fail")
     )
     problems = []
-    if attempts - n_retries not in (0, 1):
+    invocations = sum(
+        _counter_values(
+            metrics, "ia_supervisor_invocations_total"
+        ).values()
+    )
+    if invocations:
+        # Round 13: a serving daemon makes one supervise() call per
+        # dispatch, so `attempts - failures` counts the HEALED calls —
+        # anywhere from 0 (every call gave up) to the invocation count
+        # (every call healed or succeeded outright).
+        observed["invocations"] = invocations
+        if not 0 <= attempts - n_retries <= invocations:
+            problems.append(
+                f"attempts ({attempts}) - failures ({n_retries}) is "
+                f"outside [0, invocations ({invocations})] — attempt "
+                "accounting lost"
+            )
+    elif attempts - n_retries not in (0, 1):
+        # Legacy single-call shape (a pre-round-13 metrics.json with
+        # no invocations counter): exactly one supervise() call.
         problems.append(
             f"attempts ({attempts}) - failures ({n_retries}) is "
             "neither 0 (give-up) nor 1 (healed) — attempt accounting "
@@ -648,6 +667,125 @@ def check_recovery(metrics: Optional[dict]) -> Dict:
         "plan" + ("" if not problems else " — " + "; ".join(problems))
         + ("" if not degr or problems else " — run healed only by "
            "degrading; output mode differs from the requested one"),
+    )
+
+
+def check_serving(metrics: Optional[dict]) -> Dict:
+    """Serving-daemon ledger (round 13, serving/): every request the
+    daemon accepted must be accounted for, and the executable cache's
+    claims must be arithmetically possible.
+
+    Invariants, enforced only when a daemon ran
+    (`ia_serve_requests_total` present):
+
+      - requests == admitted + shed: an arriving request either
+        entered the queue or was shed with a 429 — violated otherwise
+        (the increment order pins this: the request counter books
+        first, so a scrape can never see admitted+shed ahead of
+        requests).
+      - admitted == completed + failed + still-pending, with pending
+        >= 0 and, when the queue-depth/in-flight gauges are exposed,
+        pending equal to their sum.  A NEGATIVE pending is violated
+        (responses the daemon never admitted); a gauge mismatch on a
+        mid-flight scrape grades degraded, not violated (the gauges
+        and counters update non-atomically; at quiescence they must
+        agree).
+      - client cache hits <= client requests (a hit is booked once
+        per dispatch, a dispatch serves >= 1 request, and warmup
+        traffic is labeled out) — more hits than requests is a
+        fabricated cache claim, violated.
+      - cache hits + misses == dispatches (every dispatch consulted
+        the cache exactly once) — violated otherwise."""
+    requests = sum(
+        _counter_values(metrics, "ia_serve_requests_total").values()
+    )
+    admitted = sum(
+        _counter_values(metrics, "ia_serve_admitted_total").values()
+    )
+    shed = sum(_counter_values(metrics, "ia_serve_shed_total").values())
+    completed = sum(
+        _counter_values(metrics, "ia_serve_completed_total").values()
+    )
+    failed = sum(
+        _counter_values(metrics, "ia_serve_failed_total").values()
+    )
+    dispatches = sum(
+        _counter_values(metrics, "ia_serve_dispatches_total").values()
+    )
+    hits = _counter_values(metrics, "ia_serve_excache_hits_total")
+    misses = _counter_values(metrics, "ia_serve_excache_misses_total")
+    if not requests and not admitted and not shed and not dispatches:
+        return _check(
+            "serving", "skipped",
+            detail="no serving daemon in this session",
+        )
+    client_hits = sum(
+        n for key, n in hits.items()
+        if dict(key).get("kind", "client") == "client"
+    )
+    n_hits = sum(hits.values())
+    n_misses = sum(misses.values())
+    pending = admitted - completed - failed
+    gauges = (metrics or {}).get("ia_serve_queue_depth", {}).get(
+        "values", {}
+    )
+    inflight = (metrics or {}).get("ia_serve_inflight", {}).get(
+        "values", {}
+    )
+    gauge_backlog = None
+    if gauges or inflight:
+        gauge_backlog = sum(
+            v for v in gauges.values() if _is_num(v)
+        ) + sum(v for v in inflight.values() if _is_num(v))
+    observed = {
+        "requests": requests, "admitted": admitted, "shed": shed,
+        "completed": completed, "failed": failed, "pending": pending,
+        "gauge_backlog": gauge_backlog, "dispatches": dispatches,
+        "cache_hits": n_hits, "cache_hits_client": client_hits,
+        "cache_misses": n_misses,
+    }
+    problems = []
+    degraded = []
+    if requests != admitted + shed:
+        problems.append(
+            f"requests ({requests}) != admitted ({admitted}) + shed "
+            f"({shed}) — a request entered neither the queue nor the "
+            "429 path"
+        )
+    if pending < 0:
+        problems.append(
+            f"completed ({completed}) + failed ({failed}) exceed "
+            f"admitted ({admitted}) — responses were never admitted"
+        )
+    elif gauge_backlog is not None and pending != round(gauge_backlog):
+        degraded.append(
+            f"pending ({pending}) != queue+inflight gauges "
+            f"({gauge_backlog}) — mid-flight scrape, or gauge drift "
+            "if the daemon is quiescent"
+        )
+    if client_hits > requests:
+        problems.append(
+            f"client cache hits ({client_hits}) exceed requests "
+            f"({requests}) — fabricated cache claim"
+        )
+    if n_hits + n_misses != dispatches:
+        problems.append(
+            f"cache hits ({n_hits}) + misses ({n_misses}) != "
+            f"dispatches ({dispatches}) — a dispatch skipped the "
+            "cache, or a lookup never dispatched"
+        )
+    status = (
+        "violated" if problems else ("degraded" if degraded else "ok")
+    )
+    return _check(
+        "serving", status,
+        expected="requests == admitted + shed; admitted == completed "
+        "+ failed + backlog (backlog >= 0, matching the gauges); "
+        "client cache hits <= requests; hits + misses == dispatches",
+        observed=observed,
+        detail="serving admission/cache ledger"
+        + ("" if not (problems or degraded)
+           else " — " + "; ".join(problems + degraded)),
     )
 
 
@@ -706,6 +844,7 @@ def evaluate_health(
         check_telemetry_overhead(metrics),
         check_straggler_skew(metrics),
         check_recovery(metrics),
+        check_serving(metrics),
     ]
     if bench_record is not None:
         checks.append(check_instrument_drift(bench_record))

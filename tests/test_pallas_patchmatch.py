@@ -735,6 +735,7 @@ class TestBandedStreaming:
 
 
 class TestEndToEnd:
+    @pytest.mark.slow  # r13 tier-1 budget (round-8 rule)
     def test_rgb_mode_kernel_path(self, rng):
         """color_mode='rgb': six fine channels through the kernel."""
         from image_analogies_tpu import SynthConfig, create_image_analogy

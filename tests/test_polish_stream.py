@@ -122,6 +122,7 @@ class TestStreamDist:
         )
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
+    @pytest.mark.slow  # r13 tier-1 budget (round-8 rule)
     def test_sweeps_bit_identical_under_gather_hook(self, rng):
         """patchmatch_sweeps with the streamed gather: same PRNG, same
         candidates, same accepts — field and dist bitwise equal."""

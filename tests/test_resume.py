@@ -18,6 +18,8 @@ def _inputs(rng, n=32):
     return a, ap, b
 
 
+@pytest.mark.slow  # r13 tier-1 budget: the batch-runner resume
+# roundtrip below keeps resume mechanics in tier-1 (round-8 rule)
 def test_resume_reproduces_full_run(tmp_path, rng):
     a, ap, b = _inputs(rng)
     ckpt = str(tmp_path / "ckpt")

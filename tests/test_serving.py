@@ -1,0 +1,972 @@
+"""Serving-tier tests (round 13): the compiled-executable cache
+(serving/excache.py), the batching/admission policy
+(serving/queueing.py), the daemon itself (serving/daemon.py), the
+sentinel's serving-ledger check, the SERVE_r13.json validator
+(tools/check_serve.py), and the committed artifact.
+
+The acceptance-critical paths run against ONE in-process daemon with
+the real engine (module fixture `daemon_scenario`): cold request
+compiles, the same-shape repeat is a cache hit, an injected fault maps
+a supervisor give-up to HTTP 500 with the daemon surviving, and an
+overload burst sheds 429s with the admission ledger balanced.  The
+subprocess CLI lifecycle (`ia-synth serve` + live.json rendezvous +
+SIGTERM flight dump) and a fresh serve_load sweep are slow-marked
+(each costs a private interpreter + compile)."""
+
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from check_serve import main as check_serve_main  # noqa: E402
+from check_serve import validate_serve  # noqa: E402
+
+from image_analogies_tpu.config import SynthConfig  # noqa: E402
+from image_analogies_tpu.serving.daemon import (  # noqa: E402
+    SynthDaemon,
+    _decode_request,
+    _luma_bucket,
+)
+from image_analogies_tpu.serving.excache import (  # noqa: E402
+    ExecutableCache,
+    compression_mode,
+    config_fingerprint,
+    exec_key,
+    key_str,
+    load_warmup_manifest,
+    run_warmup,
+)
+from image_analogies_tpu.serving.queueing import (  # noqa: E402
+    AdmissionController,
+    BatchingPolicy,
+    RequestQueue,
+    ServeRequest,
+    coalesce,
+    demux,
+    head_deadline,
+)
+from image_analogies_tpu.telemetry.metrics import (  # noqa: E402
+    MetricsRegistry,
+    set_registry,
+)
+from image_analogies_tpu.telemetry.sentinel import (  # noqa: E402
+    IMBALANCE_RATIO_MAX,
+    check_serving,
+)
+
+_SERVE_CFG = dict(
+    levels=2, matcher="patchmatch", pallas_mode="off",
+    em_iters=1, pm_iters=2,
+)
+
+
+def _body(frame: np.ndarray) -> bytes:
+    return json.dumps({
+        "image_b64": base64.b64encode(
+            np.ascontiguousarray(frame.astype(np.float32)).tobytes()
+        ).decode(),
+        "shape": list(frame.shape),
+        "dtype": "float32",
+    }).encode()
+
+
+def _post(url: str, body: bytes, timeout: float = 300.0):
+    """(status, parsed-json, headers) for POST /synthesize."""
+    req = urllib.request.Request(
+        url + "/synthesize", data=body, method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(
+                resp.headers
+            )
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(url: str, timeout: float = 30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+# ------------------------------------------------- executable cache
+class TestExecKey:
+    def test_fingerprint_ignores_noncompute_fields(self, tmp_path):
+        import dataclasses
+
+        cfg = SynthConfig(**_SERVE_CFG)
+        with_ckpt = dataclasses.replace(
+            cfg, save_level_artifacts=str(tmp_path)
+        )
+        assert config_fingerprint(cfg) == config_fingerprint(with_ckpt)
+
+    def test_fingerprint_tracks_compute_fields(self):
+        import dataclasses
+
+        cfg = SynthConfig(**_SERVE_CFG)
+        assert config_fingerprint(cfg) != config_fingerprint(
+            dataclasses.replace(cfg, em_iters=cfg.em_iters + 1)
+        )
+
+    def test_key_carries_batch_shape_matcher_compression(self):
+        cfg = SynthConfig(**_SERVE_CFG)
+        k1 = exec_key((32, 32, 3), cfg, batch_size=2)
+        assert k1[0] == (2, 32, 32, 3)
+        assert k1[2] == cfg.matcher
+        # Compression mode is the three process-wide kernel knobs.
+        assert len(k1[3].split("|")) == 3
+        assert k1[3] == compression_mode()
+        assert exec_key((32, 32, 3), cfg, batch_size=4) != k1
+        assert exec_key((64, 64, 3), cfg, batch_size=2) != k1
+        assert "32" in key_str(k1) and cfg.matcher in key_str(k1)
+
+
+class TestExecutableCache:
+    def _hits(self, reg, kind="client"):
+        return reg.to_dict().get(
+            "ia_serve_excache_hits_total", {}
+        ).get("values", {}).get('{kind="%s"}' % kind, 0)
+
+    def _misses(self, reg, kind="client"):
+        return reg.to_dict().get(
+            "ia_serve_excache_misses_total", {}
+        ).get("values", {}).get('{kind="%s"}' % kind, 0)
+
+    def test_miss_then_hit_books_counters(self):
+        reg = MetricsRegistry()
+        cache = ExecutableCache(capacity=2, registry=reg)
+        key = ((1, 32, 32, 3), "fp", "patchmatch", "f32|full|unpacked")
+        assert cache.lookup(key) == "miss"
+        assert cache.lookup(key) == "hit"
+        assert cache.lookup(key) == "hit"
+        assert self._misses(reg) == 1 and self._hits(reg) == 2
+        snap = cache.snapshot()
+        assert snap["resident"] == 1 and snap["evictions"] == 0
+        (entry,) = snap["entries"]
+        assert entry["warm"] and entry["hits"] == 2
+        assert entry["compiles"] == 1
+
+    def test_warmup_kind_labels_stay_separate(self):
+        reg = MetricsRegistry()
+        cache = ExecutableCache(capacity=2, registry=reg)
+        key = ((1, 16, 16, 3), "fp", "patchmatch", "m")
+        cache.lookup(key, kind="warmup")
+        cache.lookup(key, kind="client")
+        assert self._misses(reg, "warmup") == 1
+        assert self._hits(reg, "client") == 1
+        assert self._hits(reg, "warmup") == 0
+
+    def test_epoch_eviction_demotes_every_resident(self, monkeypatch):
+        # Patch out the real engine-cache clear: the unit test asserts
+        # the ACCOUNTING epoch semantics without dropping the compiled
+        # functions every other test in the suite shares.
+        import image_analogies_tpu.kernels.patchmatch_tile as pt
+
+        cleared = []
+        monkeypatch.setattr(
+            pt, "clear_compiled_level_caches",
+            lambda: cleared.append(1),
+        )
+        reg = MetricsRegistry()
+        cache = ExecutableCache(capacity=2, registry=reg)
+        k = [((1, s, s, 3), "fp", "patchmatch", "m") for s in
+             (16, 32, 64)]
+        cache.lookup(k[0])
+        cache.lookup(k[1])
+        cache.lookup(k[2])  # evicts k[0] (LRU), demotes k[1]
+        assert cleared == [1]
+        assert cache.evictions == 1
+        evictions = reg.to_dict()[
+            "ia_serve_excache_evictions_total"
+        ]["values"]["total"]
+        assert evictions == 1
+        # The demoted survivor re-warms as an HONEST miss.
+        assert cache.lookup(k[1]) == "miss"
+        assert cache.lookup(k[1]) == "hit"
+        # The evicted key was dropped entirely: re-admit, miss.
+        assert cache.lookup(k[0]) == "miss"
+
+    def test_capacity_validated(self):
+        with pytest.raises(ValueError):
+            ExecutableCache(capacity=0)
+
+
+class TestWarmupManifest:
+    def _write(self, tmp_path, manifest):
+        p = tmp_path / "warm.json"
+        p.write_text(json.dumps(manifest))
+        return str(p)
+
+    def test_valid_manifest_loads(self, tmp_path):
+        path = self._write(tmp_path, {
+            "schema_version": 1, "kind": "serve_warmup",
+            "entries": [{"height": 64, "width": 48},
+                        {"height": 32, "width": 32, "channels": 1}],
+        })
+        entries = load_warmup_manifest(path)
+        assert entries == [
+            {"height": 64, "width": 48, "channels": 3},
+            {"height": 32, "width": 32, "channels": 1},
+        ]
+
+    @pytest.mark.parametrize("mutation", [
+        {"schema_version": 2},
+        {"kind": "warmup"},
+        {"entries": []},
+        {"entries": [{"height": 64}]},
+        {"entries": [{"height": 4, "width": 64}]},
+        {"entries": [{"height": 64, "width": 64, "channels": 2}]},
+    ])
+    def test_malformed_manifest_raises(self, tmp_path, mutation):
+        manifest = {
+            "schema_version": 1, "kind": "serve_warmup",
+            "entries": [{"height": 64, "width": 64}],
+        }
+        manifest.update(mutation)
+        with pytest.raises(ValueError):
+            load_warmup_manifest(self._write(tmp_path, manifest))
+
+    def test_run_warmup_dedups_by_key_and_records_wall(self):
+        cache = ExecutableCache(capacity=4, registry=MetricsRegistry())
+        dispatched = []
+
+        def dispatch(shape):
+            key = (shape, "fp", "m", "c")
+            cache.lookup(key, kind="warmup")
+            dispatched.append(shape)
+
+        entries = [
+            {"height": 32, "width": 32, "channels": 3},
+            {"height": 32, "width": 32, "channels": 3},  # duplicate
+            {"height": 16, "width": 16, "channels": 3},
+        ]
+        report = run_warmup(
+            entries, dispatch, cache,
+            key_fn=lambda shape: (shape, "fp", "m", "c"),
+        )
+        assert dispatched == [(32, 32, 3), (16, 16, 3)]
+        assert len(report) == 2
+        assert all(r["wall_ms"] >= 0 for r in report)
+        snap = {e["key"]: e for e in cache.snapshot()["entries"]}
+        assert all(e["compile_ms"] is not None for e in snap.values())
+
+
+# ------------------------------------------- batching + admission
+def _req(compat="k", age_ms=0.0):
+    r = ServeRequest(frame=None, key=("k",), compat=compat,
+                     b_stats=None)
+    r.enqueue_t = time.monotonic() - age_ms / 1000.0
+    return r
+
+
+class TestBatchingPolicy:
+    def test_policy_validated(self):
+        with pytest.raises(ValueError):
+            BatchingPolicy(max_batch=0)
+        with pytest.raises(ValueError):
+            BatchingPolicy(max_wait_ms=-1.0)
+
+    def test_young_partial_batch_waits(self):
+        policy = BatchingPolicy(max_batch=2, max_wait_ms=50.0)
+        assert coalesce([_req(age_ms=1)], time.monotonic(),
+                        policy) is None
+
+    def test_full_batch_flushes_immediately(self):
+        policy = BatchingPolicy(max_batch=2, max_wait_ms=1e9)
+        batch = coalesce([_req(), _req()], time.monotonic(), policy)
+        assert batch is not None and len(batch) == 2
+
+    def test_aged_head_flushes_partial(self):
+        policy = BatchingPolicy(max_batch=4, max_wait_ms=50.0)
+        batch = coalesce([_req(age_ms=60)], time.monotonic(), policy)
+        assert batch is not None and len(batch) == 1
+
+    def test_incompatible_requests_stay_behind(self):
+        policy = BatchingPolicy(max_batch=3, max_wait_ms=50.0)
+        a1, b1, a2 = _req("a", 60), _req("b", 55), _req("a", 50)
+        batch = coalesce([a1, b1, a2], time.monotonic(), policy)
+        assert batch == [a1, a2]  # compat-matched, FIFO, b skipped
+
+    def test_head_deadline_tracks_head(self):
+        policy = BatchingPolicy(max_batch=4, max_wait_ms=50.0)
+        assert head_deadline([], policy) is None
+        head = _req(age_ms=10)
+        dl = head_deadline([head, _req()], policy)
+        assert dl == pytest.approx(head.enqueue_t + 0.05)
+
+
+class TestRequestQueue:
+    def test_next_batch_pops_compat_leaves_rest(self):
+        policy = BatchingPolicy(max_batch=2, max_wait_ms=10.0)
+        q = RequestQueue()
+        a1, a2, b1 = _req("a", 50), _req("a", 40), _req("b", 30)
+        for r in (a1, a2, b1):
+            q.put(r)
+        assert q.next_batch(policy, timeout=1.0) == [a1, a2]
+        assert len(q) == 1
+        assert q.next_batch(policy, timeout=1.0) == [b1]
+
+    def test_timeout_returns_none(self):
+        q = RequestQueue()
+        t0 = time.monotonic()
+        assert q.next_batch(
+            BatchingPolicy(), timeout=0.05
+        ) is None
+        assert time.monotonic() - t0 < 2.0
+
+    def test_drain_empties(self):
+        q = RequestQueue()
+        q.put(_req())
+        q.put(_req())
+        assert len(q.drain()) == 2 and len(q) == 0
+
+
+class TestAdmissionController:
+    def test_admits_below_limit_sheds_at_limit(self):
+        adm = AdmissionController(
+            max_depth=4, registry=MetricsRegistry()
+        )
+        assert adm.admit(3, 0) == (True, None)
+        ok, retry = adm.admit(3, 1)  # in-flight counts as backlog
+        assert not ok and 1.0 <= retry <= 60.0
+        ok, _ = adm.admit(0, 4)
+        assert not ok
+
+    def test_retry_after_clamped(self):
+        reg = MetricsRegistry()
+        adm = AdmissionController(max_depth=4, registry=reg)
+        # No latency observed yet: floor clamp.
+        assert adm.retry_after(100) == 1.0
+        h = reg.histogram(
+            "ia_serve_request_ms",
+            "serving request latency by lifecycle phase (ms)",
+        )
+        for _ in range(8):
+            h.observe(2000.0, labels={"phase": "service"})
+        assert adm.retry_after(1000) == 60.0  # ceiling clamp
+        assert adm.retry_after(1) >= 1.0
+
+    def test_degraded_backend_halves_depth(self):
+        reg = MetricsRegistry()
+        adm = AdmissionController(max_depth=8, registry=reg)
+        assert adm.effective_depth() == 8
+        reg.gauge(
+            "ia_shard_imbalance_ratio", "straggler gauge"
+        ).set(IMBALANCE_RATIO_MAX * 2)
+        assert adm.backend_degraded()
+        assert adm.effective_depth() == 4
+        ok, _ = adm.admit(4, 0)
+        assert not ok
+
+    def test_degradation_counter_also_degrades(self):
+        reg = MetricsRegistry()
+        adm = AdmissionController(max_depth=8, registry=reg)
+        reg.counter(
+            "ia_degradations_total", "ladder bookings"
+        ).inc(labels={"action": "pallas_off"})
+        assert adm.backend_degraded()
+
+    def test_max_depth_validated(self):
+        with pytest.raises(ValueError):
+            AdmissionController(max_depth=0)
+
+
+class TestDemux:
+    def test_positional_fanout(self):
+        batch = [_req("a"), _req("a"), _req("a")]
+        stacked = np.arange(4 * 2 * 2, dtype=np.float32).reshape(
+            4, 2, 2
+        )  # includes one padding row
+        demux(batch, stacked[:3])
+        for i, r in enumerate(batch):
+            assert np.array_equal(r.result, stacked[i])
+            assert r.status == "ok"
+            assert r.spans[-1]["name"] == "demuxed"
+
+    def test_short_stack_raises(self):
+        with pytest.raises(ValueError):
+            demux([_req(), _req()], np.zeros((1, 2, 2)))
+
+
+# ------------------------------------------- in-memory batch ingest
+class TestIngestFrames:
+    """Round-13 satellite: `parallel/batch.ingest_frames` — the
+    daemon's tempfile-free front door, same majority-shape/strict
+    semantics as `ingest_frame_dir`."""
+
+    def _ingest(self, *a, **kw):
+        from image_analogies_tpu.parallel.batch import ingest_frames
+
+        return ingest_frames(*a, **kw)
+
+    def test_sequence_of_arrays(self):
+        rng = np.random.default_rng(0)
+        frames, labels, failures = self._ingest(
+            [rng.random((8, 8, 3)), rng.random((8, 8, 3))]
+        )
+        assert frames.shape == (2, 8, 8, 3)
+        assert frames.dtype == np.float32
+        assert labels == ["frames[0]", "frames[1]"]
+        assert failures == []
+
+    def test_stacked_ndarray_and_single_frame(self):
+        rng = np.random.default_rng(1)
+        frames, labels, _ = self._ingest(
+            rng.random((3, 8, 8, 3)).astype(np.float32)
+        )
+        assert frames.shape == (3, 8, 8, 3)
+        single, labels, _ = self._ingest(
+            rng.random((8, 8, 3)).astype(np.float32)
+        )
+        assert single.shape == (1, 8, 8, 3)
+
+    def test_majority_shape_wins_minority_recorded(self):
+        rng = np.random.default_rng(2)
+        frames, labels, failures = self._ingest([
+            rng.random((8, 8, 3)), rng.random((6, 6, 3)),
+            rng.random((8, 8, 3)),
+        ])
+        assert frames.shape == (2, 8, 8, 3)
+        assert labels == ["frames[0]", "frames[2]"]
+        assert [f["path"] for f in failures] == ["frames[1]"]
+
+    def test_bad_channels_recorded_and_strict_raises(self):
+        rng = np.random.default_rng(3)
+        good = rng.random((8, 8, 3))
+        bad = rng.random((8, 8, 2))
+        frames, _, failures = self._ingest([good, bad])
+        assert frames.shape == (1, 8, 8, 3)
+        assert [f["path"] for f in failures] == ["frames[1]"]
+        with pytest.raises(RuntimeError, match="frames\\[1\\]"):
+            self._ingest([good, bad], strict=True)
+
+    def test_nothing_usable_raises(self):
+        with pytest.raises(RuntimeError, match="no usable"):
+            self._ingest([np.zeros((8, 8, 2))])
+
+    def test_frame_indices_length_validated(self):
+        from image_analogies_tpu.config import SynthConfig
+        from image_analogies_tpu.parallel.batch import synthesize_batch
+
+        rng = np.random.default_rng(4)
+        a, ap = rng.random((16, 16, 3)), rng.random((16, 16, 3))
+        frames = rng.random((2, 16, 16, 3)).astype(np.float32)
+        with pytest.raises(ValueError, match="frame_indices"):
+            synthesize_batch(
+                a, ap, frames, SynthConfig(**_SERVE_CFG), None,
+                frame_indices=[0],
+            )
+
+
+# ---------------------------------------------------- wire format
+class TestDecodeRequest:
+    def test_float32_roundtrip(self):
+        frame = np.random.default_rng(0).random(
+            (8, 6, 3)
+        ).astype(np.float32)
+        out = _decode_request(_body(frame))
+        assert out.dtype == np.float32
+        assert np.array_equal(out, frame)
+
+    def test_uint8_scaled(self):
+        frame = np.arange(8 * 6 * 3, dtype=np.uint8).reshape(8, 6, 3)
+        body = json.dumps({
+            "image_b64": base64.b64encode(frame.tobytes()).decode(),
+            "shape": [8, 6, 3], "dtype": "uint8",
+        }).encode()
+        out = _decode_request(body)
+        assert out.dtype == np.float32
+        assert out.max() <= 1.0
+        assert np.allclose(out, frame.astype(np.float32) / 255.0)
+
+    def test_single_channel_squeezes(self):
+        frame = np.zeros((8, 6, 1), np.float32)
+        body = json.dumps({
+            "image_b64": base64.b64encode(frame.tobytes()).decode(),
+            "shape": [8, 6, 1], "dtype": "float32",
+        }).encode()
+        assert _decode_request(body).shape == (8, 6)
+
+    @pytest.mark.parametrize("body", [
+        None,
+        b"",
+        b"not json",
+        b'["not", "an", "object"]',
+        json.dumps({"image_b64": "AA==", "shape": [8, 6],
+                    "dtype": "float32"}).encode(),
+        json.dumps({"image_b64": "AA==", "shape": [8, 6, 2],
+                    "dtype": "float32"}).encode(),
+        json.dumps({"image_b64": "AA==", "shape": [8, 6, 3],
+                    "dtype": "float64"}).encode(),
+        json.dumps({"shape": [8, 6, 3],
+                    "dtype": "float32"}).encode(),
+        json.dumps({"image_b64": "!!notb64!!", "shape": [8, 6, 3],
+                    "dtype": "float32"}).encode(),
+        json.dumps({"image_b64": "AA==", "shape": [8, 6, 3],
+                    "dtype": "float32"}).encode(),  # wrong byte count
+    ])
+    def test_malformed_payloads_raise(self, body):
+        with pytest.raises(ValueError):
+            _decode_request(body)
+
+    def test_luma_bucket_quantizes_to_centers(self):
+        frame = np.full((8, 8, 3), 0.5, np.float32)
+        mu, sigma = _luma_bucket(frame)
+        assert mu == (np.floor(0.5 * 32) + 0.5) / 32
+        assert sigma == 0.5 / 32  # zero std -> first bucket's center
+
+
+# ------------------------------------------- sentinel serving check
+class TestServingSentinelCheck:
+    def _metrics(self, requests=0, admitted=0, shed=0, completed=0,
+                 failed=0, dispatches=0, hits=0, misses=0,
+                 warmup_hits=0, warmup_misses=0, depth=0, inflight=0):
+        reg = MetricsRegistry()
+        reg.counter("ia_serve_requests_total", "r").inc(requests)
+        reg.counter("ia_serve_admitted_total", "r").inc(admitted)
+        reg.counter("ia_serve_shed_total", "r").inc(shed)
+        reg.counter("ia_serve_completed_total", "r").inc(completed)
+        reg.counter("ia_serve_failed_total", "r").inc(failed)
+        reg.counter("ia_serve_dispatches_total", "r").inc(
+            dispatches, labels={"kind": "client"}
+        )
+        for n, kind, c in ((hits, "client", "hits"),
+                           (misses, "client", "misses"),
+                           (warmup_hits, "warmup", "hits"),
+                           (warmup_misses, "warmup", "misses")):
+            if n:
+                reg.counter(
+                    f"ia_serve_excache_{c}_total", "r"
+                ).inc(n, labels={"kind": kind})
+        reg.gauge("ia_serve_queue_depth", "g").set(depth)
+        reg.gauge("ia_serve_inflight", "g").set(inflight)
+        return reg.to_dict()
+
+    def test_skipped_without_a_daemon(self):
+        check = check_serving(MetricsRegistry().to_dict())
+        assert check["status"] == "skipped"
+
+    def test_balanced_ledger_ok(self):
+        check = check_serving(self._metrics(
+            requests=5, admitted=4, shed=1, completed=3, failed=1,
+            dispatches=3, hits=2, misses=1,
+        ))
+        assert check["status"] == "ok", check
+        assert check["observed"]["pending"] == 0
+
+    def test_unbalanced_admission_violated(self):
+        check = check_serving(self._metrics(
+            requests=5, admitted=3, shed=1, completed=3,
+            dispatches=3, hits=3,
+        ))
+        assert check["status"] == "violated"
+        assert "shed" in check["detail"]
+
+    def test_negative_pending_violated(self):
+        check = check_serving(self._metrics(
+            requests=2, admitted=2, completed=2, failed=1,
+            dispatches=3, hits=3,
+        ))
+        assert check["status"] == "violated"
+
+    def test_midflight_gauge_mismatch_degrades_only(self):
+        check = check_serving(self._metrics(
+            requests=3, admitted=3, completed=2, dispatches=2,
+            hits=1, misses=1, depth=0, inflight=0,
+        ))  # pending=1 but gauges read 0: a mid-flight scrape
+        assert check["status"] == "degraded"
+
+    def test_fabricated_hits_violated(self):
+        check = check_serving(self._metrics(
+            requests=2, admitted=2, completed=2, dispatches=8,
+            hits=7, misses=1,
+        ))
+        assert check["status"] == "violated"
+        assert "hits" in check["detail"]
+
+    def test_unconsulted_dispatch_violated(self):
+        check = check_serving(self._metrics(
+            requests=3, admitted=3, completed=3, dispatches=3,
+            hits=1, misses=1,
+        ))
+        assert check["status"] == "violated"
+
+    def test_warmup_hits_stay_out_of_client_ledger(self):
+        # 1 client request but 2 total hits (1 warmup): legal, because
+        # the hits<=requests claim is about CLIENT traffic only.
+        check = check_serving(self._metrics(
+            requests=1, admitted=1, completed=1, dispatches=2,
+            hits=1, warmup_misses=1,
+        ))
+        assert check["status"] == "ok", check
+        assert check["observed"]["cache_hits_client"] == 1
+        assert check["observed"]["cache_hits"] == 1
+
+
+# ------------------------------------------------ artifact validator
+def _valid_record():
+    return {
+        "schema_version": 1,
+        "kind": "serve",
+        "round": 13,
+        "proxy_size": 32,
+        "config": {"levels": 2, "matcher": "patchmatch"},
+        "cache": {
+            "cold_ms": 20000.0, "warm_ms": 50.0,
+            "latency_delta_ms": 19950.0, "hits": 30.0, "misses": 1.0,
+            "evictions": 0, "resident": 1,
+        },
+        "sweep": [
+            {"clients": 1, "requests": 3, "completed": 3, "shed": 0,
+             "failed": 0, "hit_ratio": 1.0, "p50_ms": 45.0,
+             "p99_ms": 50.0},
+            {"clients": 8, "requests": 24, "completed": 9, "shed": 15,
+             "failed": 0, "hit_ratio": 1.0, "p50_ms": 80.0,
+             "p99_ms": 120.0},
+        ],
+        "ledger": {"requests": 35.0, "admitted": 20.0,
+                   "completed": 20.0, "failed": 0.0, "shed": 15.0},
+        "serving_check": "ok",
+    }
+
+
+class TestCheckServeValidator:
+    def test_valid_record_passes(self):
+        assert validate_serve(_valid_record()) == []
+
+    @pytest.mark.parametrize("mutate,needle", [
+        (lambda r: r.update(schema_version=2), "schema_version"),
+        (lambda r: r.update(kind="faults"), "kind"),
+        (lambda r: r["cache"].update(latency_delta_ms=0),
+         "latency_delta_ms"),
+        (lambda r: r["cache"].update(warm_ms=30000.0), "hit"),
+        (lambda r: r["sweep"].pop(1), "backpressure"),
+        (lambda r: r["sweep"][0].update(hit_ratio=0.2, shed=0),
+         "hit_ratio"),
+        (lambda r: r["sweep"][0].update(completed=2), "requests"),
+        (lambda r: r["sweep"][0].update(p50_ms=60.0, p99_ms=50.0),
+         "p50"),
+        (lambda r: r["ledger"].update(requests=99.0), "ledger"),
+        (lambda r: r["ledger"].update(completed=19.0), "ledger"),
+        (lambda r: r.update(serving_check="violated"),
+         "serving_check"),
+    ])
+    def test_mutations_fail(self, mutate, needle):
+        record = _valid_record()
+        mutate(record)
+        errs = validate_serve(record)
+        assert errs, f"mutation {needle} passed validation"
+        assert any(needle in e for e in errs), errs
+
+    def test_steady_state_warmth_requires_unshed_point(self):
+        record = _valid_record()
+        # Only the shed point is warm: no steady-state warm evidence.
+        record["sweep"][0]["hit_ratio"] = 0.0
+        assert any("steady" in e for e in validate_serve(record))
+
+    def test_cli_exit_codes(self, tmp_path):
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(_valid_record()))
+        assert check_serve_main([str(good)]) == 0
+        bad_record = _valid_record()
+        bad_record["serving_check"] = "skipped"
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(bad_record))
+        assert check_serve_main([str(bad)]) == 1
+        assert check_serve_main([str(tmp_path / "absent.json")]) == 1
+
+
+class TestCommittedServeArtifact:
+    def test_committed_artifact_validates(self):
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "SERVE_r13.json"
+        )
+        assert os.path.isfile(path), (
+            "SERVE_r13.json missing — regenerate with "
+            "`python tools/serve_load.py --out SERVE_r13.json`"
+        )
+        assert check_serve_main([path]) == 0
+        with open(path) as f:
+            record = json.load(f)
+        assert record["round"] == 13
+        # The headline claim: the repeat-shape request skipped a
+        # compile that costs real time.
+        assert record["cache"]["latency_delta_ms"] > 100.0
+
+
+# ------------------------------------------------- daemon end-to-end
+@pytest.fixture(scope="module")
+def daemon_scenario():
+    """One in-process daemon, real engine, one compile: cold/warm
+    requests, an injected give-up, and an overload burst — the
+    acceptance scenarios, sharing a single compiled executable."""
+    from image_analogies_tpu.runtime.faults import set_fault_plan
+
+    rng = np.random.default_rng(7)
+    a, ap, b = (
+        rng.random((24, 24, 3)).astype(np.float32) for _ in range(3)
+    )
+    cfg = SynthConfig(**_SERVE_CFG)
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    daemon = SynthDaemon(
+        a, ap, cfg, registry=reg,
+        max_batch=1, max_wait_ms=5.0, max_queue_depth=2,
+        cache_capacity=4, max_retries=1,
+    ).start()
+    body = _body(b)
+    out = {}
+    try:
+        out["cold"] = _post(daemon.url, body)
+        out["warm"] = _post(daemon.url, body)
+        # What a direct solo dispatch of the same request produces —
+        # the isolation contract says the daemon's answer must be
+        # bit-identical (same PRNG identity, same luminance bucket).
+        from image_analogies_tpu.parallel.batch import synthesize_batch
+
+        out["solo_ref"] = np.asarray(synthesize_batch(
+            a, ap, b[None], cfg, daemon.mesh,
+            frame_indices=[0], _b_stats=daemon._make_request(b).b_stats,
+        ))[0]
+        out["serving"] = json.loads(_get(daemon.url + "/serving")[1])
+        out["metrics_text"] = _get(daemon.url + "/metrics")[1].decode()
+        out["health_mid"] = daemon.health()
+
+        set_fault_plan("level:0:raise:2")  # outlives max_retries=1
+        out["gave_up"] = _post(daemon.url, body)
+        out["after_give_up"] = _post(daemon.url, body)
+
+        set_fault_plan("level:0:hang:3")  # slow one dispatch 3 s
+        results = []
+        lock = threading.Lock()
+
+        def worker():
+            r = _post(daemon.url, body)
+            with lock:
+                results.append(r)
+
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)
+        for t in threads:
+            t.join(timeout=300)
+        out["burst"] = results
+        out["health_end"] = daemon.health()
+    finally:
+        set_fault_plan(None)
+        daemon.stop()
+        set_registry(prev)
+    return out
+
+
+class TestDaemonEndToEnd:
+    def test_repeat_shape_is_cache_hit(self, daemon_scenario):
+        code, r, _ = daemon_scenario["cold"]
+        assert code == 200 and r["cache"] == "miss"
+        code, r, _ = daemon_scenario["warm"]
+        assert code == 200 and r["cache"] == "hit"
+        assert [s["name"] for s in r["spans"]] == [
+            "queued", "admitted", "cache-hit", "executed", "demuxed",
+        ]
+        # The warm request must not have paid the compile again.
+        cold_ms = daemon_scenario["cold"][1]["wall_ms"]
+        assert r["wall_ms"] < cold_ms
+
+    def test_response_image_roundtrips(self, daemon_scenario):
+        _, r, _ = daemon_scenario["warm"]
+        img = np.frombuffer(
+            base64.b64decode(r["image_b64"]), np.float32
+        ).reshape(r["shape"])
+        assert img.shape == (24, 24, 3)
+        assert np.all(np.isfinite(img))
+
+    def test_output_matches_solo_dispatch(self, daemon_scenario):
+        """Isolation contract: the served answer is bit-identical to a
+        direct solo `synthesize_batch` call for the same frame."""
+        _, r, _ = daemon_scenario["warm"]
+        img = np.frombuffer(
+            base64.b64decode(r["image_b64"]), np.float32
+        ).reshape(r["shape"])
+        np.testing.assert_array_equal(
+            img, daemon_scenario["solo_ref"]
+        )
+
+    def test_serving_snapshot_shape(self, daemon_scenario):
+        snap = daemon_scenario["serving"]
+        assert snap["cache"]["resident"] == 1
+        assert snap["policy"]["max_batch"] == 1
+        assert set(snap["slo_ms"]) == {"queued", "service", "total"}
+        assert snap["slo_ms"]["total"]["p50"] is not None
+
+    def test_metrics_exposition_carries_serving_families(
+        self, daemon_scenario
+    ):
+        text = daemon_scenario["metrics_text"]
+        assert 'ia_serve_excache_hits_total{kind="client"} 1' in text
+        assert "ia_serve_requests_total 2" in text
+        assert "ia_serve_request_ms" in text
+
+    def test_give_up_maps_to_500_daemon_survives(self, daemon_scenario):
+        code, r, _ = daemon_scenario["gave_up"]
+        assert code == 500 and "gave up" in r["error"]
+        code, r, _ = daemon_scenario["after_give_up"]
+        assert code == 200 and r["status"] == "ok"
+
+    def test_overload_sheds_with_retry_after(self, daemon_scenario):
+        codes = sorted(c for c, _, _ in daemon_scenario["burst"])
+        assert 429 in codes and 200 in codes
+        shed = next(
+            (r, h) for c, r, h in daemon_scenario["burst"] if c == 429
+        )
+        r, headers = shed
+        assert r["status"] == "shed"
+        assert int(headers["Retry-After"]) >= 1
+        assert r["retry_after_s"] >= 1.0
+
+    def test_sentinel_grades_the_session(self, daemon_scenario):
+        for key in ("health_mid", "health_end"):
+            checks = {
+                c["name"]: c for c in daemon_scenario[key]["checks"]
+            }
+            assert checks["serving"]["status"] == "ok", checks[
+                "serving"
+            ]
+            assert checks["recovery"]["status"] == "ok", checks[
+                "recovery"
+            ]
+        observed = {
+            c["name"]: c for c in daemon_scenario["health_end"][
+                "checks"
+            ]
+        }["serving"]["observed"]
+        assert observed["requests"] == (
+            observed["admitted"] + observed["shed"]
+        )
+        assert observed["shed"] >= 1
+
+
+# ------------------------------------------- subprocess CLI lifecycle
+@pytest.mark.slow
+class TestServeCLISubprocess:
+    def test_serve_lifecycle_warmup_hit_sigterm_flight(self, tmp_path):
+        """test_live.py-style lifecycle for `ia-synth serve`: spawn
+        the daemon with a warmup manifest and --trace-dir, rendezvous
+        on live.json (announced AFTER warmup), post the warmed shape
+        twice (both hits), scrape /metrics + /healthz, SIGTERM, and
+        validate the flight dump."""
+        from check_report import validate_flight
+
+        from image_analogies_tpu.utils.io import save_image
+
+        rng = np.random.default_rng(3)
+        a_path = str(tmp_path / "a.png")
+        ap_path = str(tmp_path / "ap.png")
+        save_image(a_path, rng.random((24, 24, 3)).astype(np.float32))
+        save_image(ap_path, rng.random((24, 24, 3)).astype(np.float32))
+        manifest = str(tmp_path / "warm.json")
+        with open(manifest, "w") as f:
+            json.dump({
+                "schema_version": 1, "kind": "serve_warmup",
+                "entries": [{"height": 24, "width": 24}],
+            }, f)
+        trace = str(tmp_path / "trace")
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "image_analogies_tpu.cli",
+                "serve", "--a", a_path, "--ap", ap_path,
+                "--port", "0", "--max-batch", "1",
+                "--max-wait-ms", "5", "--warmup", manifest,
+                "--levels", "2", "--matcher", "patchmatch",
+                "--em-iters", "1", "--pm-iters", "2",
+                "--device", "cpu", "--trace-dir", trace,
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            live_path = os.path.join(trace, "live.json")
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                if os.path.isfile(live_path) or proc.poll() is not None:
+                    break
+                time.sleep(0.1)
+            assert os.path.isfile(live_path), (
+                "live.json never appeared (daemon exited "
+                f"rc={proc.poll()} before announcing)"
+            )
+            with open(live_path) as f:
+                url = json.load(f)["url"]
+
+            body = _body(
+                rng.random((24, 24, 3)).astype(np.float32)
+            )
+            # The warmup manifest covered this shape: both client
+            # requests reuse the warmed executable.
+            code, r1, _ = _post(url, body)
+            assert code == 200 and r1["cache"] == "hit", r1
+            code, r2, _ = _post(url, body)
+            assert code == 200 and r2["cache"] == "hit", r2
+
+            _, metrics = _get(url + "/metrics")
+            text = metrics.decode()
+            assert (
+                'ia_serve_excache_hits_total{kind="client"} 2' in text
+            )
+            assert (
+                'ia_serve_excache_misses_total{kind="warmup"} 1'
+                in text
+            )
+            code, health_body = _get(url + "/healthz")
+            assert code == 200
+            health = json.loads(health_body)
+            assert health["context"] == "serving"
+            checks = {c["name"]: c for c in health["checks"]}
+            assert checks["serving"]["status"] == "ok"
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        flight_path = os.path.join(trace, "flight.json")
+        assert os.path.isfile(flight_path), (
+            "SIGTERM'd daemon left no flight.json"
+        )
+        with open(flight_path) as f:
+            dump = json.load(f)
+        assert validate_flight(dump) == []
+
+
+@pytest.mark.slow
+class TestServeLoadFresh:
+    def test_fresh_sweep_generates_valid_artifact(self, tmp_path):
+        from serve_load import main as serve_load_main
+
+        out = str(tmp_path / "SERVE_fresh.json")
+        rc = serve_load_main([
+            "--out", out, "--size", "24", "--clients", "1,6",
+            "--max-queue-depth", "2", "--requests-per-client", "2",
+        ])
+        assert rc == 0
+        with open(out) as f:
+            record = json.load(f)
+        assert validate_serve(record) == []
+        assert record["cache"]["latency_delta_ms"] > 0

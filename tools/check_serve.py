@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Validate a SERVE_r13.json serving-tier artifact (round 13).
+
+The serving acceptance bar, enforced by a validator instead of trusted
+to prose: the executable cache must have MEASURABLY saved the second
+same-shape request its prologue compile (latency_delta_ms > 0, warm
+under cold), the steady-state sweep point must actually run warm
+(hit_ratio >= 0.5 with nothing shed), the overload point must have
+produced real backpressure (at least one 429), every sweep point's
+arithmetic must close (completed + shed + failed == requests, p50 <=
+p99), the final admission ledger must balance (requests == admitted +
+shed, admitted == completed + failed — nothing lost, nothing double-
+counted), and the sentinel's serving check must have graded the run
+"ok" — a ledger the daemon's own invariant check rejects is not an
+artifact, it is a bug report.
+
+Usage:
+    python tools/check_serve.py SERVE_r13.json
+
+Runs under pytest too (tests/test_serving.py validates the COMMITTED
+artifact) so tier-1 fails if the record is missing, truncated, or
+structurally degraded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+SERVE_SCHEMA_VERSION = 1
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_serve(record: dict) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if record.get("schema_version") != SERVE_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {record.get('schema_version')!r} != "
+            f"{SERVE_SCHEMA_VERSION}"
+        )
+    if record.get("kind") != "serve":
+        errs.append(f"kind {record.get('kind')!r} != 'serve'")
+    size = record.get("proxy_size")
+    if not (_num(size) and size >= 16):
+        errs.append(f"proxy_size {size!r} is not a size >= 16")
+
+    cache = record.get("cache")
+    if not isinstance(cache, dict):
+        errs.append("cache: missing object")
+        cache = {}
+    cold, warm = cache.get("cold_ms"), cache.get("warm_ms")
+    delta = cache.get("latency_delta_ms")
+    if not (_num(cold) and cold > 0):
+        errs.append(f"cache.cold_ms {cold!r} is not a positive number")
+    if not (_num(warm) and warm > 0):
+        errs.append(f"cache.warm_ms {warm!r} is not a positive number")
+    if not (_num(delta) and delta > 0):
+        errs.append(
+            f"cache.latency_delta_ms {delta!r} is not > 0 — the "
+            "second same-shape request must demonstrably skip the "
+            "prologue compile"
+        )
+    if _num(cold) and _num(warm) and cold <= warm:
+        errs.append(
+            f"cache.cold_ms {cold} <= warm_ms {warm} — a 'hit' that "
+            "is no faster than the compile is not a hit"
+        )
+    for k in ("hits", "misses"):
+        v = cache.get(k)
+        if not (_num(v) and v >= 1):
+            errs.append(f"cache.{k} {v!r} is not a count >= 1")
+
+    sweep = record.get("sweep")
+    if not isinstance(sweep, list) or not sweep:
+        errs.append("sweep: missing/empty list")
+        sweep = []
+    any_shed = False
+    any_warm_steady = False
+    for i, pt in enumerate(sweep):
+        if not isinstance(pt, dict):
+            errs.append(f"sweep[{i}]: not an object")
+            continue
+        name = f"sweep[{i}] (clients={pt.get('clients')!r})"
+        for k in ("clients", "requests", "completed", "shed", "failed"):
+            if not (_num(pt.get(k)) and pt.get(k) >= 0):
+                errs.append(f"{name}: {k} {pt.get(k)!r} is not a "
+                            "non-negative number")
+        if all(_num(pt.get(k)) for k in ("requests", "completed",
+                                         "shed", "failed")):
+            if pt["completed"] + pt["shed"] + pt["failed"] != \
+                    pt["requests"]:
+                errs.append(
+                    f"{name}: completed {pt['completed']} + shed "
+                    f"{pt['shed']} + failed {pt['failed']} != requests "
+                    f"{pt['requests']}"
+                )
+            if pt["shed"] >= 1:
+                any_shed = True
+        hr = pt.get("hit_ratio")
+        if not (_num(hr) and 0.0 <= hr <= 1.0):
+            errs.append(f"{name}: hit_ratio {hr!r} not in [0, 1]")
+        p50, p99 = pt.get("p50_ms"), pt.get("p99_ms")
+        if _num(pt.get("completed")) and pt["completed"] >= 1:
+            if not (_num(p50) and _num(p99)):
+                errs.append(
+                    f"{name}: completed requests but p50_ms/p99_ms "
+                    f"are {p50!r}/{p99!r}"
+                )
+            elif p50 > p99:
+                errs.append(f"{name}: p50_ms {p50} > p99_ms {p99}")
+        if (
+            _num(pt.get("shed")) and pt["shed"] == 0
+            and _num(hr) and hr >= 0.5
+        ):
+            any_warm_steady = True
+    if sweep and not any_shed:
+        errs.append(
+            "no sweep point shed a request — the overload arm never "
+            "produced backpressure (429s are an acceptance criterion, "
+            "not an error mode)"
+        )
+    if sweep and not any_warm_steady:
+        errs.append(
+            "no steady-state sweep point (shed == 0) ran warm "
+            "(hit_ratio >= 0.5) — the executable cache is not doing "
+            "its job under sustained same-shape load"
+        )
+
+    ledger = record.get("ledger")
+    if not isinstance(ledger, dict):
+        errs.append("ledger: missing object")
+        ledger = {}
+    if all(_num(ledger.get(k)) for k in ("requests", "admitted",
+                                         "shed")):
+        if ledger["requests"] != ledger["admitted"] + ledger["shed"]:
+            errs.append(
+                f"ledger: requests {ledger['requests']} != admitted "
+                f"{ledger['admitted']} + shed {ledger['shed']}"
+            )
+    else:
+        errs.append("ledger: requests/admitted/shed must be numbers")
+    if all(_num(ledger.get(k)) for k in ("admitted", "completed",
+                                         "failed")):
+        if ledger["admitted"] != ledger["completed"] + ledger["failed"]:
+            errs.append(
+                f"ledger: admitted {ledger['admitted']} != completed "
+                f"{ledger['completed']} + failed {ledger['failed']} — "
+                "an unbalanced final ledger means a request was lost "
+                "or double-counted"
+            )
+    else:
+        errs.append("ledger: admitted/completed/failed must be numbers")
+
+    if record.get("serving_check") != "ok":
+        errs.append(
+            f"serving_check {record.get('serving_check')!r} != 'ok' — "
+            "the sentinel's own ledger invariants must grade the run "
+            "clean"
+        )
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="SERVE_r13.json to validate")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"check_serve: cannot read {args.path}: {e}")
+        return 1
+    errs = validate_serve(record)
+    if errs:
+        print(f"check_serve: {args.path} INVALID:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    cache = record.get("cache", {})
+    print(
+        f"check_serve: {args.path} OK "
+        f"(compile saved {cache.get('latency_delta_ms')} ms on repeat "
+        f"shape; {len(record.get('sweep', []))} sweep points; ledger "
+        f"{record.get('ledger')})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for the synthesis daemon (round 13).
+
+Drives an in-process `SynthDaemon` (same code path as `ia-synth
+serve`, minus the subprocess) through the serving acceptance
+scenarios and writes one SERVE_r13.json artifact:
+
+  1. cache probe — one cold request (compiles) and one warm repeat of
+     the same shape (cache hit): `latency_delta_ms` is the measured
+     compile saving, the tentpole's headline number;
+  2. load sweep — for each client count, that many closed-loop
+     clients each post `--requests-per-client` same-shape requests
+     back-to-back (429s are recorded, not retried: the sweep measures
+     the admission decision, not client patience).  The burst arm's
+     client count deliberately exceeds the queue depth so admission
+     control MUST shed — a sweep that never sheds fails validation;
+  3. ledger + sentinel — the final admission ledger scraped from the
+     daemon's registry, plus the sentinel serving check's verdict on
+     the same metrics the daemon's /healthz serves.
+
+The artifact is validated with tools/check_serve.py before this tool
+exits 0 (the generator never commits a record its own validator
+rejects).
+
+Usage:
+    python tools/serve_load.py --out SERVE_r13.json [--size 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Tuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from check_serve import validate_serve  # noqa: E402
+
+
+def _post(url: str, body: bytes,
+          timeout: float = 600.0) -> Tuple[int, dict]:
+    req = urllib.request.Request(
+        url + "/synthesize", data=body,
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _quantiles(lat_ms: List[float]) -> Tuple[Optional[float],
+                                             Optional[float]]:
+    if not lat_ms:
+        return None, None
+    if len(lat_ms) == 1:
+        return round(lat_ms[0], 3), round(lat_ms[0], 3)
+    qs = statistics.quantiles(lat_ms, n=100, method="inclusive")
+    return round(qs[49], 3), round(qs[98], 3)
+
+
+def _counter_total(snap: dict, name: str) -> float:
+    return float(sum(
+        v for v in snap.get(name, {}).get("values", {}).values()
+        if isinstance(v, (int, float))
+    ))
+
+
+def run_load(args) -> dict:
+    import numpy as np
+
+    from image_analogies_tpu.config import SynthConfig
+    from image_analogies_tpu.serving.daemon import SynthDaemon
+    from image_analogies_tpu.telemetry.metrics import (
+        MetricsRegistry,
+        set_registry,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    size = args.size
+    a, ap, b = (
+        rng.random((size, size, 3)).astype(np.float32) for _ in range(3)
+    )
+    cfg = SynthConfig(
+        levels=2, matcher="patchmatch", pallas_mode="off",
+        em_iters=1, pm_iters=2,
+    )
+    body = json.dumps({
+        "image_b64": base64.b64encode(
+            np.ascontiguousarray(b).tobytes()
+        ).decode(),
+        "shape": [size, size, 3],
+        "dtype": "float32",
+    }).encode()
+
+    registry = MetricsRegistry()
+    prev = set_registry(registry)
+    daemon = SynthDaemon(
+        a, ap, cfg, registry=registry,
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        max_queue_depth=args.max_queue_depth,
+        cache_capacity=4, max_retries=1,
+    ).start()
+    try:
+        # -- 1. cache probe: cold (compiles) vs warm repeat shape.
+        t0 = time.perf_counter()
+        code, r = _post(daemon.url, body)
+        cold_ms = (time.perf_counter() - t0) * 1000.0
+        if code != 200 or r.get("cache") != "miss":
+            raise RuntimeError(
+                f"cold probe: expected 200/miss, got {code}/"
+                f"{r.get('cache')!r} ({r.get('error')})"
+            )
+        t0 = time.perf_counter()
+        code, r = _post(daemon.url, body)
+        warm_ms = (time.perf_counter() - t0) * 1000.0
+        if code != 200 or r.get("cache") != "hit":
+            raise RuntimeError(
+                f"warm probe: expected 200/hit, got {code}/"
+                f"{r.get('cache')!r} ({r.get('error')})"
+            )
+        print(
+            f"serve_load: cache probe cold={cold_ms:.0f} ms "
+            f"warm={warm_ms:.0f} ms "
+            f"(saved {cold_ms - warm_ms:.0f} ms)", flush=True,
+        )
+
+        # -- 2. closed-loop sweep.
+        sweep = []
+        for clients in args.clients:
+            lock = threading.Lock()
+            lat_ms: List[float] = []
+            counts = {"completed": 0, "shed": 0, "failed": 0,
+                      "hits": 0}
+            barrier = threading.Barrier(clients)
+
+            def client():
+                barrier.wait()
+                for _ in range(args.requests_per_client):
+                    t0 = time.perf_counter()
+                    code, r = _post(daemon.url, body)
+                    wall = (time.perf_counter() - t0) * 1000.0
+                    with lock:
+                        if code == 200:
+                            counts["completed"] += 1
+                            lat_ms.append(wall)
+                            if r.get("cache") == "hit":
+                                counts["hits"] += 1
+                        elif code == 429:
+                            counts["shed"] += 1
+                        else:
+                            counts["failed"] += 1
+
+            threads = [threading.Thread(target=client)
+                       for _ in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            p50, p99 = _quantiles(lat_ms)
+            point = {
+                "clients": clients,
+                "requests": clients * args.requests_per_client,
+                "completed": counts["completed"],
+                "shed": counts["shed"],
+                "failed": counts["failed"],
+                "hit_ratio": round(
+                    counts["hits"] / counts["completed"], 3
+                ) if counts["completed"] else 0.0,
+                "p50_ms": p50,
+                "p99_ms": p99,
+            }
+            sweep.append(point)
+            print(f"serve_load: sweep {point}", flush=True)
+
+        # -- 3. final ledger + the sentinel's own verdict.
+        snap = registry.to_dict()
+        ledger = {
+            k: _counter_total(snap, f"ia_serve_{k}_total")
+            for k in ("requests", "admitted", "completed", "failed",
+                      "shed")
+        }
+        health = daemon.health()
+        serving_check = next(
+            c["status"] for c in health["checks"]
+            if c["name"] == "serving"
+        )
+        cache_snap = daemon.cache.snapshot()
+        record = {
+            "schema_version": 1,
+            "kind": "serve",
+            "round": 13,
+            "proxy_size": size,
+            "config": {
+                "levels": cfg.levels, "matcher": cfg.matcher,
+                "em_iters": cfg.em_iters, "pm_iters": cfg.pm_iters,
+                "max_batch": daemon.policy.max_batch,
+                "max_wait_ms": daemon.policy.max_wait_ms,
+                "max_queue_depth": daemon.admission.max_depth,
+                "requests_per_client": args.requests_per_client,
+            },
+            "cache": {
+                "cold_ms": round(cold_ms, 3),
+                "warm_ms": round(warm_ms, 3),
+                "latency_delta_ms": round(cold_ms - warm_ms, 3),
+                "hits": _counter_total(
+                    snap, "ia_serve_excache_hits_total"
+                ),
+                "misses": _counter_total(
+                    snap, "ia_serve_excache_misses_total"
+                ),
+                "evictions": cache_snap["evictions"],
+                "resident": cache_snap["resident"],
+            },
+            "sweep": sweep,
+            "ledger": ledger,
+            "serving_check": serving_check,
+        }
+        return record
+    finally:
+        daemon.stop()
+        set_registry(prev)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", required=True,
+                    help="where to write SERVE_r13.json")
+    ap.add_argument("--size", type=int, default=32,
+                    help="proxy image edge (default 32)")
+    ap.add_argument("--max-batch", type=int, default=2)
+    ap.add_argument("--max-wait-ms", type=float, default=10.0)
+    ap.add_argument("--max-queue-depth", type=int, default=3,
+                    help="kept BELOW the burst client count so the "
+                    "overload arm must shed")
+    ap.add_argument("--clients", default="1,2,8",
+                    help="comma-separated closed-loop client counts")
+    ap.add_argument("--requests-per-client", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    args.clients = [int(c) for c in str(args.clients).split(",")]
+    if max(args.clients) <= args.max_queue_depth:
+        print(
+            "serve_load: largest client count must exceed "
+            f"--max-queue-depth ({args.max_queue_depth}) or the "
+            "overload arm cannot shed"
+        )
+        return 1
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    record = run_load(args)
+    errs = validate_serve(record)
+    if errs:
+        print("serve_load: generated record INVALID:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, args.out)
+    print(
+        f"serve_load: wrote {args.out} (compile saved "
+        f"{record['cache']['latency_delta_ms']} ms; ledger "
+        f"{record['ledger']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
