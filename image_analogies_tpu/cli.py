@@ -73,6 +73,14 @@ def _add_synth_flags(p: argparse.ArgumentParser) -> None:
         "per sweep (e.g. '16:8'); 'off' disables.  Sets the "
         "process-wide kernel mode (IA_CAND_PRUNE)",
     )
+    p.add_argument(
+        "--tau", type=float, default=0.0,
+        help="temporal-coherence weight (video subsystem): warm frames "
+        "penalize match candidates by tau x normalized squared "
+        "divergence from the previous frame's converged mapping; 0 "
+        "keeps the historic graphs bit-identical (the kappa of the "
+        "time axis)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
         "--feature-bytes-budget", type=int, default=None,
@@ -197,6 +205,7 @@ def _config_from(args) -> "SynthConfig":
         pm_iters=args.pm_iters,
         pca_dims=args.pca_dims,
         ann_eps=args.ann_eps,
+        tau=args.tau,
         seed=args.seed,
         pallas_mode=args.pallas_mode,
         save_level_artifacts=args.save_level_artifacts,
@@ -619,6 +628,121 @@ def cmd_batch(args) -> int:
     return 0
 
 
+def cmd_video(args) -> int:
+    """Frame-SEQUENCE stylization with temporal warm-starting
+    (round 14, video/): NNF warm-start between consecutive frames,
+    tau-weighted temporal coherence, and delta-cost scheduling — same
+    ingest, telemetry, --health, and --supervise surfaces as `batch`
+    (frame-granular resume rides the per-frame `frames_{t:05d}`
+    checkpoint subdirectories both modes share)."""
+    _apply_cand_compression(args)
+    _select_device(args.device)
+    import numpy as np
+
+    from .parallel.batch import ingest_frame_dir
+    from .parallel.mesh import make_mesh
+    from .utils.io import load_image, save_image
+    from .utils.profiling import telemetry_session
+    from .utils.progress import ProgressWriter
+    from .video import set_warm_mode, synthesize_video
+
+    if args.warm:
+        set_warm_mode(args.warm)
+    progress = ProgressWriter(args.progress)
+    a = load_image(args.a)
+    ap = load_image(args.ap)
+    frames, names, frame_failures = ingest_frame_dir(
+        args.frames, strict=args.strict_frames
+    )
+    cfg = _config_from(args)
+    # Default mesh: the warm path loops single frames, so extra devices
+    # would only carry padding ballast (outputs are mesh-invariant);
+    # --n-devices still forces a mesh for the warm-off batch dispatch.
+    mesh = make_mesh(args.n_devices) if args.n_devices else None
+    t0 = time.perf_counter()
+
+    instrument = bool(
+        args.progress or args.trace_dir or args.health
+        or args.metrics_port is not None or args.supervise
+    )
+    cfg, ckpt_dir, ckpt_ephemeral = _force_ckpt_dir(args, cfg)
+    with telemetry_session(
+        args.trace_dir or args.profile, sink=progress,
+        enabled=instrument, artifact_dir=args.trace_dir,
+        metrics_port=args.metrics_port,
+    ) as tracer:
+        if frame_failures and tracer.enabled:
+            from .telemetry.metrics import get_registry
+
+            c = get_registry().counter(
+                "ia_frames_failed_total",
+                "batch-ingest frames skipped for per-frame faults "
+                "(unreadable/undecodable; --strict-frames aborts "
+                "instead)",
+            )
+            for rec in frame_failures:
+                c.inc(labels={
+                    "reason": rec["reason"].split(":", 1)[0],
+                })
+            tracer.emit(
+                "frame_failures",
+                n=len(frame_failures),
+                frames=[rec["path"] for rec in frame_failures],
+            )
+        runner_state = {
+            "mode": (
+                "mesh"
+                if mesh is not None and mesh.devices.size > 1
+                else "single"
+            )
+        }
+        strict_state = {"first": True}
+
+        def _dispatch(resume_from):
+            run_mesh = (
+                mesh if runner_state["mode"] == "mesh"
+                else (make_mesh(1) if mesh is not None else None)
+            )
+            return synthesize_video(
+                a, ap, frames, cfg, mesh=run_mesh,
+                progress=tracer if instrument else None,
+                resume_from=resume_from,
+                resume_strict=_resume_strict_for(
+                    args, resume_from, strict_state
+                ),
+            )
+
+        if args.supervise:
+            bps = np.asarray(
+                _run_supervised(
+                    args, _dispatch, runner_state, ckpt_dir, tracer,
+                    ckpt_ephemeral,
+                )
+            )
+        else:
+            try:
+                bps = np.asarray(_dispatch(args.resume_from))
+            except _resume_error_type() as e:
+                raise SystemExit(str(e))
+    os.makedirs(args.out, exist_ok=True)
+    for name, bp in zip(names, bps):
+        save_image(os.path.join(args.out, name), bp)
+    print(
+        f"wrote {len(names)} frames to {args.out} "
+        f"({time.perf_counter() - t0:.2f}s, warm={args.warm or 'on'})"
+    )
+    for rec in frame_failures:
+        print(f"frame FAILED (skipped): {rec['path']} — {rec['reason']}")
+    if frame_failures:
+        print(
+            f"{len(frame_failures)} frame(s) skipped; rerun with "
+            "--strict-frames to abort on ingest errors instead"
+        )
+    if args.health:
+        _emit_health(tracer, args.trace_dir, "video")
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Synthesis-as-a-service (round 13, serving/): a long-lived
     daemon over one style pair, serving POST /synthesize with a
@@ -674,6 +798,7 @@ def cmd_serve(args) -> int:
             max_queue_depth=args.max_queue_depth,
             cache_capacity=args.cache_capacity,
             max_retries=args.max_retries,
+            max_sessions=args.max_sessions,
             flight=getattr(tracer, "flight_recorder", None),
         ).start()
         try:
@@ -841,6 +966,32 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_batch)
 
     p = sub.add_parser(
+        "video",
+        help="stylize a frame SEQUENCE with temporal warm-starting "
+        "(video/): NNF warm-start between consecutive frames, "
+        "tau-weighted temporal coherence, delta-cost scheduling",
+    )
+    p.add_argument("--a", required=True)
+    p.add_argument("--ap", required=True)
+    p.add_argument("--frames", required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--n-devices", type=int, default=None)
+    p.add_argument(
+        "--warm", default=None, choices=["on", "off"],
+        help="NNF warm-start seam (IA_VIDEO_WARM): 'off' dispatches "
+        "every frame cold through the batch runner, bit-identical to "
+        "`batch --frames-per-step 1` (default: on, or the "
+        "IA_VIDEO_WARM environment value)",
+    )
+    p.add_argument(
+        "--strict-frames", action="store_true",
+        help="abort on the first unreadable/undecodable frame instead "
+        "of skipping it with a recorded per-frame status",
+    )
+    _add_synth_flags(p)
+    p.set_defaults(fn=cmd_video)
+
+    p = sub.add_parser(
         "serve",
         help="synthesis-as-a-service daemon: request queue + "
         "compiled-executable cache + continuous batching + admission "
@@ -885,6 +1036,14 @@ def main(argv=None) -> int:
         "compiled through the real dispatch path before the endpoint "
         "announces — the first client request of each listed shape "
         "is then a cache hit",
+    )
+    p.add_argument(
+        "--max-sessions", type=int, default=16, metavar="N",
+        help="video session-affinity streams held live (LRU; round "
+        "14).  A /synthesize request carrying session_id pins to a "
+        "per-session warm-start stream; the least-recently-used "
+        "stream beyond this count is dropped and its next frame runs "
+        "cold (default 16)",
     )
     _add_synth_flags(p)
     p.set_defaults(fn=cmd_serve)

@@ -32,6 +32,14 @@ class SynthConfig:
     patch_size: int = 5
     coarse_patch_size: int = 3
     kappa: float = 0.0
+    # Temporal-coherence weight for video synthesis (image_analogies_tpu/
+    # video): candidate distances gain a penalty proportional to the
+    # squared offset between a candidate and the PREVIOUS frame's
+    # converged mapping at the same pixel, normalized by the A-image
+    # diagonal (models/patchmatch.temporal_penalty_fn).  0 disables the
+    # term entirely — tau=0 graphs are bit-identical to the pre-video
+    # engine because the penalty is gated at trace time, like kappa.
+    tau: float = 0.0
     matcher: str = "patchmatch"
     color_mode: str = "luminance"
     steerable: bool = False
@@ -137,6 +145,8 @@ class SynthConfig:
             raise ValueError("levels must be >= 1")
         if self.em_iters < 1 or self.pm_iters < 1:
             raise ValueError("em_iters and pm_iters must be >= 1")
+        if self.tau < 0.0:
+            raise ValueError("tau must be >= 0")
         if self.pm_polish_iters < 1 or self.pm_polish_random < 0:
             raise ValueError(
                 "pm_polish_iters must be >= 1 and pm_polish_random >= 0"

@@ -253,6 +253,14 @@ def clear_compiled_level_caches() -> None:
         _psp._reslab_fn, _psp._banded_lean_step_fn,
     ):
         fn.cache_clear()
+    # The video subsystem's temporal level twin joins the funnel only
+    # when loaded (sys.modules probe: kernels must not import the video
+    # driver that imports the parallel runners that import kernels).
+    import sys
+
+    _vid = sys.modules.get("image_analogies_tpu.video.sequence")
+    if _vid is not None:
+        _vid._video_level_fn_cached.cache_clear()
 
 
 def set_packed_layout(layout: str) -> None:

@@ -128,8 +128,37 @@ def _level_state_glue(lean: bool, prev_kind: str, prev_nnf, prev_bp,
     ADVICE r2: at a lean coarsest level the stacked (H, W, 2) init
     would materialize the exact lane-padded allocation the lean
     representation avoids — draw the planes directly (bit-identical
-    streams: same key split, same shapes)."""
+    streams: same key split, same shapes).
+
+    prev_kind "direct" (video subsystem): the incoming state is a
+    SAME-RESOLUTION converged field — the previous frame's field at
+    THIS level — so it seeds this level verbatim (clamped) instead of
+    being upsampled; B' starts from prev_bp at this resolution.  At a
+    non-coarsest level prev_bp is the tuple (bp_fine, bp_coarse) — the
+    previous frame's converged B' at this level and the one below —
+    because the EM features consume the coarse plane at its own
+    resolution.  Only the video driver requests "direct" (plan_level
+    never produces it)."""
     vm = jax.vmap if batched else (lambda f: f)
+    if prev_kind == "direct":
+        if lean:
+            p_py, p_px = (
+                prev_nnf if isinstance(prev_nnf, tuple)
+                else (prev_nnf[..., 0], prev_nnf[..., 1])
+            )
+            nnf = (
+                vm(lambda p: jnp.clip(p, 0, ha - 1))(p_py),
+                vm(lambda p: jnp.clip(p, 0, wa - 1))(p_px),
+            )
+        else:
+            from .matcher import clamp_nnf
+
+            nnf = vm(lambda n: clamp_nnf(n, ha, wa))(prev_nnf)
+        if isinstance(prev_bp, tuple):
+            flt_bp, flt_bp_coarse = prev_bp
+        else:
+            flt_bp = flt_bp_coarse = prev_bp
+        return nnf, flt_bp, flt_bp_coarse
     if prev_kind != "none":
         if lean:
             p_py, p_px = (
@@ -430,7 +459,7 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
         if cfg.matcher == "brute":
             def em_step_lean_brute(src_b, flt_b, src_b_c, flt_b_c, f_a,
                                    copy_a, nnf, key, proj=None,
-                                   a_planes=None):
+                                   a_planes=None, temporal=None):
                 return lean_brute_em_step(
                     cfg, level, has_coarse,
                     src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf, key,
@@ -441,7 +470,7 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
         from ..kernels import resolve_pallas
 
         def em_step_lean(src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf,
-                         key, proj=None, a_planes=None):
+                         key, proj=None, a_planes=None, temporal=None):
             return lean_em_step(
                 cfg, level, has_coarse, polish_iters,
                 src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf, key,
@@ -451,7 +480,7 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
         return em_step_lean
 
     def em_step(src_b, flt_b, src_b_c, flt_b_c, f_a, copy_a, nnf, key,
-                proj=None, a_planes=None):
+                proj=None, a_planes=None, temporal=None):
         # tlm_* named scopes: trace-time-only phase tags that thread
         # through to profiler op names, which is how the run report
         # attributes device time to matcher phases
@@ -480,7 +509,7 @@ def make_em_step(cfg: SynthConfig, level: int, has_coarse: bool,
         with jax.named_scope("tlm_match"):
             nnf, dist = matcher.match(
                 f_b, f_a, nnf, key=key, level=level, cfg=cfg, raw=raw,
-                polish_iters=polish_iters,
+                polish_iters=polish_iters, temporal=temporal,
             )
         with jax.named_scope("tlm_render"):
             bp = _gather_image(copy_a, nnf)

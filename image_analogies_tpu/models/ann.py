@@ -144,7 +144,7 @@ class AnnMatcher(Matcher):
     name = "ann"
 
     def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig,
-              raw=None, polish_iters=None):
+              raw=None, polish_iters=None, temporal=None):
         from ..utils.native import ann_available
 
         h, w, d = f_b.shape

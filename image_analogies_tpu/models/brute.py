@@ -89,7 +89,7 @@ class BruteForceMatcher(Matcher):
     name = "brute"
 
     def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig,
-              raw=None, polish_iters=None):
+              raw=None, polish_iters=None, temporal=None):
         from ..kernels import resolve_pallas
         from ..kernels.nn_brute import exact_nn_pallas
 

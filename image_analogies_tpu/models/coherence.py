@@ -120,10 +120,10 @@ class CoherenceWrapper(Matcher):
         self.sweeps = sweeps
 
     def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig,
-              raw=None, polish_iters=None):
+              raw=None, polish_iters=None, temporal=None):
         nnf, dist = self.base.match(
             f_b, f_a, nnf, key=key, level=level, cfg=cfg, raw=raw,
-            polish_iters=polish_iters,
+            polish_iters=polish_iters, temporal=temporal,
         )
         if cfg.kappa > 0.0:
             nnf, dist = coherence_sweeps(

@@ -218,13 +218,20 @@ class Matcher:
         cfg: SynthConfig,
         raw=None,
         polish_iters=None,
+        temporal=None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """`raw` optionally carries the raw channel planes
         (models.patchmatch.RawPlanes) backing the Pallas tile kernel;
         matchers that work on assembled features ignore it.
         `polish_iters` overrides cfg.pm_polish_iters for this call (the
         driver passes 0 on non-final EM iterations when
-        cfg.pm_polish_final_only); exact-search matchers ignore it."""
+        cfg.pm_polish_final_only); exact-search matchers ignore it.
+        `temporal` optionally carries the previous frame's converged
+        (H, W, 2) field (video subsystem): with cfg.tau > 0 the
+        candidate metric gains the temporal-coherence penalty
+        (models.patchmatch.temporal_penalty_fn); matchers without a
+        penalized-metric formulation ignore it (the video driver only
+        routes temporal fields to matchers that honor them)."""
         raise NotImplementedError
 
     def __repr__(self):

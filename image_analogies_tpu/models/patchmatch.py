@@ -85,6 +85,32 @@ def _shifted(nnf: jnp.ndarray, dy: int, dx: int) -> jnp.ndarray:
     return cand + jnp.array([dy, dx], dtype=nnf.dtype)
 
 
+def temporal_penalty_fn(temporal, tau: float, ha: int, wa: int):
+    """Additive candidate penalty toward the previous frame's mapping
+    (video subsystem): candidate (cy, cx) at pixel q pays
+    tau * ((cy-ty)^2 + (cx-tx)^2) / (ha^2 + wa^2) where (ty, tx) is the
+    previous frame's converged match at q.  Normalizing by the squared
+    A diagonal makes tau the penalty of a full-diagonal divergence, so
+    the weight is resolution-independent.  Returns a function of a flat
+    candidate index array (N,) -> penalty (N,) f32, or None when the
+    term is disabled (tau == 0 or no previous field) — callers gate at
+    trace time so tau=0 graphs stay bit-identical to the pre-video
+    engine."""
+    if temporal is None or tau <= 0.0:
+        return None
+    t_flat = nnf_to_flat(clamp_nnf(temporal, ha, wa), wa)
+    ty = (t_flat // wa).astype(jnp.float32)
+    tx = (t_flat % wa).astype(jnp.float32)
+    scale = float(tau) / float(ha * ha + wa * wa)
+
+    def penalty(idx):
+        cy = (idx // wa).astype(jnp.float32)
+        cx = (idx % wa).astype(jnp.float32)
+        return scale * ((cy - ty) ** 2 + (cx - tx) ** 2)
+
+    return penalty
+
+
 def patchmatch_sweeps(
     f_b: jnp.ndarray,
     f_a: jnp.ndarray,
@@ -95,6 +121,8 @@ def patchmatch_sweeps(
     n_random: int,
     coh_factor: float,
     gather_fn=None,
+    temporal=None,
+    tau: float = 0.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Run `iters` propagate+random-search sweeps; returns (nnf, dist).
 
@@ -108,14 +136,26 @@ def patchmatch_sweeps(
     row gather here, so the streamed path IS this cascade with only
     the fetch mechanism replaced.  None keeps the XLA `jnp.take`
     lowering (the default path, bit-for-bit the historical behavior).
+
+    `temporal`/`tau` (video subsystem): when temporal is a previous
+    frame's (H, W, 2) converged field and tau > 0, every candidate
+    distance — incumbent included — carries the temporal_penalty_fn
+    term, so accept/tie decisions and the returned dist are in the
+    penalized metric.  tau == 0 or temporal None leaves the graph
+    untouched (Python-level gate).
     """
     h, w, d = f_b.shape
     ha, wa = f_a.shape[:2]
     f_b_flat = f_b.reshape(-1, d)
     f_a_flat = f_a.reshape(-1, d)
-    d_fn = lambda idx: candidate_dist(  # noqa: E731
+    base_fn = lambda idx: candidate_dist(  # noqa: E731
         f_b_flat, f_a_flat, idx, gather_fn=gather_fn
     )
+    pen_fn = temporal_penalty_fn(temporal, tau, ha, wa)
+    if pen_fn is None:
+        d_fn = base_fn
+    else:
+        d_fn = lambda idx: base_fn(idx) + pen_fn(idx)  # noqa: E731
 
     nnf = clamp_nnf(nnf, ha, wa)
     dist = d_fn(nnf_to_flat(nnf, wa)).reshape(h, w)
@@ -1087,10 +1127,38 @@ class PatchMatchMatcher(Matcher):
     name = "patchmatch"
 
     def match(self, f_b, f_a, nnf, *, key, level, cfg: SynthConfig,
-              raw: Optional[RawPlanes] = None, polish_iters=None):
+              raw: Optional[RawPlanes] = None, polish_iters=None,
+              temporal=None):
         from ..kernels import resolve_pallas
 
         interpret = resolve_pallas(cfg)
+        # Temporal-coherence term (video subsystem): an active term
+        # routes through the XLA sweeps — the reference formulation of
+        # the penalized metric; the tile kernel's SMEM candidate tables
+        # have no previous-frame field, so dispatching it there would
+        # silently drop the term.  Inactive (temporal None or tau == 0)
+        # falls through to the unchanged dispatch below, bit-identical
+        # to the pre-video graphs.
+        if temporal is not None and cfg.tau > 0.0:
+            nnf, dist = patchmatch_sweeps(
+                f_b,
+                f_a,
+                nnf,
+                key,
+                iters=_pm_iters_for(cfg, *f_a.shape[:2]),
+                n_random=cfg.pm_random_candidates,
+                coh_factor=kappa_factor(cfg.kappa, level),
+                temporal=temporal,
+                tau=cfg.tau,
+            )
+            if cfg.kappa > 0.0:
+                from .coherence import coherence_sweeps
+
+                nnf, dist = coherence_sweeps(
+                    f_b, f_a, nnf, dist,
+                    factor=kappa_factor(cfg.kappa, level), sweeps=2,
+                )
+            return nnf, dist
         if raw is not None and interpret is not None:
             from ..kernels.patchmatch_tile import plan_channels
 

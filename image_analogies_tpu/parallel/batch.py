@@ -472,12 +472,20 @@ def synthesize_batch(
     resume_from: Optional[str] = None,
     resume_strict: bool = False,
     frame_indices=None,
+    return_nnf: bool = False,
     _b_stats=None,
     _frame_offset: int = 0,
     _n_stack: Optional[int] = None,
 ):
     """B' for every frame in `frames` ((F,H,W,3) or (F,H,W)) against the
     shared style pair (a, ap).  Returns stacked B' shaped like `frames`.
+
+    `return_nnf=True` returns `(outputs, nnf)` instead, where `nnf` is
+    the per-frame converged finest-level field as one (F, H, W, 2) int
+    array (lean plane pairs host-stacked, exactly the checkpoint
+    writer's schema) — the video subsystem's warm-start producer
+    (image_analogies_tpu/video); historically these fields were
+    discarded after synthesis.
 
     Frame counts that don't divide the mesh are padded (last frame
     repeated) and trimmed after synthesis, so every device stays busy.
@@ -570,6 +578,7 @@ def synthesize_batch(
         _b_stats = luminance_stats(y_all)
     if frames_per_step and frames_per_step < frames.shape[0]:
         outs = []
+        nnfs = []
         n = frames.shape[0]
         for ci, i in enumerate(range(0, n, frames_per_step)):
             chunk = frames[i : i + frames_per_step]
@@ -594,26 +603,32 @@ def synthesize_batch(
                 if resume_from
                 else None
             )
-            outs.append(
-                jnp.asarray(
-                    synthesize_batch(
-                        a, ap, chunk, chunk_cfg, mesh, progress,
-                        resume_from=chunk_resume,
-                        resume_strict=resume_strict,
-                        frame_indices=(
-                            # Ragged final chunks pad with the last
-                            # frame; its index rides along (ballast
-                            # rows are trimmed above).
-                            (lambda ch: ch + [ch[-1]] * (
-                                frames_per_step - len(ch)
-                            ))(frame_indices[i : i + frames_per_step])
-                            if frame_indices is not None else None
-                        ),
-                        _b_stats=_b_stats, _frame_offset=i, _n_stack=n,
-                    )
-                )[:n_chunk]
+            chunk_res = synthesize_batch(
+                a, ap, chunk, chunk_cfg, mesh, progress,
+                resume_from=chunk_resume,
+                resume_strict=resume_strict,
+                frame_indices=(
+                    # Ragged final chunks pad with the last
+                    # frame; its index rides along (ballast
+                    # rows are trimmed above).
+                    (lambda ch: ch + [ch[-1]] * (
+                        frames_per_step - len(ch)
+                    ))(frame_indices[i : i + frames_per_step])
+                    if frame_indices is not None else None
+                ),
+                return_nnf=return_nnf,
+                _b_stats=_b_stats, _frame_offset=i, _n_stack=n,
             )
-        return jnp.concatenate(outs, axis=0)
+            if return_nnf:
+                chunk_res, chunk_nnf = chunk_res
+                nnfs.append(chunk_nnf[:n_chunk])
+            outs.append(jnp.asarray(chunk_res)[:n_chunk])
+        out = jnp.concatenate(outs, axis=0)
+        if return_nnf:
+            import numpy as _np
+
+            return out, _np.concatenate(nnfs, axis=0)
+        return out
     token = _mesh_token(mesh)
     n_frames = frames.shape[0]
     n_pad = (-n_frames) % mesh.devices.size
@@ -696,7 +711,10 @@ def synthesize_batch(
                 if cfg.color_mode == "luminance" and frames.ndim == 4
                 else None
             )
-            return _finalize_batch(bp, yiq_b, frames, cfg)[:n_frames]
+            out = _finalize_batch(bp, yiq_b, frames, cfg)[:n_frames]
+            if return_nnf:
+                return out, _nnf_host_stack(nnf, n_frames)
+            return out
 
     prologue_t0 = time.perf_counter()
     (
@@ -813,7 +831,24 @@ def synthesize_batch(
                 dist[:n_frames], bp[:n_frames], cfg, fp_shape,
             )
 
-    return _finalize_batch(bp, yiq_b, frames, cfg)[:n_frames]
+    out = _finalize_batch(bp, yiq_b, frames, cfg)[:n_frames]
+    if return_nnf:
+        return out, _nnf_host_stack(nnf, n_frames)
+    return out
+
+
+def _nnf_host_stack(nnf, n_frames: int):
+    """Converged field as one host (F, H, W, 2) int array, padding
+    ballast trimmed: lean plane pairs are stacked on the HOST, exactly
+    as the checkpoint writer does, so the lane-padded (..., 2) stack is
+    never materialized on device."""
+    import numpy as _np
+
+    if isinstance(nnf, tuple):
+        return _np.stack(
+            [_np.asarray(nnf[0]), _np.asarray(nnf[1])], axis=-1
+        )[:n_frames]
+    return _np.asarray(nnf)[:n_frames]
 
 
 def _finalize_batch(bp, yiq_b, frames, cfg: SynthConfig):

@@ -52,6 +52,26 @@ sub-second serving dispatches) and `ladder=[]` (NO degradation ladder:
 every rung flips process-wide kernel modes, which would silently
 change co-tenant and future-request outputs).  A give-up maps to HTTP
 500 for that batch's requests; the daemon keeps serving.
+
+Session affinity (round 14, video/): a request may carry a
+`session_id`, declaring itself the next frame of a video.  The id
+joins the batching-compatibility key — a session's frames NEVER
+coalesce with strangers (and sessionless traffic, whose compat gains
+only a constant None element, batches exactly as before) — and the
+dispatcher routes session batches through a per-session
+`video.VideoStream` held in an LRU table (`max_sessions`), so
+consecutive frames warm-start from the session's carried NNF state
+and pay the delta-sized schedule instead of the full cold pyramid.
+Deliberate contract changes inside a session: output DEPENDS on
+session history (that is the point), the remap statistics freeze on
+the session's OPENING frame's luma bucket (a stream must remap every
+frame against one style normalization or the style itself flickers),
+and a failed dispatch fails its requests AND resets the session to
+cold (the supervisor's retry ladder is calibrated for stateless
+dispatches; replaying a half-stepped stream would double-book its
+ledger).  Session dispatches still consult the executable cache
+(keyed at the stream's own batch-1 grain) so the serving sentinel's
+`hits + misses == dispatches` ledger stays exact.
 """
 
 from __future__ import annotations
@@ -63,6 +83,7 @@ import shutil
 import tempfile
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -130,6 +151,7 @@ class SynthDaemon:
         max_queue_depth: int = 32,
         cache_capacity: int = 8,
         max_retries: int = 1,
+        max_sessions: int = 16,
         flight=None,
         work_dir: Optional[str] = None,
     ):
@@ -155,6 +177,14 @@ class SynthDaemon:
         )
         self.queue = RequestQueue()
         self.max_retries = int(max_retries)
+        if max_sessions < 1:
+            raise ValueError(
+                f"max_sessions must be >= 1 ({max_sessions})"
+            )
+        self.max_sessions = int(max_sessions)
+        # session_id -> video.VideoStream, LRU-evicted at capacity.
+        # Touched only by the dispatcher thread (routes read len()).
+        self._sessions: "OrderedDict[str, Any]" = OrderedDict()
         self.host = host
         self._requested_port = port
         self.live = None  # LiveTelemetryServer after start()
@@ -282,29 +312,36 @@ class SynthDaemon:
         )
 
     # ------------------------------------------------------- serving
-    def _make_request(self, frame: np.ndarray) -> ServeRequest:
-        key = exec_key(frame.shape, self.cfg, self.policy.max_batch)
+    def _make_request(self, frame: np.ndarray,
+                      session: Optional[str] = None) -> ServeRequest:
+        # Session dispatches run one frame at a time through the
+        # stream's own solo-mesh executables, so their cache identity
+        # is the batch-1 grain, not the daemon's padding grain.
+        grain = 1 if session is not None else self.policy.max_batch
+        key = exec_key(frame.shape, self.cfg, grain)
         bucket = None
         if self.cfg.color_mode == "luminance" and \
                 self.cfg.luminance_remap:
             bucket = _luma_bucket(frame)
         return ServeRequest(
-            frame=frame, key=key, compat=key + (bucket,),
-            b_stats=bucket,
+            frame=frame, key=key, compat=key + (bucket, session),
+            b_stats=bucket, session=session,
         )
 
     def _route_synthesize(self, body: Optional[bytes]):
         """POST /synthesize handler (runs on an HTTP handler thread):
         validate -> admit-or-shed -> enqueue -> block on completion."""
         try:
-            frame = _decode_request(body)
+            manifest = _parse_manifest(body)
+            frame = _frame_from_manifest(manifest)
+            session = _session_from_manifest(manifest)
         except ValueError as e:
             return (
                 400,
                 _json_bytes({"status": "rejected", "error": str(e)}),
                 "application/json",
             )
-        req = self._make_request(frame)
+        req = self._make_request(frame, session)
         req.span("queued")
         # Requests books FIRST (the serving sentinel check's ordering
         # contract), then exactly one of admitted/shed.
@@ -385,6 +422,14 @@ class SynthDaemon:
                 "effective_queue_depth": self.admission.effective_depth(),
             },
             "cache": self.cache.snapshot(),
+            "sessions": {
+                "active": len(self._sessions),
+                "max": self.max_sessions,
+                "frames": {
+                    sid: stream.t
+                    for sid, stream in self._sessions.items()
+                },
+            },
             "slo_ms": {
                 phase: {
                     "p50": self._h_latency.quantile(
@@ -431,16 +476,13 @@ class SynthDaemon:
                         self._c_failed.inc()
                         req.done.set()
 
-    def _execute(self, batch: List[ServeRequest],
-                 kind: str = "client") -> None:
-        """One dispatch: cache verdict -> pad to the static grain ->
-        supervised `synthesize_batch` -> demux -> settle requests."""
-        import dataclasses
-
-        from ..parallel.batch import synthesize_batch
-        from ..runtime.supervisor import SupervisorGaveUp, supervise
-
-        grain = self.policy.max_batch
+    def _admit_batch(self, batch: List[ServeRequest],
+                     kind: str) -> float:
+        """Shared dispatch preamble: admission spans/latency, the
+        in-flight gauges, the dispatch counter, and the executable-
+        cache verdict (booked exactly once per dispatch — the serving
+        sentinel's `hits + misses == dispatches` contract).  Returns
+        the admission timestamp."""
         admit_t = time.monotonic()
         for req in batch:
             req.span("admitted")
@@ -457,6 +499,38 @@ class SynthDaemon:
         for req in batch:
             req.cache = cache_status
             req.span(span_name)
+        return admit_t
+
+    def _settle_batch(self, batch: List[ServeRequest],
+                      admit_t: float) -> None:
+        """Shared dispatch epilogue: service latency, done events, and
+        the in-flight gauges back to idle."""
+        service_ms = (time.monotonic() - admit_t) * 1000.0
+        for req in batch:
+            self._h_latency.observe(
+                service_ms, labels={"phase": "service"}
+            )
+            req.done.set()
+        self._inflight = 0
+        self._g_inflight.set(0)
+
+    def _execute(self, batch: List[ServeRequest],
+                 kind: str = "client") -> None:
+        """One dispatch: cache verdict -> pad to the static grain ->
+        supervised `synthesize_batch` -> demux -> settle requests.
+        Session batches (compat pins them to one session id) detour
+        through the per-session warm-start stream instead."""
+        import dataclasses
+
+        from ..parallel.batch import synthesize_batch
+        from ..runtime.supervisor import SupervisorGaveUp, supervise
+
+        if batch[0].session is not None:
+            self._execute_session(batch, kind=kind)
+            return
+
+        grain = self.policy.max_batch
+        admit_t = self._admit_batch(batch, kind)
 
         frames = np.stack([r.frame for r in batch])
         if frames.shape[0] < grain:
@@ -506,14 +580,76 @@ class SynthDaemon:
                     self._c_failed.inc()
         finally:
             shutil.rmtree(ckpt_dir, ignore_errors=True)
-            service_ms = (time.monotonic() - admit_t) * 1000.0
+            self._settle_batch(batch, admit_t)
+
+    # ---------------------------------------------- session dispatch
+    def _session_stream(self, sid: str, proto: ServeRequest):
+        """The session's VideoStream, created on first use (remap
+        stats pinned to the opening frame's luma bucket) and LRU-
+        evicted at `max_sessions` — an evicted session's next frame
+        simply opens a new stream and runs cold."""
+        stream = self._sessions.get(sid)
+        if stream is not None:
+            self._sessions.move_to_end(sid)
+            return stream
+        import dataclasses
+
+        from ..video.sequence import VideoStream
+
+        cfg = dataclasses.replace(self.cfg, save_level_artifacts=None)
+        stream = VideoStream(
+            self.a, self.ap, cfg=cfg, b_stats=proto.b_stats,
+            registry=self.registry,
+        )
+        self._sessions[sid] = stream
+        while len(self._sessions) > self.max_sessions:
+            evicted, _ = self._sessions.popitem(last=False)
+            import logging
+
+            logging.getLogger("image_analogies_tpu").info(
+                "serving session %s evicted (LRU, %d resident)",
+                evicted, len(self._sessions),
+            )
+        return stream
+
+    def _execute_session(self, batch: List[ServeRequest],
+                         kind: str = "client") -> None:
+        """One session dispatch: the batch (all one session, by
+        compat) steps through the session's warm-start stream in
+        arrival order.  No supervisor: a failed step leaves the
+        stream's carried state unsettled, so the dispatch fails its
+        requests and RESETS the session — the next frame opens a
+        fresh stream and runs cold (module docstring)."""
+        sid = batch[0].session
+        admit_t = self._admit_batch(batch, kind)
+        try:
+            stream = self._session_stream(sid, batch[0])
+            outs = []
             for req in batch:
-                self._h_latency.observe(
-                    service_ms, labels={"phase": "service"}
+                outs.append(
+                    np.asarray(stream.step(req.frame), np.float32)
                 )
-                req.done.set()
-            self._inflight = 0
-            self._g_inflight.set(0)
+            for req in batch:
+                req.span("executed")
+            demux(batch, outs)
+            for req in batch:
+                if kind == "client":
+                    self._c_completed.inc()
+        except BaseException as e:  # noqa: BLE001 - daemon survives
+            import logging
+
+            logging.getLogger("image_analogies_tpu").exception(
+                "serving session %s dispatch error (session reset)", sid
+            )
+            self._sessions.pop(sid, None)
+            for req in batch:
+                if not req.done.is_set():
+                    req.status = "failed"
+                    req.error = f"{type(e).__name__}: {e}"
+                    if kind == "client":
+                        self._c_failed.inc()
+        finally:
+            self._settle_batch(batch, admit_t)
 
 
 # ------------------------------------------------------------- payloads
@@ -525,10 +661,17 @@ def _decode_request(body: Optional[bytes]) -> np.ndarray:
     """Parse a /synthesize payload into one float32 (H, W, C) frame.
 
     Wire format: JSON {"image_b64": base64 of the raw pixel buffer,
-    "shape": [H, W, C], "dtype": "float32"|"uint8"} — raw buffers
-    rather than PNG so the daemon has zero image-codec dependencies
-    on the hot path (uint8 payloads are scaled to [0, 1]).  Raises
-    ValueError (-> HTTP 400) on any malformation."""
+    "shape": [H, W, C], "dtype": "float32"|"uint8", optional
+    "session_id": str} — raw buffers rather than PNG so the daemon has
+    zero image-codec dependencies on the hot path (uint8 payloads are
+    scaled to [0, 1]).  Raises ValueError (-> HTTP 400) on any
+    malformation.  (The route handler parses the manifest once and
+    pulls frame + session separately; this wrapper is the frame-only
+    convenience the tests and warmup path use.)"""
+    return _frame_from_manifest(_parse_manifest(body))
+
+
+def _parse_manifest(body: Optional[bytes]) -> dict:
     if not body:
         raise ValueError("empty request body")
     try:
@@ -537,6 +680,24 @@ def _decode_request(body: Optional[bytes]) -> np.ndarray:
         raise ValueError(f"request body is not JSON: {e}") from None
     if not isinstance(manifest, dict):
         raise ValueError("request body is not a JSON object")
+    return manifest
+
+
+def _session_from_manifest(manifest: dict) -> Optional[str]:
+    """The optional session-affinity id: a non-empty string of at most
+    64 characters (the compat key embeds it verbatim; the bound keeps
+    a hostile client from inflating queue snapshots and logs)."""
+    sid = manifest.get("session_id")
+    if sid is None:
+        return None
+    if not isinstance(sid, str) or not 1 <= len(sid) <= 64:
+        raise ValueError(
+            "session_id must be a non-empty string of <= 64 characters"
+        )
+    return sid
+
+
+def _frame_from_manifest(manifest: dict) -> np.ndarray:
     shape = manifest.get("shape")
     if (
         not isinstance(shape, list) or len(shape) != 3

@@ -44,15 +44,19 @@ class ServeRequest:
     """One in-flight synthesis request (daemon-internal).
 
     `compat` is the batching identity: the executable key PLUS the
-    luminance-stats bucket — two requests coalesce only if they share
-    a compiled executable AND the same canonical remap statistics, so
-    a request's output never depends on its co-tenants (the
-    batch-composition-independence contract, serving/daemon.py)."""
+    luminance-stats bucket PLUS the session id (None for sessionless
+    traffic) — two requests coalesce only if they share a compiled
+    executable AND the same canonical remap statistics AND the same
+    session, so a sessionless request's output never depends on its
+    co-tenants (the batch-composition-independence contract,
+    serving/daemon.py) and a session's frames never share a dispatch
+    with strangers."""
 
     frame: Any  # np.ndarray (H, W, C) float32
     key: tuple  # executable key (serving/excache.exec_key)
-    compat: tuple  # key + luminance bucket
+    compat: tuple  # key + (luminance bucket, session id)
     b_stats: Optional[Tuple[float, float]]  # canonical bucket stats
+    session: Optional[str] = None  # session-affinity id (daemon)
     req_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
     enqueue_t: float = field(default_factory=time.monotonic)
     done: threading.Event = field(default_factory=threading.Event,

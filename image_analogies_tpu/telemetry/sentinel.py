@@ -789,6 +789,118 @@ def check_serving(metrics: Optional[dict]) -> Dict:
     )
 
 
+def check_warm_start(metrics: Optional[dict]) -> Dict:
+    """Video warm-start ledger (round 14, video/): every frame the
+    video driver synthesized is booked cold or warm, and the warm
+    sweep counters must be arithmetically possible against their cold
+    equivalents.  Skipped when the counters are silent (no video
+    synthesis in the session).
+
+    Invariants:
+
+      - frames{mode=warm} == ia_warm_start_frames_total: the two warm
+        series book in the same call, so disagreement is ledger
+        corruption — violated.
+      - warm frames imply both sweep series
+        (ia_warm_start_sweeps_total{mode=warm|cold_equiv}) — a warm
+        frame that booked no sweeps is violated.
+      - warm sweeps <= cold-equivalent sweeps: the delta scheduler only
+        ever SHORTENS the schedule (`video/sequence.warm_schedule`
+        floors at one sweep, caps at the full cfg) — violated
+        otherwise.
+      - cold frames >= streams when any frame ran warm: each stream's
+        head frame is cold by construction; fewer cold frames than
+        streams means a head frame booked warm.  MORE cold frames than
+        streams grades degraded, not violated — a mid-stream frame can
+        legitimately fall back cold (resume without a usable seed), but
+        it deserves eyes.
+      - cold_equiv non-divisible by the warm frame count grades
+        degraded: per-frame cold equivalents are a per-stream constant
+        (levels x em_iters x pm_iters), so non-integral per-frame
+        values mean mixed-config streams or drift.
+
+    The exact sweep arithmetic against the config (which bucket each
+    frame's measured delta selects) needs the run's cfg and delta
+    series, which the metrics exposition doesn't carry — the VIDEO
+    bench record pins that end of the model (tools/check_video.py);
+    this check owns the config-free invariants."""
+    frames = _counter_values(metrics, "ia_video_frames_total")
+    warm_booked = sum(
+        _counter_values(metrics, "ia_warm_start_frames_total").values()
+    )
+    sweeps = _counter_values(metrics, "ia_warm_start_sweeps_total")
+    streams = sum(
+        _counter_values(metrics, "ia_video_streams_total").values()
+    )
+    if not frames and not warm_booked and not sweeps:
+        return _check(
+            "warm_start", "skipped",
+            detail="no video synthesis in this session",
+        )
+    n_cold = n_warm = 0.0
+    for key, v in frames.items():
+        if dict(key).get("mode") == "warm":
+            n_warm += v
+        else:
+            n_cold += v
+    warm_sweeps = cold_equiv = 0.0
+    for key, v in sweeps.items():
+        if dict(key).get("mode") == "warm":
+            warm_sweeps += v
+        elif dict(key).get("mode") == "cold_equiv":
+            cold_equiv += v
+    observed = {
+        "frames_cold": n_cold, "frames_warm": n_warm,
+        "warm_frames_booked": warm_booked, "streams": streams,
+        "warm_sweeps": warm_sweeps, "cold_equiv_sweeps": cold_equiv,
+    }
+    problems = []
+    degraded = []
+    if n_warm != warm_booked:
+        problems.append(
+            f"frames{{mode=warm}} ({n_warm}) != "
+            f"ia_warm_start_frames_total ({warm_booked}) — the two warm "
+            "series book in the same call"
+        )
+    if warm_booked and (warm_sweeps <= 0 or cold_equiv <= 0):
+        problems.append(
+            f"{warm_booked} warm frames booked but sweep counters are "
+            f"silent (warm {warm_sweeps}, cold_equiv {cold_equiv})"
+        )
+    if warm_sweeps > cold_equiv:
+        problems.append(
+            f"warm sweeps ({warm_sweeps}) exceed the cold equivalent "
+            f"({cold_equiv}) — the delta scheduler only shortens"
+        )
+    if warm_booked and streams and n_cold < streams:
+        problems.append(
+            f"cold frames ({n_cold}) < streams ({streams}) — a stream's "
+            "head frame booked warm"
+        )
+    elif warm_booked and streams and n_cold > streams:
+        degraded.append(
+            f"cold frames ({n_cold}) > streams ({streams}) — "
+            "mid-stream warm misses (seedless resume?)"
+        )
+    if warm_booked and cold_equiv and (cold_equiv % warm_booked):
+        degraded.append(
+            f"cold_equiv ({cold_equiv}) not divisible by warm frames "
+            f"({warm_booked}) — mixed-config streams or ledger drift"
+        )
+    status = (
+        "violated" if problems else ("degraded" if degraded else "ok")
+    )
+    return _check(
+        "warm_start", status,
+        expected="warm frame series agree; warm sweeps present and "
+        "<= cold equivalent; one cold head frame per stream",
+        observed=observed,
+        detail="video warm-start ledger"
+        + ("" if not (problems or degraded)
+           else " — " + "; ".join(problems + degraded)),
+    )
+
+
 def check_instrument_drift(record: Optional[dict]) -> Dict:
     """Bench records: the host-differenced loop figure diverging more
     than INSTRUMENT_DRIFT_FRAC from the trace-derived figure is
@@ -845,6 +957,7 @@ def evaluate_health(
         check_straggler_skew(metrics),
         check_recovery(metrics),
         check_serving(metrics),
+        check_warm_start(metrics),
     ]
     if bench_record is not None:
         checks.append(check_instrument_drift(bench_record))

@@ -1,0 +1,31 @@
+"""Video analogies: temporal synthesis subsystem (round 14).
+
+Frame-sequence synthesis layered on the batch engine — NNF warm-start
+between consecutive frames, a temporal-coherence term in the candidate
+metric (`SynthConfig.tau`), and delta-cost scheduling of warm frames.
+See `sequence` for the mechanics and the `IA_VIDEO_WARM` seam.
+"""
+
+from .sequence import (  # noqa: F401
+    VideoStream,
+    field_delta,
+    flicker_metric,
+    frame_delta,
+    set_warm_mode,
+    synthesize_video,
+    warm_enabled,
+    warm_mode,
+    warm_schedule,
+)
+
+__all__ = [
+    "VideoStream",
+    "field_delta",
+    "flicker_metric",
+    "frame_delta",
+    "set_warm_mode",
+    "synthesize_video",
+    "warm_enabled",
+    "warm_mode",
+    "warm_schedule",
+]

@@ -1,0 +1,873 @@
+"""Video analogies — temporal synthesis over the batch engine (round 14).
+
+Frame sequences share one (A, A') style pair, and consecutive frames of
+real video are nearly identical — so the per-frame batch runner
+(`parallel/batch.synthesize_batch`), which synthesizes every frame from
+a cold random init, re-pays the full pyramid schedule F times for work
+that barely changes.  This module layers three temporal mechanisms on
+the EXISTING engine (no forked level bodies; the batch machinery is
+called with different state, not reimplemented):
+
+1. **NNF warm-start** (`IA_VIDEO_WARM=on|off`, `set_warm_mode`): EVERY
+   pyramid level of frame t is seeded with frame t-1's CONVERGED
+   (nnf, B') state at that level through `_level_state_glue`'s
+   ``prev_kind="direct"`` arm, replacing the random init (coarsest) and
+   the upsample chain (finer levels).  Coarsest-only seeding — the
+   obvious smaller design — measured ~1.7 dB below cold at the minimum
+   warm schedule, because finer levels restarted from a single-sweep
+   upsample; per-level seeding starts each level at the previous
+   frame's optimum so one sweep suffices on low-delta frames.  ``off``
+   dispatches the whole sequence to
+   `synthesize_batch(frames_per_step=1)` — bit identity with the
+   per-frame batch runner is structural, not an equality proof.
+
+2. **Temporal-coherence term** (`cfg.tau`, plumbed like kappa through
+   the matcher interface): warm frames pass frame t-1's converged field
+   at EVERY level as the matcher's `temporal` anchor, and PatchMatch
+   candidates pay `models/patchmatch.temporal_penalty_fn` for diverging
+   from it.  tau == 0 is a trace-time gate — those frames dispatch the
+   exact `_batch_level_fn` graphs the batch runner compiles
+   (`_video_level_fn` is a separate cached twin, so the tau=0 path
+   cannot even reach a changed graph).
+
+3. **Delta-cost scheduling** (`warm_schedule`): warm frames run a
+   shortened PM/EM schedule sized by the measured change fraction
+   between the incoming frame and the frame whose converged state seeds
+   it (`frame_delta` — the converged FIELD's own change fraction is
+   dominated by optimizer stochasticity, see `field_delta`'s docstring),
+   quantized to `_SCALE_BUCKETS` so the compile count stays bounded.
+   The shortened schedule is a `dataclasses.replace` of (pm_iters,
+   em_iters) — the cost/byte models (`level_eta_cost_units`, the
+   sentinel ledger) are parameterized on cfg, so warm frames are priced
+   by the SAME model evaluated at the warm schedule (one-model
+   discipline; no second formula to drift).
+
+Per-run accounting: `ia_warm_start_frames_total`,
+`ia_warm_start_sweeps_total{mode=warm|cold_equiv}` (sentinel
+`warm_start` check), and the `ia_video_flicker` gauge
+(`flicker_metric`: mean per-pixel temporal delta of the stylized
+output — the quantity the tau term exists to reduce).
+
+`VideoStream` is the stateful per-frame entry (the serving daemon's
+session-affinity path drives it one request at a time);
+`synthesize_video` wraps a whole in-memory stack with checkpoint/resume
+parity (per-frame `frames_{t:05d}` subdirectories — the SAME layout the
+chunked batch runner writes, so cold-frame checkpoints interoperate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+import os
+import time
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..config import SynthConfig
+from ..models.analogy import (
+    _assemble_fa_fn,
+    _save_level,
+    _strip_noncompute,
+    level_eta_cost_units,
+    make_em_step,
+    plan_level,
+    record_level_span,
+    record_prologue,
+    resume_prologue,
+    shard_sync_walls,
+)
+from ..ops.color import rgb_to_yiq
+from ..ops.features import assemble_features
+from ..ops.remap import luminance_stats
+from ..parallel.batch import (
+    _MESHES,
+    _batch_feature_table_bytes,
+    _batch_level_fn,
+    _batch_prologue_fn,
+    _finalize_batch,
+    _mesh_token,
+    _nnf_host_stack,
+    synthesize_batch,
+)
+from ..parallel.mesh import BATCH_AXIS, batch_sharding, make_mesh, replicated
+
+
+# ---------------------------------------------------------------------------
+# Warm-start seam.
+
+_WARM_MODES = ("on", "off")
+_WARM_MODE = os.environ.get("IA_VIDEO_WARM", "on")
+
+
+def warm_mode() -> str:
+    return _WARM_MODE
+
+
+def warm_enabled() -> bool:
+    return _WARM_MODE != "off"
+
+
+def set_warm_mode(mode: str) -> None:
+    """Install the video warm-start mode process-wide (`IA_VIDEO_WARM`).
+
+    Unlike the polish/compression seams this does NOT clear the
+    compiled level caches: no cached graph resolves `_WARM_MODE` at
+    trace time — the seam only selects which Python driver path runs
+    (per-frame batch dispatch vs the warm loop), and both paths' graphs
+    stay valid across a flip."""
+    global _WARM_MODE
+    if mode not in _WARM_MODES:
+        raise ValueError(
+            f"video warm mode {mode!r} names neither 'on' nor 'off'"
+        )
+    _WARM_MODE = mode
+
+
+# ---------------------------------------------------------------------------
+# Temporal signals.
+
+# Frame-change fraction at (or above) which a warm frame runs the FULL
+# schedule.  Below it the schedule scales down linearly: a static scene
+# measures delta ~0 and runs the minimum bucket.
+_DELTA_FULL = 0.5
+# Schedule scale is quantized to this many buckets (1/N .. N/N): every
+# bucket is a distinct (pm_iters, em_iters) replace and therefore a
+# distinct set of compiled level graphs, so the quantization bounds the
+# compile count per run at _SCALE_BUCKETS + 1 (cold).
+_SCALE_BUCKETS = 3
+
+
+def field_delta(nnf_a, nnf_b) -> float:
+    """Fraction of pixels whose mapping changed between two converged
+    (..., H, W, 2) fields.
+
+    Observability metric, NOT the warm scheduler's signal: PatchMatch
+    converges to one of many near-equivalent optima per pixel and the
+    per-frame PRNG stream makes consecutive frames land on different
+    ones, so this fraction has a measured noise floor of ~25-45% EVEN
+    ON A STATIC SCENE (and distance-thresholding does not rescue it —
+    competing matches differ by far more than a few percent of the mean
+    match distance at practical iteration counts).  The scheduler uses
+    `frame_delta` instead."""
+    a = np.asarray(nnf_a)
+    b = np.asarray(nnf_b)
+    if a.shape != b.shape:
+        return 1.0
+    return float(np.mean(np.any(a != b, axis=-1)))
+
+
+def frame_delta(frame_a, frame_b, eps: float = 1.0 / 255.0) -> float:
+    """Fraction of pixels that changed (any channel by more than `eps`)
+    between two input frames — the warm scheduler's change signal.
+
+    The NNF field's own change fraction is dominated by optimizer
+    stochasticity (see `field_delta`), so the schedule is sized from
+    the signal the field change is a RESPONSE to: how much of the
+    incoming frame actually differs from the one whose converged state
+    seeds it.  Host-side, costs one array compare, and is available
+    BEFORE the frame is synthesized — the schedule reacts to this
+    frame's change, not the previous frame's.  `eps` defaults to one
+    8-bit quantization step."""
+    a = np.asarray(frame_a, np.float32)
+    b = np.asarray(frame_b, np.float32)
+    if a.shape != b.shape:
+        return 1.0
+    diff = np.abs(a - b) > eps
+    if diff.ndim == 3:
+        diff = np.any(diff, axis=-1)
+    return float(np.mean(diff))
+
+
+def warm_schedule(cfg: SynthConfig, delta: float):
+    """(pm_iters, em_iters) for a warm frame that measured change
+    fraction `delta` against the frame seeding it (`frame_delta`).
+
+    Linear in delta up to `_DELTA_FULL`, quantized to `_SCALE_BUCKETS`
+    scale levels, floored at TWO PM sweeps (or cfg.pm_iters if fewer)
+    and one EM iteration — a warm seed still needs propagation over the
+    new frame's features (the seed is last frame's optimum, not this
+    frame's), and a single sweep measured ~0.3-0.5 dB below the cold
+    schedule on the static-scene gate where two sweeps hold it."""
+    frac = min(1.0, max(0.0, float(delta)) / _DELTA_FULL)
+    bucket = max(1, int(math.ceil(frac * _SCALE_BUCKETS)))
+    scale = bucket / float(_SCALE_BUCKETS)
+    pm_floor = min(2, cfg.pm_iters)
+    pm_w = max(pm_floor, int(round(cfg.pm_iters * scale)))
+    em_w = max(1, int(round(cfg.em_iters * scale)))
+    return pm_w, em_w
+
+
+def flicker_metric(outputs) -> float:
+    """Mean per-pixel temporal delta of the stylized output: the mean
+    over consecutive frame pairs of mean |out_t - out_{t-1}|.  The
+    temporal-coherence term exists to push this down; the bench records
+    it with and without tau.  0.0 for sequences shorter than 2."""
+    out = np.asarray(outputs, np.float32)
+    if out.shape[0] < 2:
+        return 0.0
+    return float(np.mean(np.abs(out[1:] - out[:-1])))
+
+
+# ---------------------------------------------------------------------------
+# Temporal level function: `_batch_level_fn_cached` with ONE extra
+# sharded argument.
+
+
+def _video_level_fn(cfg: SynthConfig, level: int, has_coarse: bool,
+                    mesh_key, fa_external: bool = False,
+                    prev_kind: str = "stacked"):
+    return _video_level_fn_cached(
+        _strip_noncompute(cfg), level, has_coarse, mesh_key, fa_external,
+        prev_kind,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _video_level_fn_cached(cfg: SynthConfig, level: int, has_coarse: bool,
+                           mesh_key, fa_external: bool = False,
+                           prev_kind: str = "stacked"):
+    """`parallel/batch._batch_level_fn_cached` with one extra sharded
+    argument: the previous frame's converged field at this level,
+    threaded into every EM step as the matcher's `temporal` anchor.
+
+    Kept as a separate cached twin instead of a parameter on the batch
+    function so the tau=0 / warm-off / batch paths keep dispatching
+    exactly the graphs they always compiled — their bit-identity to the
+    historical runner is by construction, not by equality proof.  Only
+    the fused patchmatch regime comes here (the caller gates on
+    `cfg.tau > 0`, `not plan.lean`, `plan.fuse`, matcher ==
+    "patchmatch"); with a temporal anchor present the matcher takes the
+    XLA sweep path, which never consumes kernel A-planes — so unlike
+    the batch twin this body skips `_level_plan`/`prepare_a_planes`
+    entirely rather than relying on XLA to dead-code them."""
+    mesh = _MESHES[mesh_key]
+    shard = batch_sharding(mesh)
+    repl = replicated(mesh)
+    step_final = make_em_step(cfg, level, has_coarse)
+    step_mid = (
+        make_em_step(cfg, level, has_coarse, polish_iters=0)
+        if cfg.pm_polish_final_only
+        else step_final
+    )
+
+    def run_level(src_a_l, flt_a_l, src_a_c, flt_a_c, src_b_l, src_b_c,
+                  raw_b_l, copy_a_l, prev_nnf, prev_bp, level_key,
+                  frame_idx, temporal, f_a_ext=None, proj_ext=None):
+        from ..models.analogy import _level_state_glue
+        from ..ops.pca import fit_and_project
+
+        h, w = src_b_l.shape[1:3]
+        ha, wa = src_a_l.shape[:2]
+        if fa_external:
+            f_a, proj = f_a_ext, proj_ext
+        else:
+            f_a = assemble_features(
+                src_a_l, flt_a_l, cfg, src_a_c, flt_a_c
+            )
+            f_a, proj = fit_and_project(f_a, cfg.pca_dims)
+
+        def frame_keys(base_key):
+            return jax.vmap(
+                lambda i: jax.random.fold_in(base_key, i)
+            )(frame_idx)
+
+        nnf, flt_bp, flt_bp_coarse = _level_state_glue(
+            False, prev_kind, prev_nnf, prev_bp, raw_b_l, h, w, ha, wa,
+            frame_keys(jax.random.fold_in(level_key, 0x1217)),
+            batched=True,
+        )
+
+        mk_vstep = lambda s: jax.vmap(  # noqa: E731
+            s,
+            in_axes=(0, 0, 0, 0, None, None, 0, 0, None, None, 0),
+        )
+        vstep_final, vstep_mid = mk_vstep(step_final), mk_vstep(step_mid)
+        dist = bp = None
+        for em in range(cfg.em_iters):
+            vstep = (
+                vstep_final if em == cfg.em_iters - 1 else vstep_mid
+            )
+            nnf, dist, bp = vstep(
+                src_b_l,
+                flt_bp,
+                src_b_c if has_coarse else src_b_l,
+                flt_bp_coarse if has_coarse else flt_bp,
+                f_a,
+                copy_a_l,
+                nnf,
+                frame_keys(jax.random.fold_in(level_key, em)),
+                proj,
+                None,
+                temporal,
+            )
+            flt_bp = bp
+        return nnf, dist, bp
+
+    return jax.jit(
+        run_level,
+        in_shardings=(
+            repl, repl, repl, repl, shard, shard, shard, repl,
+            shard, shard, repl, repl, shard, repl, repl,
+        ),
+        out_shardings=(shard, shard, shard),
+    )
+
+
+def _pad_rows(x, n_pad: int):
+    """Re-pad a trimmed (1, ...) state array to the mesh's frame grain
+    (repeat the single real row — same ballast rule as the batch
+    runner's `_pad_tail`)."""
+    x = jnp.asarray(x)
+    if n_pad:
+        x = jnp.concatenate(
+            [x, jnp.repeat(x[-1:], n_pad, axis=0)], axis=0
+        )
+    return x
+
+
+def _ckpt_bps(resume_dir: Optional[str], levels: int):
+    """Per-level B' from a resumed frame's checkpoint tree
+    (`resume_prologue`'s aux fill carries per-level (nnf, dist) but not
+    per-level bp — the resume contract only needs the FINEST bp; the
+    per-level warm seed needs every level's).  Best-effort: a level
+    whose checkpoint is missing or unreadable is simply absent, and the
+    next frame seeds the levels it can."""
+    bps = {}
+    if not resume_dir:
+        return bps
+    for level in range(levels):
+        path = os.path.join(resume_dir, f"level_{level}.npz")
+        try:
+            with np.load(path) as z:
+                bps[level] = np.asarray(z["bp"])
+        except Exception:  # noqa: BLE001 - seed is best-effort
+            continue
+    return bps
+
+
+class VideoStream:
+    """Stateful per-frame warm-start synthesis: one stream == one video.
+
+    Each `step(frame)` runs one frame through the batch engine's level
+    machinery on a 1-frame stack (default mesh: `make_mesh(1)` — a
+    single frame sharded over an N-device mesh is 1 real row plus N-1
+    ballast rows, and outputs are mesh-invariant, so the solo mesh just
+    skips the ballast).  Frame 0 runs the full cold schedule and is
+    bit-identical to the batch runner's frame 0 (same prologue, level
+    graphs, whole-stack remap stats when provided, and frame-index PRNG
+    identity).  Later frames warm-start from the carried state when the
+    seam is on.
+
+    Style luminance statistics freeze on whatever `b_stats` the
+    constructor gets (the whole-stack stats from `synthesize_video`, a
+    luma-bucket from the serving daemon) or, when omitted, on the first
+    frame's own stats — a stream must remap every frame against the
+    SAME style normalization or the style itself would flicker.
+    """
+
+    def __init__(self, a, ap, cfg: Optional[SynthConfig] = None,
+                 mesh=None, b_stats=None, n_stack: Optional[int] = None,
+                 progress=None, registry=None):
+        from ..telemetry.spans import as_tracer
+
+        self.cfg = cfg or SynthConfig()
+        self.registry = registry  # None: process default at book time
+        self.mesh = mesh or make_mesh(1)
+        self.token = _mesh_token(self.mesh)
+        self.a = jnp.asarray(a, jnp.float32)
+        self.ap = jnp.asarray(ap, jnp.float32)
+        self.b_stats = b_stats
+        self.n_stack = n_stack
+        self.tracer = as_tracer(progress)
+        self.t = 0
+        # Carried warm state (all trimmed to the 1 real frame):
+        self._fields = None       # {level: (1, h, w, 2) np} converged
+        self._bps = None          # {level: (1, h, w[, C]) np} conv. B'
+        self._prev_finest = None  # frame t-1 finest field (field_delta)
+        self._prev2_finest = None
+        self._prev_frame = None   # frame t-1 input (the delta signal)
+        self.finest_history = []  # per-frame (h, w, 2) converged fields
+        # Per-run accounting (the bench/aux consumers):
+        self.deltas = []          # measured delta per frame (None: cold)
+        self.schedules = []       # (pm_iters, em_iters) actually run
+        self.warm_frames = 0
+        self.run_units = 0.0      # modeled units actually scheduled
+        self.cold_units = 0.0     # modeled units of the cold equivalent
+
+    def step(self, frame, *, resume_root: Optional[str] = None,
+             resume_strict: bool = False):
+        """Synthesize the next frame; returns stylized (H, W[, 3]).
+
+        `resume_root`: root checkpoint directory of a prior run — this
+        frame resumes from `frames_{t:05d}` under it (the same per-item
+        subdirectory layout the chunked batch runner uses, so warm-off
+        and warm-on runs share checkpoint trees for cold frames)."""
+        cfg = self.cfg
+        t = self.t
+        can_warm = (
+            warm_enabled() and t > 0
+            and self._fields is not None and bool(self._bps)
+        )
+        if can_warm:
+            # Sized from THIS frame's measured change against the frame
+            # whose converged state seeds it (frame_delta docstring).
+            delta = (
+                1.0 if self._prev_frame is None
+                else frame_delta(frame, self._prev_frame)
+            )
+            pm_w, em_w = warm_schedule(cfg, delta)
+            run_cfg = dataclasses.replace(
+                cfg, pm_iters=pm_w, em_iters=em_w
+            )
+            self.deltas.append(delta)
+        else:
+            run_cfg = cfg
+            self.deltas.append(None)
+        self.schedules.append((run_cfg.pm_iters, run_cfg.em_iters))
+
+        out, fields, bps, shapes, seeded, ran = self._run_frame(
+            frame, run_cfg, can_warm, resume_root, resume_strict
+        )
+
+        reg = self.registry
+        if reg is None:
+            from ..telemetry.metrics import get_registry
+
+            reg = get_registry()
+        if t == 0:
+            reg.counter(
+                "ia_video_streams_total",
+                "video streams started (each stream's head frame is "
+                "cold)",
+            ).inc()
+        if ran:
+            # Fully-resumed frames scheduled no synthesis: the ledger
+            # (and the modeled-unit tally) records THIS run's work.
+            reg.counter(
+                "ia_video_frames_total",
+                "video frames synthesized, by schedule mode",
+            ).inc(labels={"mode": "warm" if seeded else "cold"})
+            self.run_units += sum(
+                level_eta_cost_units(
+                    run_cfg, shapes, self.a.shape[:2], runner="batch"
+                ).values()
+            )
+            self.cold_units += sum(
+                level_eta_cost_units(
+                    cfg, shapes, self.a.shape[:2], runner="batch"
+                ).values()
+            )
+        if seeded:
+            self.warm_frames += 1
+            _book_warm_frame(cfg, run_cfg, len(shapes), reg)
+
+        finest = fields.get(0)
+        self._prev2_finest = self._prev_finest
+        self._prev_finest = finest
+        self._prev_frame = np.asarray(frame, np.float32)
+        if finest is not None:
+            self.finest_history.append(np.asarray(finest)[0])
+        self._fields = fields
+        self._bps = bps
+        self.t += 1
+        return out
+
+    # -- one frame through the batch level machinery -------------------
+
+    def _run_frame(self, frame, run_cfg: SynthConfig, warm: bool,
+                   resume_root, resume_strict):
+        from ..runtime.faults import fire as _fault_fire
+
+        cfg, mesh, token, tracer = self.cfg, self.mesh, self.token, \
+            self.tracer
+        t = self.t
+        frames = jnp.asarray(frame, jnp.float32)
+        if frames.ndim == 2 or (frames.ndim == 3 and frames.shape[-1] in (1, 3)):
+            frames = frames[None]
+        if self.b_stats is None and cfg.color_mode == "luminance" \
+                and cfg.luminance_remap:
+            y = rgb_to_yiq(frames)[..., 0] if frames.ndim == 4 else frames
+            self.b_stats = luminance_stats(y)
+
+        save_root = cfg.save_level_artifacts
+        if save_root:
+            run_cfg = dataclasses.replace(
+                run_cfg,
+                save_level_artifacts=os.path.join(
+                    save_root, f"frames_{t:05d}"
+                ),
+            )
+        resume_dir = (
+            os.path.join(resume_root, f"frames_{t:05d}")
+            if resume_root else None
+        )
+
+        n_pad = (-1) % mesh.devices.size
+        # xfer injection point: this frame's host->device transfer.
+        _fault_fire("xfer", 0)
+        if n_pad:
+            frames = jnp.concatenate(
+                [frames, jnp.repeat(frames[-1:], n_pad, axis=0)], axis=0
+            )
+        frames = jax.device_put(frames, batch_sharding(mesh))
+
+        levels = cfg.clamp_levels(self.a.shape[:2], frames.shape[1:3])
+        key = jax.random.PRNGKey(cfg.seed)
+        frame_idx = jnp.full((frames.shape[0],), t, dtype=jnp.int32)
+        # Checkpoint identity: exactly the batch runner's per-chunk
+        # fingerprint for a frames_per_step=1 run — (1, H, W[, C],
+        # whole-stack length, this frame's offset) — so cold frames'
+        # checkpoints interoperate between warm-off and warm-on runs
+        # (warm frames stamp run_cfg's shortened schedule and bind to
+        # it).  Streams with unknown total length identify as t+1.
+        n_stack = self.n_stack if self.n_stack is not None else t + 1
+        fp_shape = (1,) + tuple(frames.shape[1:]) + (n_stack, t)
+
+        start_level = levels - 1
+        bp = nnf = None
+        aux = {}
+        resumed = resume_prologue(
+            resume_dir, levels, run_cfg, fp_shape, tracer,
+            strict=resume_strict,
+        )
+        if resumed is not None:
+            start_level, nnf, bp, aux = resumed
+            if n_pad:
+                def _pad_tail(x):
+                    return jnp.concatenate(
+                        [x, jnp.repeat(x[-1:], n_pad, axis=0)], axis=0
+                    )
+
+                nnf = (
+                    tuple(_pad_tail(p) for p in nnf)
+                    if isinstance(nnf, tuple) else _pad_tail(nnf)
+                )
+                bp = _pad_tail(bp)
+            if start_level < 0:
+                # Fully-checkpointed frame: finalize directly; the
+                # carried warm state comes from the checkpoint's own
+                # per-level fields (aux) + a direct coarsest-B' read.
+                yiq_b = (
+                    jax.vmap(rgb_to_yiq)(frames)
+                    if cfg.color_mode == "luminance" and frames.ndim == 4
+                    else None
+                )
+                out = _finalize_batch(bp, yiq_b, frames, run_cfg)[:1]
+                fields = {
+                    lv: np.asarray(a_nnf)[:1]
+                    for lv, (a_nnf, _d) in aux.items()
+                }
+                bps = _ckpt_bps(resume_dir, levels)
+                # Nothing ran: a fully-checkpointed frame books no warm
+                # work (the ledger records THIS run's scheduling).
+                return (
+                    np.asarray(out[0]), fields, bps,
+                    _pyr_shapes(frames.shape[1:3], levels), False, False,
+                )
+
+        prologue_t0 = time.perf_counter()
+        (
+            pyr_src_a, pyr_flt_a, pyr_copy_a, pyr_src_b, pyr_raw_b, yiq_b
+        ) = _batch_prologue_fn(cfg, levels, token)(
+            self.a, self.ap, frames, self.b_stats
+        )
+        record_prologue(
+            tracer, pyr_raw_b, levels, prologue_t0, cfg=run_cfg,
+            a_hw=self.a.shape[:2], batched=True, runner="video",
+        )
+
+        seed_fields = self._fields if warm else None
+        seed_bps = self._bps if warm else None
+        fields = {}
+        bps = {}
+        seeded = False
+        shapes = [
+            [int(s) for s in pyr_raw_b[lv].shape[1:3]]
+            for lv in range(levels)
+        ]
+        for level in range(start_level, -1, -1):
+            _fault_fire("level", level)
+            level_t0 = time.perf_counter()
+            h, w = pyr_src_b[level].shape[1:3]
+            has_coarse = level < levels - 1
+            ha, wa = pyr_src_a[level].shape[:2]
+            plan = plan_level(
+                run_cfg, level, pyr_src_a[level], pyr_flt_a[level],
+                has_coarse, h, w, prev_nnf=nnf,
+                table_bytes=_batch_feature_table_bytes(
+                    frames.shape[0], h, w, ha, wa
+                ),
+                work_scale=frames.shape[0],
+                brute_lean=False,
+            )
+            prev_kind = plan.prev_kind
+            if (
+                warm and resumed is None
+                and seed_fields is not None and level in seed_fields
+                and tuple(np.shape(seed_fields[level])[1:3]) == (h, w)
+                and seed_bps is not None and level in seed_bps
+                and tuple(np.shape(seed_bps[level])[1:3]) == (h, w)
+                and not plan.lean
+                and (
+                    not has_coarse
+                    or (
+                        level + 1 in seed_bps
+                        and tuple(np.shape(seed_bps[level + 1])[1:3])
+                        == tuple(pyr_src_b[level + 1].shape[1:3])
+                    )
+                )
+            ):
+                # Warm seed: last frame's converged state at THIS level
+                # stands in for the init ('direct' glue arm) — every
+                # level, not just the coarsest (coarsest-only seeding
+                # measured ~1.7 dB below cold at the minimum warm
+                # schedule; module docstring).  A non-coarsest level
+                # additionally hands the glue the coarse-resolution B'
+                # as the second element of a (fine, coarse) tuple — the
+                # EM features consume the coarse plane at its own
+                # resolution.
+                prev_kind = "direct"
+                nnf = _pad_rows(seed_fields[level], n_pad)
+                bp = _pad_rows(seed_bps[level], n_pad)
+                if has_coarse:
+                    bp = (bp, _pad_rows(seed_bps[level + 1], n_pad))
+                seeded = True
+            use_temporal = (
+                warm and cfg.tau > 0.0 and cfg.matcher == "patchmatch"
+                and not plan.lean and plan.fuse
+                and seed_fields is not None and level in seed_fields
+                and tuple(np.shape(seed_fields[level])[1:3]) == (h, w)
+            )
+            f_a_ext = proj_ext = None
+            if plan.fa_external:
+                f_a_ext, proj_ext = _assemble_fa_fn(
+                    run_cfg, has_coarse
+                )(
+                    pyr_src_a[level],
+                    pyr_flt_a[level],
+                    pyr_src_a[level + 1] if has_coarse else None,
+                    pyr_flt_a[level + 1] if has_coarse else None,
+                )
+            args = (
+                pyr_src_a[level],
+                pyr_flt_a[level],
+                pyr_src_a[level + 1] if has_coarse else None,
+                pyr_flt_a[level + 1] if has_coarse else None,
+                pyr_src_b[level],
+                pyr_src_b[level + 1] if has_coarse else None,
+                pyr_raw_b[level],
+                pyr_copy_a[level],
+                nnf,
+                bp,
+                jax.random.fold_in(key, level),
+                frame_idx,
+            )
+            _fault_fire("kernel", level)
+            if use_temporal:
+                run = _video_level_fn(
+                    run_cfg, level, has_coarse, token, plan.fa_external,
+                    prev_kind,
+                )
+                temporal = _pad_rows(seed_fields[level], n_pad)
+                nnf, dist, bp = run(*args, temporal, f_a_ext, proj_ext)
+            else:
+                run = _batch_level_fn(
+                    run_cfg, level, has_coarse, token, plan.fa_external,
+                    plan.lean, prev_kind, plan.fuse,
+                )
+                nnf, dist, bp = run(*args, f_a_ext, proj_ext)
+
+            if tracer.enabled:
+                n_sh = int(mesh.devices.size)
+                per = dist.shape[0] // n_sh
+                walls = shard_sync_walls(
+                    level_t0,
+                    [dist[i * per:(i + 1) * per] for i in range(n_sh)],
+                ) if per else None
+                record_level_span(
+                    tracer, run_cfg, level_t0, level, h, w,
+                    float(dist.mean()), shard_walls=walls,
+                    shard_axis=BATCH_AXIS,
+                )
+            fields[level] = _nnf_host_stack(nnf, 1)
+            bps[level] = np.asarray(bp[:1])
+            if run_cfg.save_level_artifacts:
+                nnf_save = nnf
+                if isinstance(nnf, tuple):
+                    nnf_save = np.stack(
+                        [np.asarray(nnf[0]), np.asarray(nnf[1])],
+                        axis=-1,
+                    )
+                _save_level(
+                    run_cfg.save_level_artifacts, level, nnf_save[:1],
+                    dist[:1], bp[:1], run_cfg, fp_shape,
+                )
+
+        # Partial resume: levels finer than the resume point ran live;
+        # already-checkpointed coarser levels' (field, B') come from the
+        # aux fill plus a direct checkpoint read, so the next frame
+        # still has every level's seed.
+        for lv, (a_nnf, _d) in aux.items():
+            fields.setdefault(lv, np.asarray(a_nnf)[:1])
+        if resume_dir:
+            for lv, b in _ckpt_bps(resume_dir, levels).items():
+                bps.setdefault(lv, b)
+
+        out = _finalize_batch(bp, yiq_b, frames, run_cfg)[:1]
+        return np.asarray(out[0]), fields, bps, shapes, seeded, True
+
+
+def _pyr_shapes(hw, levels: int):
+    """Host-side pyramid shape ladder ((h, w) per level, finest first)
+    for cost-model pricing when the pyramids themselves were skipped
+    (fully-resumed frames)."""
+    h, w = int(hw[0]), int(hw[1])
+    return [
+        [max(1, h // (2 ** lv)), max(1, w // (2 ** lv))]
+        for lv in range(levels)
+    ]
+
+
+def _book_warm_frame(cfg: SynthConfig, run_cfg: SynthConfig,
+                     levels: int, registry=None) -> None:
+    """Ledger one warm-started frame: the frame count the sentinel
+    `warm_start` check reconciles, plus the scheduled-vs-cold sweep
+    counts priced by the SAME (levels x em_iters x pm_iters) product
+    the cost model uses — evaluated at the warm replace and at the base
+    cfg respectively (one model, two operating points)."""
+    reg = registry
+    if reg is None:
+        from ..telemetry.metrics import get_registry
+
+        reg = get_registry()
+    reg.counter(
+        "ia_warm_start_frames_total",
+        "video frames synthesized from a warm-start seed",
+    ).inc()
+    sweeps = reg.counter(
+        "ia_warm_start_sweeps_total",
+        "scheduled PM sweeps on warm-started frames vs their cold "
+        "equivalent",
+    )
+    sweeps.inc(
+        float(levels * run_cfg.em_iters * run_cfg.pm_iters),
+        labels={"mode": "warm"},
+    )
+    sweeps.inc(
+        float(levels * cfg.em_iters * cfg.pm_iters),
+        labels={"mode": "cold_equiv"},
+    )
+
+
+def synthesize_video(
+    a,
+    ap,
+    frames,
+    cfg: Optional[SynthConfig] = None,
+    mesh=None,
+    progress=None,
+    resume_from: Optional[str] = None,
+    resume_strict: bool = False,
+    return_aux: bool = False,
+):
+    """Stylized B' for a frame SEQUENCE ((F, H, W[, 3])) against one
+    style pair, with temporal warm-starting (module docstring).
+
+    Returns the stacked outputs shaped like `frames`; `return_aux=True`
+    returns `(outputs, aux)` where aux carries the per-run temporal
+    accounting: per-frame finest fields, measured deltas, the schedules
+    actually run, the flicker metric, and the modeled cost of the run
+    vs its cold equivalent (`run_units` / `cold_units` — the VIDEO
+    bench's warm_cost_ratio numerator/denominator).
+
+    With the seam OFF (`IA_VIDEO_WARM=off` / `set_warm_mode("off")`)
+    the sequence dispatches to `synthesize_batch(frames_per_step=1)`:
+    every frame cold, bit-identical to the per-frame batch runner by
+    construction (chunking invariance is a tested batch property).
+    Checkpoint layout (`frames_{t:05d}` per-frame subdirectories under
+    `cfg.save_level_artifacts`, resumed from `resume_from`) is shared
+    between both modes, so a warm-off checkpoint tree resumes a
+    warm-on run's cold frames and vice versa — frame-granular resume
+    rides the existing per-level checkpoints."""
+    cfg = cfg or SynthConfig()
+    frames = np.asarray(frames, np.float32)
+    if frames.ndim not in (3, 4):
+        raise ValueError(
+            f"frames has shape {frames.shape}; expected (F, H, W[, C])"
+        )
+    from ..telemetry.metrics import get_registry
+
+    if not warm_enabled():
+        res = synthesize_batch(
+            a, ap, frames, cfg, mesh=mesh, progress=progress,
+            frames_per_step=1, resume_from=resume_from,
+            resume_strict=resume_strict, return_nnf=return_aux,
+        )
+        out, nnf = res if return_aux else (res, None)
+        flick = flicker_metric(out)
+        get_registry().gauge(
+            "ia_video_flicker",
+            "mean per-pixel temporal delta of the stylized output",
+        ).set(flick)
+        if return_aux:
+            aux = {
+                "mode": "off",
+                "fields": np.asarray(nnf),
+                "deltas": [None] * frames.shape[0],
+                "schedules": [
+                    (cfg.pm_iters, cfg.em_iters)
+                ] * frames.shape[0],
+                "flicker": flick,
+                "warm_frames": 0,
+                "run_units": None,
+                "cold_units": None,
+            }
+            return out, aux
+        return out
+
+    b_stats = None
+    if cfg.color_mode == "luminance" and cfg.luminance_remap:
+        # Whole-stack style normalization, exactly the batch runner's:
+        # frame 0 of a warm run must be bit-identical to frame 0 of the
+        # batch run over the same stack.
+        fr = jnp.asarray(frames, jnp.float32)
+        y_all = rgb_to_yiq(fr)[..., 0] if fr.ndim == 4 else fr
+        b_stats = luminance_stats(y_all)
+    stream = VideoStream(
+        a, ap, cfg=cfg, mesh=mesh, b_stats=b_stats,
+        n_stack=frames.shape[0], progress=progress,
+    )
+    outs = [
+        stream.step(
+            frames[t], resume_root=resume_from,
+            resume_strict=resume_strict,
+        )
+        for t in range(frames.shape[0])
+    ]
+    out = jnp.stack([jnp.asarray(o) for o in outs], axis=0)
+    flick = flicker_metric(out)
+    get_registry().gauge(
+        "ia_video_flicker",
+        "mean per-pixel temporal delta of the stylized output",
+    ).set(flick)
+    if return_aux:
+        aux = {
+            "mode": "on",
+            "fields": (
+                np.stack(stream.finest_history, axis=0)
+                if stream.finest_history else np.zeros((0,), np.int32)
+            ),
+            "deltas": list(stream.deltas),
+            "schedules": list(stream.schedules),
+            "flicker": flick,
+            "warm_frames": stream.warm_frames,
+            "run_units": stream.run_units,
+            "cold_units": stream.cold_units,
+        }
+        return out, aux
+    return out

@@ -56,6 +56,7 @@ from typing import Dict, List, Optional, Tuple
 
 _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _SCALE_RE = re.compile(r"^SCALE_r(\d+)\.json$")
+_VIDEO_RE = re.compile(r"^VIDEO_r(\d+)\.json$")
 
 PROVENANCES = ("measured", "carried", "modeled")
 
@@ -92,6 +93,22 @@ BENCH_SERIES: Tuple[Dict, ...] = (
      "label": "polish-DMA useful/moved fraction"},
     {"field": "kernel_bytes_per_polish", "direction": "lower",
      "rel_tol": 0.02, "since": 8, "label": "modeled polish traffic (B)"},
+)
+
+# VIDEO artifacts (round 14: tools/video_bench.py) are nested records;
+# load_history flattens the tracked cells (`_flatten_video`) so the
+# same provenance discipline applies: a modeled warm_cost_ratio can
+# never set the bar a later measured one is judged against.
+VIDEO_SERIES: Tuple[Dict, ...] = (
+    {"field": "flicker_warm_tau", "direction": "lower", "rel_tol": 0.50,
+     "since": 14,
+     "label": "stylized-output flicker with the coherence term"},
+    {"field": "warm_cost_ratio", "direction": "lower", "rel_tol": 0.15,
+     "ceiling": 0.6, "since": 14,
+     "label": "modeled warm/cold schedule cost ratio"},
+    {"field": "quality_mean_delta_db", "direction": "higher",
+     "abs_tol": 0.30, "floor": -0.1, "since": 14,
+     "label": "warm-vs-cold PSNR-vs-oracle delta (dB)"},
 )
 
 # SCALE rows are keyed by size; each series is tracked per size.
@@ -166,15 +183,41 @@ def _mark_compressed_cells(rec):
 
 
 # -------------------------------------------------------------- loading
+def _flatten_video(rec):
+    """Tracked VIDEO cells, hoisted out of the record's nested sections
+    so `check_series` sees the flat {field: value} shape the other
+    artifact kinds provide.  Record-level provenance and any per-cell
+    map pass through under the same keys."""
+    if not isinstance(rec, dict):
+        return rec
+    flat = {}
+    if "provenance" in rec:
+        flat["provenance"] = rec["provenance"]
+    if isinstance(rec.get("cell_provenance"), dict):
+        flat["cell_provenance"] = rec["cell_provenance"]
+    flick = rec.get("flicker")
+    if isinstance(flick, dict):
+        flat["flicker_warm_tau"] = flick.get("warm_tau")
+    warm = rec.get("warm")
+    if isinstance(warm, dict):
+        flat["warm_cost_ratio"] = warm.get("warm_cost_ratio")
+    qual = rec.get("quality")
+    if isinstance(qual, dict):
+        flat["quality_mean_delta_db"] = qual.get("mean_delta_db")
+    return flat
+
+
 def load_history(root: str):
-    """(bench, scale) lists of (round, filename, payload), round-sorted.
-    BENCH payloads unwrap the driver's capture wrapper to the parsed
-    record.  Builder probe files (BENCH_r*_builder*.json) do not match
-    the round pattern and are deliberately out of scope — they are
-    CPU-built field-builder exercises, not round records.  Compressed-
-    mode records get their byte-model cells forced to modeled
-    (`_mark_compressed_cells`)."""
-    bench, scale = [], []
+    """(bench, scale, video) lists of (round, filename, payload),
+    round-sorted.  BENCH payloads unwrap the driver's capture wrapper
+    to the parsed record.  Builder probe files (BENCH_r*_builder*.json)
+    do not match the round pattern and are deliberately out of scope —
+    they are CPU-built field-builder exercises, not round records.
+    Compressed-mode records get their byte-model cells forced to
+    modeled (`_mark_compressed_cells`); VIDEO payloads stay raw here
+    (schema validation needs the nested record) and are flattened at
+    the series check."""
+    bench, scale, video = [], [], []
     for name in sorted(os.listdir(root)):
         m = _BENCH_RE.match(name)
         if m:
@@ -193,9 +236,14 @@ def load_history(root: str):
         if m:
             with open(os.path.join(root, name)) as f:
                 scale.append((int(m.group(1)), name, json.load(f)))
+        m = _VIDEO_RE.match(name)
+        if m:
+            with open(os.path.join(root, name)) as f:
+                video.append((int(m.group(1)), name, json.load(f)))
     bench.sort(key=lambda t: t[0])
     scale.sort(key=lambda t: t[0])
-    return bench, scale
+    video.sort(key=lambda t: t[0])
+    return bench, scale, video
 
 
 # ------------------------------------------------------ schema (by era)
@@ -426,7 +474,7 @@ def check_series(
 def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
     """All schema + trajectory checks over the committed history.
     Returns (violations, machine-readable report rows)."""
-    bench, scale = load_history(root)
+    bench, scale, video = load_history(root)
     errs: List[str] = []
     report: List[Dict] = []
 
@@ -434,11 +482,24 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
         errs.extend(validate_bench_record(rnd, name, rec))
     for rnd, name, data in scale:
         errs.extend(validate_scale_artifact(rnd, name, data))
+    for rnd, name, rec in video:
+        # Video artifacts carry their full contract in check_video.
+        tools_dir = os.path.dirname(os.path.abspath(__file__))
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        from check_video import validate_video
+
+        errs.extend(f"{name}: {e}" for e in validate_video(rec))
 
     for decl in BENCH_SERIES:
         check_series(
             decl, [(r, n, rec) for r, n, rec in bench],
             f"bench.{decl['field']}", errs, report,
+        )
+    for decl in VIDEO_SERIES:
+        check_series(
+            decl, [(r, n, _flatten_video(rec)) for r, n, rec in video],
+            f"video.{decl['field']}", errs, report,
         )
     def _rows(data):
         rows = data.get("rows") if isinstance(data, dict) else None

@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Validate a VIDEO_r14.json video-analogies artifact (round 14).
+
+The video acceptance bar, enforced by a validator instead of trusted to
+prose: on a >= 8-frame sequence at a >= 64px proxy, every frame after
+the first must have warm-started (warm_frames == frames - 1) on a
+measurably shortened schedule (modeled warm_cost_ratio <= 0.6, the
+delta-cost claim), the warm pass must hold the static-scene quality
+gate (mean PSNR-vs-oracle within 0.1 dB of the cold pass), the
+temporal-coherence term must have actually reduced flicker (warm_tau
+strictly below independent per-frame synthesis), the warm-start sweep
+ledger must reconcile with itself and with the frame counts, and the
+sentinel's `warm_start` check must have graded both warm passes "ok" —
+a ledger the engine's own invariant check rejects is not an artifact,
+it is a bug report.
+
+Usage:
+    python tools/check_video.py VIDEO_r14.json
+
+Runs under pytest too (tests/test_video.py validates the COMMITTED
+artifact) so tier-1 fails if the record is missing, truncated, or
+structurally degraded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+VIDEO_SCHEMA_VERSION = 1
+
+WARM_COST_RATIO_MAX = 0.6
+QUALITY_DELTA_DB_MIN = -0.1
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _label_sum(counter, label: str = None) -> float:
+    """Sum a metrics-snapshot counter dict ({label_repr: value}),
+    optionally restricted to entries mentioning `label`."""
+    if not isinstance(counter, dict):
+        return float("nan")
+    total = 0.0
+    for k, v in counter.items():
+        if label is not None and label not in k:
+            continue
+        if not _num(v):
+            return float("nan")
+        total += v
+    return total
+
+
+def validate_video(record: dict) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if record.get("schema_version") != VIDEO_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {record.get('schema_version')!r} != "
+            f"{VIDEO_SCHEMA_VERSION}"
+        )
+    if record.get("kind") != "video":
+        errs.append(f"kind {record.get('kind')!r} != 'video'")
+    size = record.get("proxy_size")
+    if not (_num(size) and size >= 64):
+        errs.append(f"proxy_size {size!r} is not a size >= 64")
+    frames = record.get("frames")
+    if not (_num(frames) and frames >= 8):
+        errs.append(f"frames {frames!r} is not a count >= 8")
+        frames = None
+
+    cold = record.get("cold")
+    if not isinstance(cold, dict):
+        errs.append("cold: missing object")
+        cold = {}
+    warm = record.get("warm")
+    if not isinstance(warm, dict):
+        errs.append("warm: missing object")
+        warm = {}
+    if frames is not None:
+        for sect, d in (("cold", cold), ("warm", warm)):
+            walls = d.get("wall_s_per_frame")
+            if not (isinstance(walls, list) and len(walls) == frames
+                    and all(_num(w) and w >= 0 for w in walls)):
+                errs.append(
+                    f"{sect}.wall_s_per_frame is not a list of "
+                    f"{frames} non-negative numbers"
+                )
+        scheds = warm.get("schedules")
+        if not (isinstance(scheds, list) and len(scheds) == frames):
+            errs.append(f"warm.schedules is not a list of {frames}")
+            scheds = None
+        deltas = warm.get("deltas")
+        if not (isinstance(deltas, list) and len(deltas) == frames):
+            errs.append(f"warm.deltas is not a list of {frames}")
+        elif deltas[0] is not None:
+            errs.append(
+                f"warm.deltas[0] {deltas[0]!r} is not null — frame 0 "
+                "has nothing to warm-start from and must run cold"
+            )
+        wf = warm.get("warm_frames")
+        if not (_num(wf) and wf == frames - 1):
+            errs.append(
+                f"warm.warm_frames {wf!r} != frames - 1 "
+                f"({frames - 1}) — every frame after the first must "
+                "warm-start on this bench's static scene"
+            )
+        cfg = record.get("config")
+        if not isinstance(cfg, dict):
+            errs.append("config: missing object")
+        elif scheds:
+            full = [cfg.get("pm_iters"), cfg.get("em_iters")]
+            if list(scheds[0]) != full:
+                errs.append(
+                    f"warm.schedules[0] {scheds[0]!r} != cold schedule "
+                    f"{full!r} — frame 0 must run the full schedule"
+                )
+            shortened = [
+                s for s in scheds[1:]
+                if isinstance(s, list) and s != full
+            ]
+            if not shortened:
+                errs.append(
+                    "no warm frame ran a shortened schedule — the "
+                    "delta-cost scheduler never engaged"
+                )
+
+    ratio = warm.get("warm_cost_ratio")
+    if not (_num(ratio) and 0.0 < ratio <= WARM_COST_RATIO_MAX):
+        errs.append(
+            f"warm.warm_cost_ratio {ratio!r} is not in "
+            f"(0, {WARM_COST_RATIO_MAX}] — warm frames must run a "
+            "measurably reduced modeled schedule"
+        )
+    ru, cu = warm.get("run_units"), warm.get("cold_units")
+    if _num(ru) and _num(cu) and cu > 0 and _num(ratio):
+        if abs(ru / cu - ratio) > 0.01:
+            errs.append(
+                f"warm.warm_cost_ratio {ratio} != run_units/cold_units "
+                f"({ru}/{cu}) — the ratio must come from the model it "
+                "claims to"
+            )
+
+    quality = record.get("quality")
+    if not isinstance(quality, dict):
+        errs.append("quality: missing object")
+        quality = {}
+    mean_d = quality.get("mean_delta_db")
+    if not (_num(mean_d) and mean_d >= QUALITY_DELTA_DB_MIN):
+        errs.append(
+            f"quality.mean_delta_db {mean_d!r} is not >= "
+            f"{QUALITY_DELTA_DB_MIN} — the warm pass must hold PSNR-vs-"
+            "oracle within 0.1 dB of the cold pass"
+        )
+    for k in ("psnr_cold_db", "psnr_warm_db"):
+        arr = quality.get(k)
+        if frames is not None and not (
+            isinstance(arr, list) and len(arr) == frames
+            and all(_num(p) for p in arr)
+        ):
+            errs.append(f"quality.{k} is not a list of {frames} numbers")
+
+    flick = record.get("flicker")
+    if not isinstance(flick, dict):
+        errs.append("flicker: missing object")
+        flick = {}
+    indep, wtau = flick.get("independent"), flick.get("warm_tau")
+    if not (_num(indep) and _num(wtau) and wtau < indep):
+        errs.append(
+            f"flicker.warm_tau {wtau!r} is not strictly below "
+            f"flicker.independent {indep!r} — the coherence term must "
+            "demonstrably reduce flicker vs per-frame synthesis"
+        )
+    tau = flick.get("tau")
+    if not (_num(tau) and tau > 0):
+        errs.append(f"flicker.tau {tau!r} is not > 0")
+
+    ledger = record.get("ledger")
+    if not isinstance(ledger, dict):
+        errs.append("ledger: missing object")
+        ledger = {}
+    warm_booked = _label_sum(
+        ledger.get("ia_warm_start_frames_total")
+    )
+    frames_warm = _label_sum(
+        ledger.get("ia_video_frames_total"), 'mode="warm"'
+    )
+    if warm_booked != frames_warm:
+        errs.append(
+            f"ledger: ia_warm_start_frames_total {warm_booked} != "
+            f"ia_video_frames_total{{mode=warm}} {frames_warm}"
+        )
+    wf = warm.get("warm_frames")
+    if _num(wf) and warm_booked != wf:
+        errs.append(
+            f"ledger: ia_warm_start_frames_total {warm_booked} != "
+            f"warm.warm_frames {wf}"
+        )
+    sw = ledger.get("ia_warm_start_sweeps_total")
+    sw_warm = _label_sum(sw, 'mode="warm"')
+    sw_cold = _label_sum(sw, 'mode="cold_equiv"')
+    if not (sw_warm == sw_warm and sw_cold == sw_cold):  # NaN guard
+        errs.append("ledger: ia_warm_start_sweeps_total is malformed")
+    elif sw_warm >= sw_cold:
+        errs.append(
+            f"ledger: warm sweeps {sw_warm} >= cold-equivalent "
+            f"{sw_cold} — the warm schedule saved nothing"
+        )
+
+    for k in ("warm_check", "warm_check_tau"):
+        if record.get(k) != "ok":
+            errs.append(
+                f"{k} {record.get(k)!r} != 'ok' — the sentinel's "
+                "warm_start invariants must grade the run clean"
+            )
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="VIDEO_r14.json to validate")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"check_video: cannot read {args.path}: {e}")
+        return 1
+    errs = validate_video(record)
+    if errs:
+        print(f"check_video: {args.path} INVALID:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    warm = record.get("warm", {})
+    flick = record.get("flicker", {})
+    print(
+        f"check_video: {args.path} OK "
+        f"(warm_cost_ratio={warm.get('warm_cost_ratio')}, quality "
+        f"delta {record.get('quality', {}).get('mean_delta_db')} dB, "
+        f"flicker {flick.get('independent')} -> {flick.get('warm_tau')}"
+        f" at tau={flick.get('tau')})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
