@@ -800,6 +800,13 @@ def cmd_serve(args) -> int:
             max_retries=args.max_retries,
             max_sessions=args.max_sessions,
             flight=getattr(tracer, "flight_recorder", None),
+            # Access log beside the other trace artifacts, where the
+            # `ia-synth trace` CLI looks (daemon default: its private
+            # work dir, which dies with it).
+            access_log_path=(
+                os.path.join(args.trace_dir, "access.jsonl")
+                if args.trace_dir else None
+            ),
         ).start()
         try:
             if warm_entries:
@@ -815,7 +822,7 @@ def cmd_serve(args) -> int:
                 daemon.live.announce(args.trace_dir)
             print(
                 f"serving on {daemon.url} (POST /synthesize; GET "
-                "/serving /metrics /healthz /progress)",
+                "/serving /slo /metrics /healthz /progress)",
                 flush=True,
             )
             while True:
@@ -908,6 +915,84 @@ def cmd_health(args) -> int:
         print(render_health(health))
     print(f"wrote {out}")
     return 1 if health["verdict"] == "violated" else 0
+
+
+def cmd_trace(args) -> int:
+    """Reconstruct one serving request's critical path (round 15): the
+    structured access log is the source of truth for phase attribution
+    (queue/compile/execute/demux millis the daemon booked at response
+    time), joined — when the artifacts exist — with the request's
+    `serve_request` span tree from flight.json for the span-side view.
+    Prints a phase-attributed waterfall; exits nonzero when the id is
+    not in the (possibly rotated) log."""
+    import json
+
+    from .serving.accesslog import find_request, phase_fields
+
+    log_path = args.access_log or os.path.join(
+        args.trace_dir, "access.jsonl"
+    )
+    rec = find_request(log_path, args.request_id)
+    if rec is None:
+        raise SystemExit(
+            f"trace: request {args.request_id!r} not found in "
+            f"{log_path} (or its .1 rotation)"
+        )
+    # Optional flight-side join: the daemon replays each settled
+    # request's span tree through the flight recorder, so a request
+    # still inside the ring's window has events here too.
+    flight_evs = []
+    flight_path = os.path.join(args.trace_dir, "flight.json")
+    if os.path.exists(flight_path):
+        from .telemetry.flight import read_flight, request_events
+
+        try:
+            flight_evs = request_events(
+                read_flight(flight_path), args.request_id
+            )
+        except (OSError, ValueError):
+            flight_evs = []
+    if args.format == "json":
+        print(json.dumps(
+            {"access": rec, "flight_events": flight_evs}, indent=1
+        ))
+        return 0
+    total_ms = float(rec.get("total_ms") or 0.0)
+    phases = phase_fields(rec)
+    print(
+        f"request {rec.get('request_id')}  outcome={rec.get('outcome')}"
+        f"  http={rec.get('http_status')}  cache={rec.get('cache', '-')}"
+        f"  session={rec.get('session_id') or '-'}"
+    )
+    if rec.get("exec_key"):
+        print(f"  exec_key {rec['exec_key']}")
+    if rec.get("ts"):
+        print(f"  ts {rec['ts']}  bytes_in {rec.get('bytes_in', 0)}"
+              f"  bytes_out {rec.get('bytes_out', 0)}")
+    width = 32
+    for name, ms in phases:
+        frac = ms / total_ms if total_ms > 0 else 0.0
+        bar = "#" * max(1, int(round(frac * width))) if ms > 0 else ""
+        print(f"  {name:8s} {ms:10.3f} ms  {100.0 * frac:5.1f}%  {bar}")
+    attributed = sum(ms for _, ms in phases)
+    gap = total_ms - attributed
+    gap_pct = 100.0 * gap / total_ms if total_ms > 0 else 0.0
+    print(
+        f"  {'phases':8s} {attributed:10.3f} ms  vs total "
+        f"{total_ms:.3f} ms (gap {gap:.3f} ms, {gap_pct:.2f}%)"
+    )
+    if flight_evs:
+        closes = [ev for ev in flight_evs if ev.get("kind") == "close"]
+        root = next(
+            (ev for ev in closes if ev.get("name") == "serve_request"),
+            None,
+        )
+        extra = (
+            f"; serve_request wall {root['wall_ms']:.3f} ms"
+            if root and root.get("wall_ms") is not None else ""
+        )
+        print(f"  flight: {len(flight_evs)} span events{extra}")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -1095,6 +1180,31 @@ def main(argv=None) -> int:
     )
     p.add_argument("--format", default="table", choices=["table", "json"])
     p.set_defaults(fn=cmd_health)
+
+    p = sub.add_parser(
+        "trace",
+        help="reconstruct one serving request's critical path from the "
+        "daemon's access log (+ flight.json span join): phase-"
+        "attributed waterfall for a request id (exit 1 if not found)",
+    )
+    _add_common_flags(p)
+    p.add_argument(
+        "request_id",
+        help="the request id to reconstruct (echoed in every "
+        "/synthesize response and error body)",
+    )
+    p.add_argument(
+        "--trace-dir", required=True, metavar="DIR",
+        help="the serve daemon's --trace-dir (access.jsonl + "
+        "flight.json live here)",
+    )
+    p.add_argument(
+        "--access-log", default=None, metavar="JSONL",
+        help="explicit access-log path (default: "
+        "<trace-dir>/access.jsonl)",
+    )
+    p.add_argument("--format", default="table", choices=["table", "json"])
+    p.set_defaults(fn=cmd_trace)
 
     args = parser.parse_args(argv)
     from .utils.progress import configure_logging

@@ -1143,7 +1143,7 @@ def shard_sync_walls(level_t0: float, parts) -> List[float]:
 
 
 def record_level_span(tracer, cfg: SynthConfig, level_t0: float,
-                      level: int, h, w, nnf_energy: float,
+                      level: int, h, w, nnf_energy: Optional[float],
                       shard_walls: Optional[List[float]] = None,
                       shard_axis: Optional[str] = None, **attrs):
     """Timed `level` span + declared em_iter children — the shared
@@ -1193,12 +1193,15 @@ def record_level_span(tracer, cfg: SynthConfig, level_t0: float,
             "max/median per-shard level wall (1.0 = balanced; the "
             "sentinel flags sustained skew)",
         ).set(ratio, labels={"level": str(level), "axis": axis})
+    if nnf_energy is not None:
+        # A lean run tracer (serving) skips the energy readback; the
+        # attr is omitted rather than recorded as null.
+        attrs["nnf_energy"] = nnf_energy
     sp = tracer.record(
         "level",
         round((time.perf_counter() - level_t0) * 1000, 3),
         level=level,
         shape=[int(h), int(w)],
-        nnf_energy=nnf_energy,
         em_iters=cfg.em_iters,
         **attrs,
     )

@@ -791,22 +791,32 @@ def synthesize_batch(
             # contiguous blocks, so each block's readback barrier is
             # one device's completion stamp), then the merged
             # nnf_energy readback — by then every shard is synced, so
-            # the level span's own wall is unchanged.
+            # the level span's own wall is unchanged.  A LEAN tracer
+            # (the serving daemon's per-request run tracer) keeps the
+            # level span but skips both readbacks: request tracing
+            # must not add device syncs to the hot path.
             from ..models.analogy import (
                 record_level_span,
                 shard_sync_walls,
             )
 
-            n_sh = int(mesh.devices.size)
-            per = dist.shape[0] // n_sh
-            walls = shard_sync_walls(
-                level_t0,
-                [dist[i * per:(i + 1) * per] for i in range(n_sh)],
-            ) if per else None
-            record_level_span(
-                tracer, cfg, level_t0, level, h, w, float(dist.mean()),
-                shard_walls=walls, shard_axis=BATCH_AXIS,
-            )
+            if getattr(tracer, "lean", False):
+                record_level_span(
+                    tracer, cfg, level_t0, level, h, w, None,
+                    shard_axis=BATCH_AXIS,
+                )
+            else:
+                n_sh = int(mesh.devices.size)
+                per = dist.shape[0] // n_sh
+                walls = shard_sync_walls(
+                    level_t0,
+                    [dist[i * per:(i + 1) * per] for i in range(n_sh)],
+                ) if per else None
+                record_level_span(
+                    tracer, cfg, level_t0, level, h, w,
+                    float(dist.mean()),
+                    shard_walls=walls, shard_axis=BATCH_AXIS,
+                )
         if cfg.save_level_artifacts:
             # Whole-batch per-level state through the single-image
             # writer: atomic tmp+rename and a fingerprint covering the

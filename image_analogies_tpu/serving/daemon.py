@@ -79,15 +79,18 @@ from __future__ import annotations
 import base64
 import json
 import os
+import re
 import shutil
 import tempfile
 import threading
 import time
+import uuid
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .accesslog import AccessLog
 from .excache import ExecutableCache, exec_key, key_str, run_warmup
 from .queueing import (
     AdmissionController,
@@ -104,6 +107,54 @@ from .queueing import (
 LUMA_BUCKET = 32.0
 
 REQUEST_TIMEOUT_S = 600.0
+
+# Client-supplied X-Request-Id values must be short and safe (they land
+# in logs, span attrs, and metrics labels verbatim); anything else is
+# ignored and a server id generated instead.
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+
+def _request_id_from_headers(headers) -> str:
+    """The request's id: the client's `X-Request-Id` when present and
+    well-formed (so a caller can correlate daemon telemetry with its
+    own), else a fresh server-generated one."""
+    if headers:
+        for k, v in headers.items():
+            if str(k).lower() == "x-request-id" \
+                    and isinstance(v, str) and _REQUEST_ID_RE.match(v):
+                return v
+    return uuid.uuid4().hex[:12]
+
+
+def _phase_attribution(req: ServeRequest,
+                       total_ms: float) -> Dict[str, float]:
+    """queue/compile/execute/demux millis from the request's lifecycle
+    events plus its dispatch's prologue wall — the critical-path split
+    the access log carries and `ia-synth trace` renders.
+
+    Definitions (all relative offsets from enqueue, so they tile):
+      queue_ms   = enqueue -> admitted
+      compile_ms = the dispatch's prologue wall (0 when none carried),
+                   clamped into the execution window
+      execute_ms = cache-verdict -> executed, minus compile_ms
+      demux_ms   = executed -> the response (demux + settle + handler
+                   wakeup — everything after the engine returned)
+    The parts deliberately sum to total_ms minus only the sub-ms
+    admitted -> cache-verdict preamble, which is what lets the trace
+    CLI assert its 5%% reconstruction bound."""
+    t = {ev["name"]: ev["t_ms"] for ev in req.spans}
+    out: Dict[str, float] = {}
+    if "admitted" in t:
+        out["queue_ms"] = round(t["admitted"], 3)
+    verdict = t.get("cache-hit", t.get("compiled"))
+    executed = t.get("executed")
+    if executed is not None and verdict is not None:
+        window = max(0.0, executed - verdict)
+        c = min(float(req.compile_ms or 0.0), window)
+        out["compile_ms"] = round(c, 3)
+        out["execute_ms"] = round(window - c, 3)
+        out["demux_ms"] = round(max(0.0, total_ms - executed), 3)
+    return out
 
 
 def _luma_bucket(frame: np.ndarray) -> Optional[Tuple[float, float]]:
@@ -154,8 +205,12 @@ class SynthDaemon:
         max_sessions: int = 16,
         flight=None,
         work_dir: Optional[str] = None,
+        observability: bool = True,
+        access_log_path: Optional[str] = None,
+        slo_window_s: float = 300.0,
     ):
         from ..parallel.batch import make_mesh
+        from ..telemetry.slo import SloEngine
 
         self.a = np.asarray(a, np.float32)
         self.ap = np.asarray(ap, np.float32)
@@ -193,6 +248,15 @@ class SynthDaemon:
         self._inflight = 0
         self._stop = threading.Event()
         self._dispatcher: Optional[threading.Thread] = None
+        # Round 15 observability: per-request span trees + run-subtree
+        # tracer + structured access log, all gated on ONE switch so
+        # the overhead-pin harness can run a bit-identical bare arm.
+        # (The request-duration histogram and request ids stay on
+        # either way — they ARE the response contract.)
+        self.observability = bool(observability)
+        self._access_log_path = access_log_path
+        self.access: Optional[AccessLog] = None
+        self.slo = SloEngine(registry, window_s=slo_window_s)
         self._init_metrics()
 
     # ------------------------------------------------------- metrics
@@ -238,6 +302,21 @@ class SynthDaemon:
             "= enqueue->admitted, service = admitted->done, total = "
             "enqueue->done",
         )
+        from ..telemetry.slo import (
+            REQUEST_DURATION_BUCKETS,
+            REQUEST_DURATION_METRIC,
+        )
+
+        # The SLO engine's raw material: one observation per request
+        # at response time, labelled with outcome and cache verdict —
+        # explicit buckets chosen so every latency objective threshold
+        # is an exact bound (telemetry/slo.py).
+        self._h_duration = r.histogram(
+            REQUEST_DURATION_METRIC,
+            "end-to-end request latency (ms) by route/outcome/cache — "
+            "the raw family the SLO objectives are evaluated from",
+            buckets=REQUEST_DURATION_BUCKETS,
+        )
         self._g_depth.set(0)
         self._g_inflight.set(0)
 
@@ -250,6 +329,11 @@ class SynthDaemon:
             self.tracer = as_tracer(None)
         if self._own_work_dir:
             self._work_dir = tempfile.mkdtemp(prefix="ia-serve-")
+        if self.observability:
+            self.access = AccessLog(
+                self._access_log_path
+                or os.path.join(self._work_dir, "access.jsonl")
+            )
         self.live = LiveTelemetryServer(
             self.tracer,
             self.registry,
@@ -260,6 +344,7 @@ class SynthDaemon:
             routes={
                 ("POST", "/synthesize"): self._route_synthesize,
                 ("GET", "/serving"): self._route_serving,
+                ("GET", "/slo"): self._route_slo,
             },
         ).start()
         self._dispatcher = threading.Thread(
@@ -283,6 +368,9 @@ class SynthDaemon:
         if self.live is not None:
             self.live.stop()
             self.live = None
+        if self.access is not None:
+            self.access.close()
+            self.access = None
         if self._own_work_dir and self._work_dir:
             shutil.rmtree(self._work_dir, ignore_errors=True)
 
@@ -313,7 +401,8 @@ class SynthDaemon:
 
     # ------------------------------------------------------- serving
     def _make_request(self, frame: np.ndarray,
-                      session: Optional[str] = None) -> ServeRequest:
+                      session: Optional[str] = None,
+                      req_id: Optional[str] = None) -> ServeRequest:
         # Session dispatches run one frame at a time through the
         # stream's own solo-mesh executables, so their cache identity
         # is the batch-1 grain, not the daemon's padding grain.
@@ -323,25 +412,38 @@ class SynthDaemon:
         if self.cfg.color_mode == "luminance" and \
                 self.cfg.luminance_remap:
             bucket = _luma_bucket(frame)
+        kwargs = {"req_id": req_id} if req_id else {}
         return ServeRequest(
             frame=frame, key=key, compat=key + (bucket, session),
-            b_stats=bucket, session=session,
+            b_stats=bucket, session=session, **kwargs,
         )
 
-    def _route_synthesize(self, body: Optional[bytes]):
+    def _route_synthesize(self, body: Optional[bytes], headers=None):
         """POST /synthesize handler (runs on an HTTP handler thread):
-        validate -> admit-or-shed -> enqueue -> block on completion."""
+        assign/accept the request id -> validate -> admit-or-shed ->
+        enqueue -> block on completion.  Every exit echoes
+        `request_id` in the body (the machine-parseable error
+        contract), books the `ia_request_duration_ms` cell for its
+        outcome, and appends the structured access-log line."""
+        rid = _request_id_from_headers(headers)
+        t_in = time.monotonic()
+        bytes_in = len(body) if body else 0
         try:
             manifest = _parse_manifest(body)
             frame = _frame_from_manifest(manifest)
             session = _session_from_manifest(manifest)
         except ValueError as e:
-            return (
-                400,
-                _json_bytes({"status": "rejected", "error": str(e)}),
-                "application/json",
+            payload = _json_bytes({
+                "status": "rejected", "error": str(e),
+                "request_id": rid,
+            })
+            self._book_response(
+                rid, None, "rejected", 400,
+                (time.monotonic() - t_in) * 1000.0, bytes_in,
+                len(payload),
             )
-        req = self._make_request(frame, session)
+            return 400, payload, "application/json"
+        req = self._make_request(frame, session, req_id=rid)
         req.span("queued")
         # Requests books FIRST (the serving sentinel check's ordering
         # contract), then exactly one of admitted/shed.
@@ -351,14 +453,20 @@ class SynthDaemon:
         )
         if not ok:
             self._c_shed.inc()
+            payload = _json_bytes({
+                "status": "shed",
+                "error": "shed by admission control (queue at "
+                         "capacity); retry after retry_after_s",
+                "request_id": rid,
+                "retry_after_s": retry_after,
+            })
+            self._book_response(
+                rid, req, "shed", 429,
+                (time.monotonic() - t_in) * 1000.0, bytes_in,
+                len(payload),
+            )
             return (
-                429,
-                _json_bytes({
-                    "status": "shed",
-                    "request_id": req.req_id,
-                    "retry_after_s": retry_after,
-                }),
-                "application/json",
+                429, payload, "application/json",
                 {"Retry-After": str(int(np.ceil(retry_after)))},
             )
         self._c_admitted.inc()
@@ -371,43 +479,83 @@ class SynthDaemon:
             # failed here too would double-count the admission ledger
             # the serving sentinel check balances.
             req.error = "request timed out in the daemon"
-            return (
-                504,
-                _json_bytes({
-                    "status": "failed", "request_id": req.req_id,
-                    "error": req.error,
-                }),
-                "application/json",
+            payload = _json_bytes({
+                "status": "failed", "request_id": rid,
+                "error": req.error,
+            })
+            self._book_response(
+                rid, req, "timeout", 504,
+                (time.monotonic() - req.enqueue_t) * 1000.0, bytes_in,
+                len(payload),
             )
+            return 504, payload, "application/json"
         total_ms = (time.monotonic() - req.enqueue_t) * 1000.0
         self._h_latency.observe(total_ms, labels={"phase": "total"})
         if req.status != "ok":
-            return (
-                500,
-                _json_bytes({
-                    "status": "failed", "request_id": req.req_id,
-                    "error": req.error, "spans": req.spans,
-                }),
-                "application/json",
+            payload = _json_bytes({
+                "status": "failed", "request_id": rid,
+                "error": req.error, "spans": req.spans,
+            })
+            self._book_response(
+                rid, req, "failed", 500, total_ms, bytes_in,
+                len(payload),
             )
+            return 500, payload, "application/json"
         out = np.asarray(req.result, np.float32)
-        return (
-            200,
-            _json_bytes({
-                "status": "ok",
-                "request_id": req.req_id,
-                "cache": req.cache,
-                "batch_size": req.batch_size,
-                "wall_ms": round(total_ms, 3),
-                "spans": req.spans,
-                "shape": list(out.shape),
-                "dtype": "float32",
-                "image_b64": base64.b64encode(
-                    np.ascontiguousarray(out).tobytes()
-                ).decode(),
-            }),
-            "application/json",
+        payload = _json_bytes({
+            "status": "ok",
+            "request_id": rid,
+            "cache": req.cache,
+            "batch_size": req.batch_size,
+            "wall_ms": round(total_ms, 3),
+            "spans": req.spans,
+            "shape": list(out.shape),
+            "dtype": "float32",
+            "image_b64": base64.b64encode(
+                np.ascontiguousarray(out).tobytes()
+            ).decode(),
+        })
+        self._book_response(
+            rid, req, "ok", 200, total_ms, bytes_in, len(payload)
         )
+        return 200, payload, "application/json"
+
+    def _book_response(self, rid: str, req: Optional[ServeRequest],
+                       outcome: str, code: int, total_ms: float,
+                       bytes_in: int, bytes_out: int) -> None:
+        """Response-time bookkeeping, one call per exit path: the
+        request-duration observation (always — it is the SLO engine's
+        raw material) and the access-log line (observability only)."""
+        cache = req.cache if req is not None and req.cache else "none"
+        self._h_duration.observe(total_ms, labels={
+            "route": "/synthesize", "outcome": outcome, "cache": cache,
+        })
+        if self.access is None:
+            return
+        entry: Dict[str, Any] = {
+            "ts": round(time.time(), 6),
+            "request_id": rid,
+            "route": "/synthesize",
+            "outcome": outcome,
+            "http_status": code,
+            "total_ms": round(total_ms, 3),
+            "bytes_in": bytes_in,
+            "bytes_out": bytes_out,
+        }
+        if req is not None:
+            entry["t0"] = round(req.t0, 6)
+            entry["session_id"] = req.session
+            entry["exec_key"] = key_str(req.key)
+            entry["cache"] = req.cache
+            entry["batch_size"] = req.batch_size
+            entry.update(_phase_attribution(req, total_ms))
+        self.access.log(entry)
+
+    def _route_slo(self, _body):
+        """GET /slo: grade the declarative objectives over the sliding
+        window and publish the burn-rate gauges — evaluation happens
+        HERE (pull), never on the request hot path."""
+        return 200, _json_bytes(self.slo.evaluate()), "application/json"
 
     def _route_serving(self, _body):
         """GET /serving: the operator's one-look snapshot — queue /
@@ -494,7 +642,9 @@ class SynthDaemon:
         self._inflight = len(batch)
         self._g_inflight.set(len(batch))
         self._c_dispatches.inc(labels={"kind": kind})
-        cache_status = self.cache.lookup(batch[0].key, kind=kind)
+        cache_status = self.cache.lookup(
+            batch[0].key, kind=kind, request_id=batch[0].req_id
+        )
         span_name = "cache-hit" if cache_status == "hit" else "compiled"
         for req in batch:
             req.cache = cache_status
@@ -502,9 +652,24 @@ class SynthDaemon:
         return admit_t
 
     def _settle_batch(self, batch: List[ServeRequest],
-                      admit_t: float) -> None:
-        """Shared dispatch epilogue: service latency, done events, and
-        the in-flight gauges back to idle."""
+                      admit_t: float, run_roots=(),
+                      compile_ms: Optional[float] = None) -> None:
+        """Shared dispatch epilogue: per-request span trees grafted
+        onto the daemon tracer, service latency, done events, and the
+        in-flight gauges back to idle.  `compile_ms` (the dispatch's
+        prologue wall) is stamped on every co-tenant BEFORE `done`
+        fires, so the handler thread's access-log line sees it."""
+        for req in batch:
+            req.compile_ms = compile_ms
+        if self.observability:
+            try:
+                self._attach_request_trees(batch, run_roots)
+            except Exception:  # noqa: BLE001 - never fail the dispatch
+                import logging
+
+                logging.getLogger("image_analogies_tpu").exception(
+                    "per-request span tree construction failed"
+                )
         service_ms = (time.monotonic() - admit_t) * 1000.0
         for req in batch:
             self._h_latency.observe(
@@ -513,6 +678,50 @@ class SynthDaemon:
             req.done.set()
         self._inflight = 0
         self._g_inflight.set(0)
+
+    def _attach_request_trees(self, batch: List[ServeRequest],
+                              run_roots) -> None:
+        """Convert each request's lifecycle events into ONE real span
+        tree — `serve_request` root spanning enqueue -> settle, one
+        child interval per lifecycle event (each reaching to the next
+        event), the dispatch's run->level subtree grafted under the
+        batch LEAD's root (once, not per co-tenant; co-tenants carry a
+        `run_in` pointer) — and graft it onto the daemon tracer, where
+        the flight recorder, /progress, and check_report already look.
+        Runs on the dispatcher thread only, after the dispatch, so the
+        tracer's span stack is untouched (module docstring of
+        serving/queueing.py: why lifecycle events can't be live
+        spans)."""
+        if self.tracer is None or not self.tracer.enabled:
+            return
+        from ..telemetry.spans import span_at
+
+        settle_perf = time.perf_counter()
+        lead = batch[0]
+        for req in batch:
+            base = req.enqueue_perf
+            rel_end = (settle_perf - base) * 1000.0
+            events = [(ev["name"], float(ev["t_ms"]))
+                      for ev in req.spans]
+            root = span_at(
+                "serve_request", base, settle_perf,
+                request_id=req.req_id, session=req.session,
+                cache=req.cache, batch_size=req.batch_size,
+                outcome=req.status,
+            )
+            for i, (name, t_ms) in enumerate(events):
+                nxt = (events[i + 1][1] if i + 1 < len(events)
+                       else rel_end)
+                root.children.append(span_at(
+                    name, base + t_ms / 1000.0,
+                    base + max(t_ms, nxt) / 1000.0,
+                ))
+            if req is lead and run_roots:
+                root.children.extend(run_roots)
+                root.attrs["run_attached"] = len(run_roots)
+            elif run_roots:
+                root.attrs["run_in"] = lead.req_id
+            self.tracer.attach_tree(root)
 
     def _execute(self, batch: List[ServeRequest],
                  kind: str = "client") -> None:
@@ -545,10 +754,25 @@ class SynthDaemon:
         cfg = dataclasses.replace(
             self.cfg, save_level_artifacts=ckpt_dir
         )
+        # Per-dispatch run tracer (observability on): the batch
+        # runner's run->level->em_iter tree, grafted under the batch
+        # lead's serve_request root at settle.  Instrumentation only —
+        # `synthesize_batch` reads the tracer, never branches numerics
+        # on it (the solo-dispatch bit-identity test pins this) — and
+        # LEAN: the runner keeps the span tree but skips its optional
+        # per-level device readbacks (energy means, shard-sync walls),
+        # so request tracing adds no device syncs to the hot path.
+        run_tracer = None
+        if self.observability and self.tracer is not None \
+                and self.tracer.enabled:
+            from ..telemetry.spans import Tracer
+
+            run_tracer = Tracer(lean=True)
 
         def attempt(resume_from):
             return synthesize_batch(
                 self.a, self.ap, frames, cfg, self.mesh,
+                progress=run_tracer,
                 resume_from=resume_from,
                 frame_indices=[0] * grain,
                 _b_stats=b_stats,
@@ -580,7 +804,19 @@ class SynthDaemon:
                     self._c_failed.inc()
         finally:
             shutil.rmtree(ckpt_dir, ignore_errors=True)
-            self._settle_batch(batch, admit_t)
+            run_roots, compile_ms = (), None
+            if run_tracer is not None:
+                run_roots = tuple(run_tracer.roots)
+                walls = [
+                    sp.wall_ms for sp in run_tracer.find("prologue")
+                    if sp.wall_ms is not None
+                ]
+                if walls:
+                    compile_ms = round(sum(walls), 3)
+            self._settle_batch(
+                batch, admit_t, run_roots=run_roots,
+                compile_ms=compile_ms,
+            )
 
     # ---------------------------------------------- session dispatch
     def _session_stream(self, sid: str, proto: ServeRequest):
@@ -626,9 +862,10 @@ class SynthDaemon:
             stream = self._session_stream(sid, batch[0])
             outs = []
             for req in batch:
-                outs.append(
-                    np.asarray(stream.step(req.frame), np.float32)
-                )
+                outs.append(np.asarray(
+                    stream.step(req.frame, request_id=req.req_id),
+                    np.float32,
+                ))
             for req in batch:
                 req.span("executed")
             demux(batch, outs)

@@ -106,7 +106,7 @@ def key_str(key: ExecKey) -> str:
 
 class _Entry:
     __slots__ = ("key", "warm", "hits", "compiles", "last_used_t",
-                 "compile_ms")
+                 "compile_ms", "last_request_id")
 
     def __init__(self, key: ExecKey):
         self.key = key
@@ -115,6 +115,10 @@ class _Entry:
         self.compiles = 0
         self.last_used_t = time.monotonic()
         self.compile_ms: Optional[float] = None
+        # Last request to look this entry up (round 15 tracing) — the
+        # /serving snapshot's breadcrumb from a cache line back to a
+        # concrete request id the access log / trace CLI can expand.
+        self.last_request_id: Optional[str] = None
 
 
 class ExecutableCache:
@@ -149,18 +153,22 @@ class ExecutableCache:
             "(client vs warmup)",
         ).inc(labels={"kind": kind})
 
-    def lookup(self, key: ExecKey, kind: str = "client") -> str:
+    def lookup(self, key: ExecKey, kind: str = "client",
+               request_id: Optional[str] = None) -> str:
         """Admit `key`, return "hit" or "miss", and book the counters.
 
         A miss either admits a new entry (evicting the LRU entry at
         capacity — an EPOCH eviction, see the module docstring) or
         re-warms a demoted one.  The caller dispatches either way; the
-        engine's jit caches do the actual reuse/compile."""
+        engine's jit caches do the actual reuse/compile.  `request_id`
+        (round 15) stamps the entry with the looking-up request."""
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:
                 self._entries.move_to_end(key)
                 entry.last_used_t = time.monotonic()
+                if request_id is not None:
+                    entry.last_request_id = request_id
                 if entry.warm:
                     entry.hits += 1
                     self._count("hits", kind)
@@ -174,6 +182,8 @@ class ExecutableCache:
             entry = _Entry(key)
             entry.warm = True
             entry.compiles = 1
+            if request_id is not None:
+                entry.last_request_id = request_id
             self._entries[key] = entry
             if len(self._entries) > self.capacity:
                 self._evict_lru()
@@ -227,6 +237,7 @@ class ExecutableCache:
                         "hits": e.hits,
                         "compiles": e.compiles,
                         "compile_ms": e.compile_ms,
+                        "last_request_id": e.last_request_id,
                     }
                     for e in self._entries.values()
                 ],

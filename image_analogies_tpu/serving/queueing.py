@@ -59,6 +59,15 @@ class ServeRequest:
     session: Optional[str] = None  # session-affinity id (daemon)
     req_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
     enqueue_t: float = field(default_factory=time.monotonic)
+    # Absolute anchors for the SAME instant `enqueue_t` names: `t0` is
+    # wall-clock epoch seconds (so post-mortem dumps from DIFFERENT
+    # requests — whose monotonic zeroes are all their own enqueue — can
+    # be ordered against each other), `enqueue_perf` is the
+    # perf_counter reading the Tracer's span clock uses (so the
+    # request's lifecycle events can be replayed as real spans on the
+    # daemon tracer's timeline).  The relative `t_ms` span fields stay.
+    t0: float = field(default_factory=time.time)
+    enqueue_perf: float = field(default_factory=time.perf_counter)
     done: threading.Event = field(default_factory=threading.Event,
                                   repr=False)
     # Filled by the dispatcher before `done` is set:
@@ -67,6 +76,11 @@ class ServeRequest:
     status: str = "queued"  # queued|ok|failed
     cache: Optional[str] = None  # hit|miss for this request's dispatch
     batch_size: int = 0  # real (unpadded) co-tenant count
+    # Prologue wall of this request's dispatch (ms) — the compile-phase
+    # attribution the access log splits out of the execution window;
+    # None when the dispatch carried no run tracer (sessions, disabled
+    # observability).
+    compile_ms: Optional[float] = None
     spans: List[Dict[str, Any]] = field(default_factory=list)
 
     def span(self, name: str) -> None:
@@ -74,11 +88,15 @@ class ServeRequest:
         compiled|cache-hit -> executed -> demuxed), timestamped
         relative to enqueue — plain dicts, not Tracer spans, because
         requests overlap arbitrarily across threads while the Tracer's
-        span stack is strictly nested."""
+        span stack is strictly nested.  (The daemon converts them into
+        a real per-request span tree at settle time, on the dispatcher
+        thread, where no stack discipline is violated.)  `t_abs` is the
+        wall-clock instant (`t0` + the relative offset)."""
+        t_ms = round((time.monotonic() - self.enqueue_t) * 1000.0, 3)
         self.spans.append({
             "name": name,
-            "t_ms": round((time.monotonic() - self.enqueue_t) * 1000.0,
-                          3),
+            "t_ms": t_ms,
+            "t_abs": round(self.t0 + t_ms / 1000.0, 6),
         })
 
 

@@ -268,6 +268,19 @@ def stack_events(dump: Dict[str, Any]) -> List[Dict[str, Any]]:
     return list(dump.get("events") or [])
 
 
+def request_events(dump: Dict[str, Any],
+                   request_id: str) -> List[Dict[str, Any]]:
+    """Events belonging to one serving request (round 15): the
+    `serve_request` roots carry `request_id` in their attrs, and the
+    daemon replays each request's tree through the observer hook at
+    settle, so a request that finished inside the ring's window shows
+    up here — the `ia-synth trace` CLI's flight-side join."""
+    return [
+        ev for ev in stack_events(dump)
+        if (ev.get("attrs") or {}).get("request_id") == request_id
+    ]
+
+
 def read_flight(path: str) -> Dict[str, Any]:
     import json
 

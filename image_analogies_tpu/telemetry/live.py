@@ -43,12 +43,31 @@ into the server loop.
 
 from __future__ import annotations
 
+import inspect
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 LIVE_FILE = "live.json"
+
+
+def _wants_headers(handler) -> bool:
+    """True when a route handler declares a second positional
+    parameter (beyond `body`) — those receive the request headers as a
+    plain dict (round 15: the serving daemon reads X-Request-Id).
+    One-parameter handlers keep their historical `handler(body)` call
+    shape.  Resolved once per handler at route registration, never per
+    request."""
+    try:
+        params = [
+            p for p in
+            inspect.signature(handler).parameters.values()
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+        ]
+        return len(params) >= 2
+    except (TypeError, ValueError):
+        return False
 
 
 def _walk_spans(spans):
@@ -140,12 +159,17 @@ class _Handler(BaseHTTPRequestHandler):
                         body: Optional[bytes]) -> bool:
         """Injected-route dispatch (round 13: the serving daemon mounts
         its endpoints on this same server).  A route handler returns
-        (code, body_bytes, ctype[, headers]); True = handled."""
+        (code, body_bytes, ctype[, headers]); True = handled.
+        Handlers declaring a second positional parameter additionally
+        receive the request headers as a dict (round 15)."""
         live = self.server.live  # type: ignore[attr-defined]
         handler = live.routes.get((method, path))
         if handler is None:
             return False
-        out = handler(body)
+        if live._route_headers.get((method, path)):
+            out = handler(body, dict(self.headers.items()))
+        else:
+            out = handler(body)
         code, payload, ctype = out[0], out[1], out[2]
         headers = out[3] if len(out) > 3 else None
         self._send(code, payload, ctype, headers)
@@ -230,6 +254,9 @@ class LiveTelemetryServer:
         self.host = host
         self._health_cb = health_cb
         self.routes = dict(routes or {})
+        self._route_headers = {
+            key: _wants_headers(h) for key, h in self.routes.items()
+        }
         self._requested_port = int(port)
         self.port: Optional[int] = None
         self._httpd: Optional[ThreadingHTTPServer] = None

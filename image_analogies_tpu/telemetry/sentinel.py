@@ -126,6 +126,10 @@ _OVERHEAD_GAUGES = (
     # worker thread + forced checkpoints), measured by
     # tests/test_supervisor.py's min-paired-delta pin.
     "ia_supervisor_overhead_frac",
+    # Round 15: the serving observability layer (per-request span
+    # trees + run-subtree tracer + access log), measured by
+    # tests/test_serving.py's paired daemon arms.
+    "ia_serving_observability_overhead_frac",
 )
 
 # Straggler watch (round 10): a level whose slowest shard finishes
@@ -931,6 +935,56 @@ def check_instrument_drift(record: Optional[dict]) -> Dict:
     )
 
 
+def check_slo(metrics: Optional[dict]) -> Dict:
+    """Serving SLO verdict (round 15, telemetry/slo.py): grade the
+    default objectives against the request-duration histogram family.
+
+    Grading is deliberately two-stage (an SLO is a budget, not a
+    threshold): the check is VIOLATED only when some objective's error
+    budget is exhausted (burn >= 1 over the record), DEGRADED when an
+    objective is burning fast (>= FAST_BURN_THRESHOLD of budget
+    consumed) but not spent, and SKIPPED when the serving duration
+    family is silent (no daemon in this session) or every objective
+    lacks data."""
+    from .slo import FAST_BURN_THRESHOLD, evaluate_slo
+
+    report = evaluate_slo(metrics or {})
+    if report["verdict"] == "skipped":
+        return _check(
+            "slo", "skipped",
+            detail="no ia_request_duration_ms observations "
+                   "(no serving traffic in this record)",
+        )
+    worst = [
+        o for o in report["objectives"]
+        if o["status"] in ("exhausted", "fast_burn")
+    ]
+    status = {"violated": "violated", "degraded": "degraded",
+              "ok": "ok"}[report["verdict"]]
+    observed = {
+        o["name"]: {
+            "status": o["status"], "burn_rate": o.get("burn_rate"),
+            "budget_remaining": o.get("budget_remaining"),
+        }
+        for o in report["objectives"]
+    }
+    if status == "ok":
+        detail = "every objective inside its error budget"
+    else:
+        detail = "; ".join(
+            f"{o['name']}: {o['status']} "
+            f"(burn {o.get('burn_rate')})" for o in worst
+        )
+    return _check(
+        "slo", status,
+        expected=(
+            "burn_rate < 1.0 per objective "
+            f"(fast burn at >= {FAST_BURN_THRESHOLD})"
+        ),
+        observed=observed, detail=detail,
+    )
+
+
 # ------------------------------------------------------------ evaluation
 def evaluate_health(
     spans: Optional[dict] = None,
@@ -958,6 +1012,7 @@ def evaluate_health(
         check_recovery(metrics),
         check_serving(metrics),
         check_warm_start(metrics),
+        check_slo(metrics),
     ]
     if bench_record is not None:
         checks.append(check_instrument_drift(bench_record))

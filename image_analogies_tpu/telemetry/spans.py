@@ -147,8 +147,16 @@ class Tracer:
     object can be threaded through every runner).
     """
 
-    def __init__(self, sink=None, registry=None, enabled: bool = True):
+    def __init__(self, sink=None, registry=None, enabled: bool = True,
+                 lean: bool = False):
         self.enabled = enabled
+        # `lean` asks instrumented runners to skip OPTIONAL device
+        # readbacks (per-level nnf-energy means, shard-sync walls)
+        # while keeping the span tree itself: the serving daemon's
+        # per-request run tracer sets it so request-scoped tracing
+        # never adds device syncs to the hot path (round 15; the
+        # observability-overhead test pins the budget).
+        self.lean = lean
         self.sink = sink
         self.registry = registry
         self._t0 = time.perf_counter()
@@ -252,6 +260,30 @@ class Tracer:
                 fields["wall_ms"] = sp.wall_ms
             self.sink.emit(event, **fields)
 
+    def attach_tree(self, root: Span) -> None:
+        """Adopt an already-closed span tree as a new root WITHOUT
+        touching the active stack — the serving daemon's per-request
+        trees are built after the fact (requests overlap arbitrarily,
+        so they can't live on the strictly-nested stack) and grafted
+        here so `to_dict`/`find`/the flight recorder see one forest.
+        Observers are replayed depth-first (open before children,
+        close after), so the flight recorder's event window records
+        the adopted tree like any live one; legacy sink events are NOT
+        re-fired (the tree's original tracer already emitted them)."""
+        if not self.enabled:
+            return
+        self.roots.append(root)
+        if not self._observers:
+            return
+
+        def replay(sp: Span) -> None:
+            self._notify("open", sp)
+            for c in sp.children:
+                replay(c)
+            self._notify("close", sp)
+
+        replay(root)
+
     # -- output -------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -303,6 +335,22 @@ class Tracer:
 
         walk(self.roots)
         return out
+
+
+def span_at(name: str, t_start: float, t_end: float,
+            **attrs) -> Span:
+    """Build a DETACHED timed Span from explicit perf_counter readings
+    (`time.perf_counter()` values, the same process-wide clock every
+    live span samples) — the primitive the serving daemon uses to
+    reconstruct a request's lifecycle as real spans after the fact.
+    The span is closed (t_end set) but belongs to no tracer; compose
+    with `Span.children` + `Tracer.attach_tree`.  `ts` is backdated so
+    the schema's 'ts = start' promise holds."""
+    sp = Span(name, attrs, NULL_TRACER)
+    sp.t_start = float(t_start)
+    sp.t_end = max(float(t_start), float(t_end))
+    sp.ts = _iso_now(-(time.perf_counter() - sp.t_start) * 1000.0)
+    return sp
 
 
 NULL_TRACER = Tracer(enabled=False)

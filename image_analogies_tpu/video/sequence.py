@@ -398,15 +398,22 @@ class VideoStream:
         self.warm_frames = 0
         self.run_units = 0.0      # modeled units actually scheduled
         self.cold_units = 0.0     # modeled units of the cold equivalent
+        # Serving request id per frame (None outside the daemon) — the
+        # round-15 trace join: which request produced stream frame t.
+        self.request_ids = []
 
     def step(self, frame, *, resume_root: Optional[str] = None,
-             resume_strict: bool = False):
+             resume_strict: bool = False,
+             request_id: Optional[str] = None):
         """Synthesize the next frame; returns stylized (H, W[, 3]).
 
         `resume_root`: root checkpoint directory of a prior run — this
         frame resumes from `frames_{t:05d}` under it (the same per-item
         subdirectory layout the chunked batch runner uses, so warm-off
-        and warm-on runs share checkpoint trees for cold frames)."""
+        and warm-on runs share checkpoint trees for cold frames).
+        `request_id`: the serving request driving this frame, recorded
+        on `self.request_ids` for the trace/accounting join."""
+        self.request_ids.append(request_id)
         cfg = self.cfg
         t = self.t
         can_warm = (

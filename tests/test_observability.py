@@ -1,0 +1,540 @@
+"""Round-15 observability unit tests, below the daemon end-to-end
+layer (tests/test_serving.py owns that): the SLO engine
+(telemetry/slo.py — objective validation, error-budget grading, the
+serialized-histogram arithmetic, the sliding-window engine), the
+structured access log (serving/accesslog.py — atomic append, rotation,
+lookup, phase fields), the SLO artifact validator (tools/check_slo.py)
+and the sentinel's `slo` check."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from check_slo import main as check_slo_main  # noqa: E402
+from check_slo import validate_slo  # noqa: E402
+
+from image_analogies_tpu.serving.accesslog import (  # noqa: E402
+    AccessLog,
+    find_request,
+    phase_fields,
+    read_entries,
+)
+from image_analogies_tpu.telemetry.metrics import (  # noqa: E402
+    MetricsRegistry,
+)
+from image_analogies_tpu.telemetry.sentinel import (  # noqa: E402
+    check_slo,
+    evaluate_health,
+)
+from image_analogies_tpu.telemetry.slo import (  # noqa: E402
+    DEFAULT_OBJECTIVES,
+    FAST_BURN_THRESHOLD,
+    REQUEST_DURATION_BUCKETS,
+    REQUEST_DURATION_METRIC,
+    Objective,
+    SloEngine,
+    evaluate_slo,
+    quantile_from_cell,
+)
+
+
+def _duration_registry(cells):
+    """A registry with one ia_request_duration_ms family.
+    `cells`: {(outcome, cache): [duration_ms, ...]}."""
+    reg = MetricsRegistry()
+    h = reg.histogram(
+        REQUEST_DURATION_METRIC, "request duration",
+        buckets=REQUEST_DURATION_BUCKETS,
+    )
+    for (outcome, cache), values in cells.items():
+        for v in values:
+            h.observe(v, labels={
+                "route": "/synthesize", "outcome": outcome,
+                "cache": cache,
+            })
+    return reg
+
+
+def _duration_metrics(cells):
+    return _duration_registry(cells).to_dict()
+
+
+# ------------------------------------------------ objective semantics
+class TestObjective:
+    def test_kind_validated(self):
+        with pytest.raises(ValueError, match="kind"):
+            Objective(name="x", kind="latency_p99", target=0.99,
+                      threshold_ms=1.0)
+
+    @pytest.mark.parametrize("target", [0.0, -0.5, 1.5])
+    def test_target_validated(self, target):
+        with pytest.raises(ValueError, match="target"):
+            Objective(name="x", kind="availability", target=target)
+
+    def test_latency_needs_threshold(self):
+        with pytest.raises(ValueError, match="threshold_ms"):
+            Objective(name="x", kind="latency", target=0.99)
+
+    def test_allowed_frac_is_the_error_budget(self):
+        # Good-fraction kinds budget the complement ...
+        assert Objective(
+            name="a", kind="availability", target=0.99
+        ).allowed_frac() == pytest.approx(0.01)
+        # ... with a floor so a target of exactly 1.0 never divides
+        # by zero (burn just saturates instead).
+        assert Objective(
+            name="a", kind="availability", target=1.0
+        ).allowed_frac() == pytest.approx(1e-9)
+        # shed_rate budgets the ceiling itself.
+        assert Objective(
+            name="s", kind="shed_rate", target=0.9
+        ).allowed_frac() == pytest.approx(0.9)
+
+    def test_default_latency_thresholds_sit_on_bucket_bounds(self):
+        """The exact-counting contract: every default latency
+        objective's threshold is a REQUEST_DURATION_BUCKETS bound, so
+        budget arithmetic never interpolates."""
+        assert tuple(sorted(REQUEST_DURATION_BUCKETS)) == \
+            REQUEST_DURATION_BUCKETS
+        for obj in DEFAULT_OBJECTIVES:
+            if obj.kind == "latency":
+                assert obj.threshold_ms in REQUEST_DURATION_BUCKETS
+
+
+# --------------------------------------------------- budget grading
+class TestEvaluateSlo:
+    def test_silent_family_grades_no_data_and_skips(self):
+        report = evaluate_slo(MetricsRegistry().to_dict())
+        assert report["verdict"] == "skipped"
+        assert all(
+            o["status"] == "no_data" and o["burn_rate"] is None
+            for o in report["objectives"]
+        )
+
+    def test_healthy_traffic_grades_ok(self):
+        report = evaluate_slo(_duration_metrics({
+            ("ok", "hit"): [20.0] * 99, ("ok", "miss"): [5000.0],
+        }))
+        assert report["verdict"] == "ok"
+        by_name = {o["name"]: o for o in report["objectives"]}
+        lat = by_name["warm_p99_latency_ms"]
+        # Only the warm (ok, hit) cells are the latency denominator.
+        assert lat["denominator"] == 99 and lat["bad_count"] == 0
+        assert lat["observed_p99_ms"] <= 25.0
+        assert by_name["availability"]["availability"] == 1.0
+        assert by_name["shed_rate"]["burn_rate"] == 0.0
+        assert report["outcomes"] == {"ok": 100}
+
+    def test_latency_counts_exactly_at_the_bound(self):
+        """An observation AT the threshold bound is within SLO (the
+        histogram's `le` bucket includes it); one past the bound is
+        bad — no interpolation anywhere near the boundary."""
+        obj = Objective(name="lat", kind="latency", target=0.5,
+                        threshold_ms=100.0, labels={"outcome": "ok"})
+        report = evaluate_slo(_duration_metrics({
+            ("ok", "hit"): [100.0, 100.0001],
+        }), objectives=[obj])
+        (lat,) = report["objectives"]
+        assert lat["bucket_bound_ms"] == 100.0
+        assert lat["denominator"] == 2 and lat["bad_count"] == 1
+        # bad_frac 0.5 against an allowed 0.5: budget exactly spent.
+        assert lat["burn_rate"] == 1.0
+        assert lat["status"] == "exhausted"
+        assert report["verdict"] == "violated"
+
+    def test_between_bound_threshold_rounds_down(self):
+        """A threshold between bounds uses the nearest LOWER bound —
+        the conservative direction (more requests count as slow)."""
+        obj = Objective(name="lat", kind="latency", target=0.99,
+                        threshold_ms=150.0, labels={"outcome": "ok"})
+        report = evaluate_slo(_duration_metrics({
+            ("ok", "hit"): [120.0],  # under 150, but over bound 100
+        }), objectives=[obj])
+        (lat,) = report["objectives"]
+        assert lat["bucket_bound_ms"] == 100.0
+        assert lat["bad_count"] == 1
+
+    def test_availability_excludes_unadmitted_outcomes(self):
+        """Shed/rejected requests never entered the backend: they are
+        not availability's denominator (a daemon shedding load is not
+        'down')."""
+        report = evaluate_slo(_duration_metrics({
+            ("ok", "hit"): [20.0] * 19, ("failed", "hit"): [40.0],
+            ("shed", "none"): [1.0] * 30, ("rejected", "none"): [1.0],
+        }))
+        by_name = {o["name"]: o for o in report["objectives"]}
+        avail = by_name["availability"]
+        assert avail["denominator"] == 20 and avail["bad_count"] == 1
+        assert avail["availability"] == pytest.approx(0.95)
+        # 5% bad over a 1% budget: exhausted, record-level violated.
+        assert avail["status"] == "exhausted"
+        assert report["verdict"] == "violated"
+        # shed_rate: 30 shed over 50 at-admission requests = 0.6 of
+        # the 0.9 ceiling -> fast burn, not violation.
+        shed = by_name["shed_rate"]
+        assert shed["denominator"] == 50 and shed["bad_count"] == 30
+        assert shed["burn_rate"] == pytest.approx(0.6667, abs=1e-3)
+        assert shed["status"] == "fast_burn"
+
+    def test_fast_burn_degrades_before_violation(self):
+        obj = Objective(name="a", kind="availability", target=0.9)
+        report = evaluate_slo(_duration_metrics({
+            ("ok", "hit"): [20.0] * 19, ("failed", "hit"): [40.0],
+        }), objectives=[obj])
+        (avail,) = report["objectives"]
+        # bad_frac 0.05 of an allowed 0.1 = burn 0.5, exactly the
+        # fast-burn threshold.
+        assert avail["burn_rate"] == pytest.approx(
+            FAST_BURN_THRESHOLD
+        )
+        assert avail["status"] == "fast_burn"
+        assert avail["budget_remaining"] == pytest.approx(0.5)
+        assert report["verdict"] == "degraded"
+
+    def test_timeout_counts_against_availability(self):
+        obj = Objective(name="a", kind="availability", target=0.5)
+        report = evaluate_slo(_duration_metrics({
+            ("ok", "hit"): [20.0] * 3, ("timeout", "none"): [9e5],
+        }), objectives=[obj])
+        (avail,) = report["objectives"]
+        assert avail["denominator"] == 4 and avail["bad_count"] == 1
+
+    def test_report_schema(self):
+        report = evaluate_slo(
+            _duration_metrics({("ok", "hit"): [20.0]}), window_s=12.5
+        )
+        assert report["schema_version"] == 1
+        assert report["kind"] == "slo"
+        assert report["metric"] == REQUEST_DURATION_METRIC
+        assert report["window_s"] == 12.5
+        for o in report["objectives"]:
+            if o["status"] == "no_data":
+                continue
+            assert o["burn_rate"] + o["budget_remaining"] == \
+                pytest.approx(1.0, abs=1e-3)
+
+
+# ------------------------------------- serialized-histogram quantiles
+class TestQuantileFromCell:
+    def _cell(self, values):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", "x", buckets=REQUEST_DURATION_BUCKETS)
+        for v in values:
+            h.observe(v)
+        cell = reg.to_dict()["h"]["values"]["total"]
+        return h, cell
+
+    @pytest.mark.parametrize("q", [0.5, 0.9, 0.99, 1.0])
+    def test_parity_with_live_histogram(self, q):
+        """The offline estimator must answer exactly like
+        metrics.Histogram.quantile on the same observations — the
+        /serving snapshot and a graded artifact may never disagree."""
+        h, cell = self._cell(
+            [3.0, 7.0, 7.0, 40.0, 180.0, 900.0, 4000.0, 29000.0]
+        )
+        assert quantile_from_cell(cell, q) == pytest.approx(
+            h.quantile(q)
+        )
+
+    def test_empty_cell_is_none(self):
+        assert quantile_from_cell(
+            {"count": 0, "sum": 0.0, "buckets": {}}, 0.99
+        ) is None
+
+    def test_q_validated(self):
+        with pytest.raises(ValueError):
+            quantile_from_cell({"count": 1, "buckets": {"5.0": 1}}, 0.0)
+
+    def test_overflow_clamps_to_highest_finite_bound(self):
+        h, cell = self._cell([700000.0])  # past the last bucket
+        assert quantile_from_cell(cell, 0.99) == max(
+            REQUEST_DURATION_BUCKETS
+        )
+        assert h.quantile(0.99) == max(REQUEST_DURATION_BUCKETS)
+
+
+# ----------------------------------------------- sliding-window engine
+class TestSloEngine:
+    def test_first_evaluation_covers_process_lifetime(self):
+        reg = _duration_registry({("failed", "none"): [50.0]})
+        engine = SloEngine(reg)
+        report = engine.evaluate()
+        assert report["window_s"] is None
+        by_name = {o["name"]: o for o in report["objectives"]}
+        assert by_name["availability"]["bad_count"] == 1
+
+    def test_window_delta_counts_only_new_traffic(self):
+        reg = MetricsRegistry()
+        h = reg.histogram(
+            REQUEST_DURATION_METRIC, "d",
+            buckets=REQUEST_DURATION_BUCKETS,
+        )
+        labels = {"route": "/synthesize", "outcome": "failed",
+                  "cache": "none"}
+        h.observe(50.0, labels=labels)
+        engine = SloEngine(reg, window_s=300.0)
+        engine.evaluate()  # snapshot the 1-failure baseline
+        ok = {"route": "/synthesize", "outcome": "ok", "cache": "hit"}
+        for _ in range(5):
+            h.observe(20.0, labels=ok)
+        report = engine.evaluate()
+        assert report["window_s"] is not None
+        by_name = {o["name"]: o for o in report["objectives"]}
+        # The pre-window failure is subtracted out: this window saw
+        # only the 5 clean requests.
+        avail = by_name["availability"]
+        assert avail["denominator"] == 5 and avail["bad_count"] == 0
+        assert avail["status"] == "ok"
+
+    def test_expired_snapshots_fall_back_to_lifetime(self):
+        reg = _duration_registry({("ok", "hit"): [20.0]})
+        engine = SloEngine(reg, window_s=0.01)
+        assert engine.evaluate()["window_s"] is None
+        time.sleep(0.05)  # the only snapshot ages out
+        assert engine.evaluate()["window_s"] is None
+
+    def test_publishes_burn_gauges_on_evaluate(self):
+        reg = _duration_registry({("ok", "hit"): [20.0] * 4})
+        SloEngine(reg).evaluate()
+        gauges = reg.to_dict()["ia_slo_burn_rate"]["values"]
+        assert gauges['{objective="availability"}'] == 0.0
+        budgets = reg.to_dict()["ia_slo_budget_remaining"]["values"]
+        assert budgets['{objective="warm_p99_latency_ms"}'] == 1.0
+
+
+# -------------------------------------------------------- access log
+class TestAccessLog:
+    def _entry(self, i, **kw):
+        e = {"request_id": f"r{i:04d}", "outcome": "ok",
+             "total_ms": float(i), "pad": "x" * 80}
+        e.update(kw)
+        return e
+
+    def test_roundtrip_in_order(self, tmp_path):
+        path = str(tmp_path / "access.jsonl")
+        log = AccessLog(path)
+        for i in range(5):
+            log.log(self._entry(i))
+        log.close()
+        recs = list(read_entries(path))
+        assert [r["request_id"] for r in recs] == [
+            f"r{i:04d}" for i in range(5)
+        ]
+
+    def test_rotation_keeps_one_generation(self, tmp_path):
+        path = str(tmp_path / "access.jsonl")
+        log = AccessLog(path, max_bytes=1024)
+        for i in range(12):  # ~110 B/line: exactly one rotation
+            log.log(self._entry(i))
+        log.close()
+        assert os.path.exists(path + ".1")
+        recs = list(read_entries(path))
+        # One rotation loses nothing; readers walk .1 then live,
+        # oldest first.
+        assert [r["request_id"] for r in recs] == [
+            f"r{i:04d}" for i in range(12)
+        ]
+
+    def test_find_request_latest_wins(self, tmp_path):
+        path = str(tmp_path / "access.jsonl")
+        log = AccessLog(path)
+        log.log(self._entry(1, outcome="failed"))
+        log.log(self._entry(1, outcome="ok"))
+        log.close()
+        assert find_request(path, "r0001")["outcome"] == "ok"
+        assert find_request(path, "nope") is None
+
+    def test_write_errors_degrade_not_raise(self, tmp_path,
+                                            monkeypatch):
+        path = str(tmp_path / "access.jsonl")
+        log = AccessLog(path)
+        log.log(self._entry(0))  # opens the fd
+        real_write = os.write
+        monkeypatch.setattr(
+            os, "write",
+            lambda fd, data: (_ for _ in ()).throw(OSError(28, "full")),
+        )
+        log.log(self._entry(1))
+        monkeypatch.setattr(os, "write", real_write)
+        assert log.errors == 1
+        log.log(self._entry(2))
+        log.close()
+        ids = [r["request_id"] for r in read_entries(path)]
+        assert ids == ["r0000", "r0002"]
+
+    def test_unparseable_lines_skipped(self, tmp_path):
+        path = str(tmp_path / "access.jsonl")
+        log = AccessLog(path)
+        log.log(self._entry(0))
+        log.close()
+        with open(path, "a") as f:
+            f.write('{"torn": ')  # crash mid-write
+        assert [r["request_id"] for r in read_entries(path)] == [
+            "r0000"
+        ]
+
+    def test_max_bytes_validated(self, tmp_path):
+        with pytest.raises(ValueError):
+            AccessLog(str(tmp_path / "a.jsonl"), max_bytes=10)
+
+    def test_phase_fields_order_and_filtering(self):
+        rec = {"queue_ms": 1.5, "execute_ms": 30.0, "demux_ms": 0.5,
+               "compile_ms": 0.0, "total_ms": 33.0,
+               "exec_key": "not-a-phase"}
+        assert phase_fields(rec) == [
+            ("queue", 1.5), ("compile", 0.0), ("execute", 30.0),
+            ("demux", 0.5),
+        ]
+        assert phase_fields({"queue_ms": "12"}) == []  # non-numeric
+
+
+# ------------------------------------------------- artifact validator
+def _valid_slo_record():
+    return {
+        "schema_version": 1,
+        "kind": "slo",
+        "round": 15,
+        "proxy_size": 32,
+        "slo": {
+            "schema_version": 1, "kind": "slo",
+            "metric": "ia_request_duration_ms", "window_s": None,
+            "outcomes": {"ok": 9, "shed": 2},
+            "objectives": [
+                {"name": "warm_p99_latency_ms", "kind": "latency",
+                 "target": 0.99, "allowed_frac": 0.01,
+                 "denominator": 8, "bad_count": 0,
+                 "threshold_ms": 30000.0, "bucket_bound_ms": 30000.0,
+                 "observed_p99_ms": 95.0, "observed_p50_ms": 48.0,
+                 "bad_frac": 0.0, "burn_rate": 0.0,
+                 "budget_remaining": 1.0, "status": "ok"},
+                {"name": "availability", "kind": "availability",
+                 "target": 0.99, "allowed_frac": 0.01,
+                 "denominator": 9, "bad_count": 0,
+                 "availability": 1.0, "bad_frac": 0.0,
+                 "burn_rate": 0.0, "budget_remaining": 1.0,
+                 "status": "ok"},
+                {"name": "shed_rate", "kind": "shed_rate",
+                 "target": 0.9, "allowed_frac": 0.9,
+                 "denominator": 11, "bad_count": 2,
+                 "bad_frac": 0.181818, "burn_rate": 0.202,
+                 "budget_remaining": 0.798, "status": "ok"},
+            ],
+            "verdict": "ok",
+        },
+        "p99_warm_ms": 95.0,
+        "availability": 1.0,
+        "request_ids": ["slo-warm-probe", "abc123def456"],
+        "critical_path": {
+            "request_id": "slo-warm-probe",
+            "total_ms": 40.0,
+            "phases": {"queue_ms": 5.0, "compile_ms": 0.0,
+                       "execute_ms": 30.0, "demux_ms": 4.5},
+            "attributed_ms": 39.5,
+            "gap_pct": 1.25,
+        },
+    }
+
+
+class TestCheckSloValidator:
+    def test_valid_record_passes(self):
+        assert validate_slo(_valid_slo_record()) == []
+
+    @pytest.mark.parametrize("mutate,needle", [
+        (lambda r: r.update(schema_version=2), "schema_version"),
+        (lambda r: r.update(kind="serve"), "kind"),
+        (lambda r: r.update(round=14), "round"),
+        (lambda r: r.update(slo=None), "slo"),
+        (lambda r: r["slo"].update(objectives=[]), "objectives"),
+        (lambda r: r["slo"]["objectives"][0].update(
+            status="exhausted"), "exhausted"),
+        (lambda r: r["slo"]["objectives"][1].update(
+            burn_rate=0.3), "!= 1"),
+        (lambda r: r["slo"]["objectives"][2].update(
+            target=1.5), "target"),
+        (lambda r: r["slo"].update(verdict="violated"), "verdict"),
+        (lambda r: r.update(p99_warm_ms=0), "p99_warm_ms"),
+        (lambda r: r.update(availability=0.97), "availability"),
+        (lambda r: r.update(request_ids=[]), "request_ids"),
+        (lambda r: r.update(
+            request_ids=["dup", "dup"]), "duplicate"),
+        (lambda r: r["critical_path"].update(request_id=""),
+         "request_id"),
+        (lambda r: r["critical_path"]["phases"].update(
+            execute_ms=-1.0), "execute_ms"),
+        (lambda r: r["critical_path"].update(total_ms=80.0),
+         "deviates"),
+    ])
+    def test_mutations_fail(self, mutate, needle):
+        record = _valid_slo_record()
+        mutate(record)
+        errs = validate_slo(record)
+        assert errs, f"mutation {needle!r} passed validation"
+        assert any(needle in e for e in errs), errs
+
+    def test_no_data_objective_skips_budget_arithmetic(self):
+        record = _valid_slo_record()
+        record["slo"]["objectives"][0].update(
+            status="no_data", burn_rate=None, budget_remaining=None,
+            bad_frac=None,
+        )
+        assert validate_slo(record) == []
+
+    def test_gap_exactly_at_bound_passes(self):
+        record = _valid_slo_record()
+        record["critical_path"]["phases"] = {
+            "queue_ms": 0.0, "compile_ms": 0.0,
+            "execute_ms": 38.0, "demux_ms": 0.0,
+        }  # |40 - 38| / 40 = 0.05, on the bound
+        assert validate_slo(record) == []
+
+    def test_cli_exit_codes(self, tmp_path):
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(_valid_slo_record()))
+        assert check_slo_main([str(good)]) == 0
+        bad_record = _valid_slo_record()
+        bad_record["slo"]["verdict"] = "violated"
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(bad_record))
+        assert check_slo_main([str(bad)]) == 1
+        assert check_slo_main([str(tmp_path / "absent.json")]) == 1
+
+
+# --------------------------------------------------- sentinel check
+class TestSentinelSloCheck:
+    def test_skipped_without_serving_traffic(self):
+        check = check_slo(MetricsRegistry().to_dict())
+        assert check["status"] == "skipped"
+
+    def test_ok_inside_budget(self):
+        check = check_slo(_duration_metrics({
+            ("ok", "hit"): [20.0] * 100,
+        }))
+        assert check["status"] == "ok", check
+        assert check["observed"]["availability"]["burn_rate"] == 0.0
+
+    def test_fast_burn_degrades(self):
+        # 60% of the 90% shed ceiling consumed: early warning.
+        check = check_slo(_duration_metrics({
+            ("ok", "hit"): [20.0] * 20, ("shed", "none"): [1.0] * 30,
+        }))
+        assert check["status"] == "degraded", check
+        assert "shed_rate" in check["detail"]
+
+    def test_exhausted_budget_violates(self):
+        check = check_slo(_duration_metrics({
+            ("ok", "hit"): [20.0] * 9, ("failed", "hit"): [40.0],
+        }))
+        assert check["status"] == "violated", check
+        assert "availability" in check["detail"]
+
+    def test_wired_into_evaluate_health(self):
+        health = evaluate_health(metrics=_duration_metrics({
+            ("ok", "hit"): [20.0] * 100,
+        }))
+        names = [c["name"] for c in health["checks"]]
+        assert "slo" in names

@@ -16,6 +16,7 @@ SIGTERM flight dump) and a fresh serve_load sweep are slow-marked
 import base64
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -81,11 +82,13 @@ def _body(frame: np.ndarray) -> bytes:
     }).encode()
 
 
-def _post(url: str, body: bytes, timeout: float = 300.0):
+def _post(url: str, body: bytes, timeout: float = 300.0,
+          headers=None):
     """(status, parsed-json, headers) for POST /synthesize."""
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
     req = urllib.request.Request(
-        url + "/synthesize", data=body, method="POST",
-        headers={"Content-Type": "application/json"},
+        url + "/synthesize", data=body, method="POST", headers=hdrs,
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -357,6 +360,35 @@ class TestAdmissionController:
             h.observe(2000.0, labels={"phase": "service"})
         assert adm.retry_after(1000) == 60.0  # ceiling clamp
         assert adm.retry_after(1) >= 1.0
+
+    def test_retry_after_clamp_boundaries(self):
+        """Round-15 satellite: the exact clamp edges.  Zero backlog
+        prices as ONE queued service time (the shed request itself
+        still has to run somewhere), the estimate is monotone in
+        backlog between the clamps, and a sub-second estimate rides
+        the 1 s floor rather than telling clients to hammer."""
+        reg = MetricsRegistry()
+        adm = AdmissionController(max_depth=4, registry=reg)
+        h = reg.histogram(
+            "ia_serve_request_ms",
+            "serving request latency by lifecycle phase (ms)",
+        )
+        for _ in range(8):
+            h.observe(2000.0, labels={"phase": "service"})
+        assert adm.retry_after(0) == adm.retry_after(1)
+        assert adm.retry_after(0) >= 1.0
+        assert adm.retry_after(4) <= adm.retry_after(16) <= 60.0
+        assert adm.retry_after(10**6) == 60.0
+        # Fast backend: 100 ms p50 estimates under a second -> floor.
+        reg2 = MetricsRegistry()
+        adm2 = AdmissionController(max_depth=4, registry=reg2)
+        h2 = reg2.histogram(
+            "ia_serve_request_ms",
+            "serving request latency by lifecycle phase (ms)",
+        )
+        for _ in range(8):
+            h2.observe(100.0, labels={"phase": "service"})
+        assert adm2.retry_after(0) == 1.0
 
     def test_degraded_backend_halves_depth(self):
         reg = MetricsRegistry()
@@ -706,14 +738,49 @@ class TestCommittedServeArtifact:
         assert record["cache"]["latency_delta_ms"] > 100.0
 
 
+class TestCommittedSloArtifact:
+    def test_committed_artifact_validates(self):
+        from check_slo import main as check_slo_main
+
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "SLO_r15.json"
+        )
+        assert os.path.isfile(path), (
+            "SLO_r15.json missing — regenerate with "
+            "`python tools/serve_load.py --out /tmp/SERVE.json "
+            "--slo-out SLO_r15.json`"
+        )
+        assert check_slo_main([path]) == 0
+        with open(path) as f:
+            record = json.load(f)
+        assert record["round"] == 15
+        # The headline claims: the warm path meets its latency
+        # objective with real headroom, nothing failed, and the
+        # committed critical path reconstructs within the CLI bound.
+        assert record["p99_warm_ms"] < 30000.0
+        assert record["availability"] == 1.0
+        assert record["critical_path"]["gap_pct"] <= 5.0
+
+
 # ------------------------------------------------- daemon end-to-end
 @pytest.fixture(scope="module")
-def daemon_scenario():
+def daemon_scenario(tmp_path_factory):
     """One in-process daemon, real engine, one compile: cold/warm
     requests, an injected give-up, and an overload burst — the
-    acceptance scenarios, sharing a single compiled executable."""
-    from image_analogies_tpu.runtime.faults import set_fault_plan
+    acceptance scenarios, sharing a single compiled executable.
 
+    Round 15: the daemon runs with full observability wired the way
+    cli.cmd_serve wires it — a real Tracer, a FlightRecorder observer,
+    and an access log in a trace dir that outlives daemon.stop() — so
+    the request-tracing tests can join the response bodies against the
+    span trees, the flight dump, the access log, and the `ia-synth
+    trace` CLI."""
+    from image_analogies_tpu.runtime.faults import set_fault_plan
+    from image_analogies_tpu.serving.accesslog import read_entries
+    from image_analogies_tpu.telemetry.flight import FlightRecorder
+    from image_analogies_tpu.telemetry.spans import Tracer
+
+    trace_dir = str(tmp_path_factory.mktemp("serve-trace"))
     rng = np.random.default_rng(7)
     a, ap, b = (
         rng.random((24, 24, 3)).astype(np.float32) for _ in range(3)
@@ -721,16 +788,29 @@ def daemon_scenario():
     cfg = SynthConfig(**_SERVE_CFG)
     reg = MetricsRegistry()
     prev = set_registry(reg)
+    tracer = Tracer(registry=reg)
+    # Capacity raised over the serving default: every settled request
+    # replays its whole tree through the observer, and the burst would
+    # otherwise push the earliest (cold, pinned-id) requests out of
+    # the ring before the tests read it.
+    flight = FlightRecorder(
+        tracer, reg, os.path.join(trace_dir, "flight.json"),
+        capacity=4096,
+    )
+    tracer.add_observer(flight.observe)
     daemon = SynthDaemon(
-        a, ap, cfg, registry=reg,
+        a, ap, cfg, registry=reg, tracer=tracer, flight=flight,
         max_batch=1, max_wait_ms=5.0, max_queue_depth=2,
         cache_capacity=4, max_retries=1,
+        access_log_path=os.path.join(trace_dir, "access.jsonl"),
     ).start()
     body = _body(b)
-    out = {}
+    out = {"trace_dir": trace_dir, "tracer": tracer}
     try:
         out["cold"] = _post(daemon.url, body)
-        out["warm"] = _post(daemon.url, body)
+        out["warm"] = _post(
+            daemon.url, body, headers={"X-Request-Id": "pin-req-1"}
+        )
         # What a direct solo dispatch of the same request produces —
         # the isolation contract says the daemon's answer must be
         # bit-identical (same PRNG identity, same luminance bucket).
@@ -743,6 +823,14 @@ def daemon_scenario():
         out["serving"] = json.loads(_get(daemon.url + "/serving")[1])
         out["metrics_text"] = _get(daemon.url + "/metrics")[1].decode()
         out["health_mid"] = daemon.health()
+
+        # Round 15 error contract: a malformed body 400s with the id
+        # echoed; a hostile X-Request-Id is replaced, never echoed.
+        out["bad"] = _post(daemon.url, b"not json")
+        out["bad_rid"] = _post(
+            daemon.url, body,
+            headers={"X-Request-Id": "bad id with spaces!"},
+        )
 
         set_fault_plan("level:0:raise:2")  # outlives max_retries=1
         out["gave_up"] = _post(daemon.url, body)
@@ -765,10 +853,16 @@ def daemon_scenario():
             t.join(timeout=300)
         out["burst"] = results
         out["health_end"] = daemon.health()
+        out["slo"] = json.loads(_get(daemon.url + "/slo")[1])
     finally:
         set_fault_plan(None)
         daemon.stop()
         set_registry(prev)
+    flight.flush("manual")  # <trace_dir>/flight.json for the trace CLI
+    out["flight"] = flight.to_dict("manual")
+    out["access"] = list(
+        read_entries(os.path.join(trace_dir, "access.jsonl"))
+    )
     return out
 
 
@@ -856,6 +950,275 @@ class TestDaemonEndToEnd:
             observed["admitted"] + observed["shed"]
         )
         assert observed["shed"] >= 1
+
+
+# --------------------------------------- request-scoped tracing (r15)
+class TestRequestTracing:
+    """Round-15 tentpole: every /synthesize exit echoes a request id,
+    each settled request leaves ONE connected `serve_request` span
+    tree on the daemon tracer (run subtree grafted under the batch
+    lead), every outcome leaves an access-log line whose phase
+    attribution reconstructs the measured latency, and `ia-synth
+    trace <id>` renders it all back."""
+
+    def test_request_id_echoed_or_generated(self, daemon_scenario):
+        _, cold, _ = daemon_scenario["cold"]
+        assert re.fullmatch(r"[0-9a-f]{12}", cold["request_id"])
+        _, warm, _ = daemon_scenario["warm"]
+        assert warm["request_id"] == "pin-req-1"
+        # A hostile client id (spaces, shell metachars) is replaced by
+        # a server-generated one, never echoed into logs and labels.
+        code, r, _ = daemon_scenario["bad_rid"]
+        assert code == 200
+        assert re.fullmatch(r"[0-9a-f]{12}", r["request_id"])
+
+    def test_error_paths_carry_error_and_request_id(
+        self, daemon_scenario
+    ):
+        code, r, _ = daemon_scenario["bad"]
+        assert code == 400 and r["status"] == "rejected"
+        assert r["error"] and re.fullmatch(
+            r"[0-9a-f]{12}", r["request_id"]
+        )
+        code, r, _ = daemon_scenario["gave_up"]
+        assert code == 500 and r["error"] and r["request_id"]
+        shed = [r for c, r, _ in daemon_scenario["burst"] if c == 429]
+        assert shed
+        assert all(r["error"] and r["request_id"] for r in shed)
+
+    def test_one_connected_span_tree_per_request(self, daemon_scenario):
+        tracer = daemon_scenario["tracer"]
+        roots = [
+            sp for sp in tracer.roots if sp.name == "serve_request"
+        ]
+        by_rid = {sp.attrs["request_id"]: sp for sp in roots}
+        # Every dispatched request (not the 400/429 exits) has exactly
+        # one root, carrying outcome + cache verdict.
+        assert len(by_rid) == len(roots)
+        for key, outcome in (("cold", "ok"), ("warm", "ok"),
+                             ("gave_up", "failed")):
+            rid = daemon_scenario[key][1]["request_id"]
+            assert by_rid[rid].attrs["outcome"] == outcome, key
+        warm = by_rid["pin-req-1"]
+        names = [c.name for c in warm.children]
+        # Lifecycle children in order, then the grafted run subtree
+        # (this request was the batch lead of its own dispatch).
+        assert names[:5] == [
+            "queued", "admitted", "cache-hit", "executed", "demuxed",
+        ]
+        assert warm.attrs["run_attached"] >= 1
+        assert "level" in names  # the engine's own spans, same tree
+        # The lifecycle children are CLOSED (timed) and sit inside
+        # the root's wall.  (Run-subtree annotations like `run_plan`
+        # are point markers — no wall by design.)
+        assert warm.wall_ms is not None
+        assert all(
+            c.wall_ms is not None and c.wall_ms <= warm.wall_ms + 1.0
+            for c in warm.children[:5]
+        )
+
+    def test_flight_dump_joins_requests_and_validates(
+        self, daemon_scenario
+    ):
+        from check_report import validate_flight
+
+        from image_analogies_tpu.telemetry.flight import request_events
+
+        dump = daemon_scenario["flight"]
+        assert validate_flight(dump) == []
+        evs = request_events(dump, "pin-req-1")
+        assert any(ev["name"] == "serve_request" for ev in evs)
+        assert any(ev["kind"] == "close" for ev in evs)
+
+    def test_access_log_covers_every_outcome(self, daemon_scenario):
+        entries = daemon_scenario["access"]
+        outcomes = {e["outcome"] for e in entries}
+        assert {"ok", "failed", "shed", "rejected"} <= outcomes
+        for e in entries:
+            assert e["request_id"] and e["route"] == "/synthesize"
+            assert e["total_ms"] >= 0 and e["bytes_in"] >= 0
+        # Settled requests carry the executable key + cache verdict.
+        warm = [e for e in entries if e["request_id"] == "pin-req-1"]
+        assert len(warm) == 1
+        assert warm[0]["cache"] == "hit" and warm[0]["exec_key"]
+        assert warm[0]["t0"] > 0  # absolute wall anchor (satellite 1)
+
+    def test_phase_attribution_within_5pct(self, daemon_scenario):
+        """The acceptance bound: queue+compile+execute+demux explain
+        the measured end-to-end latency of the warm request to within
+        5% (same bound tools/check_slo.py freezes into SLO_r15.json)."""
+        from image_analogies_tpu.serving.accesslog import phase_fields
+
+        (warm,) = [
+            e for e in daemon_scenario["access"]
+            if e["request_id"] == "pin-req-1"
+        ]
+        phases = phase_fields(warm)
+        assert [p for p, _ in phases] == [
+            "queue", "compile", "execute", "demux",
+        ]
+        attributed = sum(ms for _, ms in phases)
+        assert attributed == pytest.approx(
+            warm["total_ms"], rel=0.05
+        ), (phases, warm["total_ms"])
+        # The warm request skipped the jit compile: its prologue wall
+        # is millis, while the cold request's carries the real
+        # compile (seconds).  Attribution must show that cliff.
+        cold_rid = daemon_scenario["cold"][1]["request_id"]
+        (cold,) = [
+            e for e in daemon_scenario["access"]
+            if e["request_id"] == cold_rid
+        ]
+        assert dict(phases)["compile"] < cold["compile_ms"] / 10.0
+
+    def test_slo_route_grades_real_outcomes(self, daemon_scenario):
+        slo = daemon_scenario["slo"]
+        assert slo["schema_version"] == 1 and slo["kind"] == "slo"
+        assert slo["metric"] == "ia_request_duration_ms"
+        assert slo["outcomes"]["ok"] >= 4
+        assert slo["outcomes"]["failed"] == 1
+        assert slo["outcomes"]["shed"] >= 1
+        assert slo["outcomes"]["rejected"] >= 1
+        by_name = {o["name"]: o for o in slo["objectives"]}
+        # Warm hits were all far under the 30 s threshold.
+        lat = by_name["warm_p99_latency_ms"]
+        assert lat["status"] == "ok" and lat["bad_count"] == 0
+        assert lat["observed_p99_ms"] < lat["threshold_ms"]
+        # The injected give-up over this tiny denominator honestly
+        # exhausts the 99% availability budget: the SLO engine must
+        # report the breach, not launder it.
+        avail = by_name["availability"]
+        assert avail["bad_count"] == 1
+        assert avail["status"] == "exhausted"
+        assert avail["burn_rate"] >= 1.0
+        assert slo["verdict"] == "violated"
+        # /slo evaluation published the burn-rate gauges.
+        text = daemon_scenario["metrics_text"]
+        assert "ia_request_duration_ms" in text
+
+    def test_trace_cli_renders_waterfall(self, daemon_scenario, capsys):
+        from image_analogies_tpu.cli import main as cli_main
+
+        d = daemon_scenario["trace_dir"]
+        rc = cli_main(["trace", "pin-req-1", "--trace-dir", d])
+        assert rc == 0
+        printed = capsys.readouterr().out
+        assert "pin-req-1" in printed
+        for phase in ("queue", "compile", "execute", "demux"):
+            assert phase in printed
+        assert "gap" in printed  # the attribution-vs-total line
+        # JSON mode round-trips the access record + flight join.
+        rc = cli_main([
+            "trace", "pin-req-1", "--trace-dir", d, "--format", "json",
+        ])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["access"]["request_id"] == "pin-req-1"
+        assert any(
+            ev["name"] == "serve_request"
+            for ev in doc["flight_events"]
+        )
+
+    def test_trace_cli_unknown_id_exits_nonzero(
+        self, daemon_scenario
+    ):
+        from image_analogies_tpu.cli import main as cli_main
+
+        with pytest.raises(SystemExit, match="no-such-request"):
+            cli_main([
+                "trace", "no-such-request",
+                "--trace-dir", daemon_scenario["trace_dir"],
+            ])
+
+
+# --------------------------------- observability overhead pin (r15)
+class TestServingObservabilityOverhead:
+    """Round-15 acceptance pin: request tracing + access log + SLO
+    booking stay under OVERHEAD_BUDGET_FRAC of warm request latency.
+    Min-paired-delta harness (the test_live.py recipe): an
+    observability-on daemon and a bare arm (observability=False)
+    serve the same warm shape alternately; the MINIMUM paired delta
+    divided by the median bare latency isolates the systematic cost
+    from scheduler noise.  Both arms share the process-wide jit cache
+    for the 24^2 shape, so no extra compile is paid."""
+
+    PAIRS = 6
+
+    def test_overhead_under_budget_and_sentinel_visible(
+        self, tmp_path
+    ):
+        import statistics
+
+        from image_analogies_tpu.telemetry.metrics import get_registry
+        from image_analogies_tpu.telemetry.sentinel import (
+            OVERHEAD_BUDGET_FRAC,
+            evaluate_health,
+        )
+        from image_analogies_tpu.telemetry.spans import Tracer
+
+        rng = np.random.default_rng(11)
+        a, ap, b = (
+            rng.random((24, 24, 3)).astype(np.float32)
+            for _ in range(3)
+        )
+        cfg = SynthConfig(**_SERVE_CFG)
+        body = _body(b)
+        reg_on = MetricsRegistry()
+        on = SynthDaemon(
+            a, ap, cfg, registry=reg_on,
+            tracer=Tracer(registry=reg_on),
+            max_batch=1, max_wait_ms=1.0, max_queue_depth=4,
+            access_log_path=str(tmp_path / "access.jsonl"),
+        ).start()
+        reg_off = MetricsRegistry()
+        off = SynthDaemon(
+            a, ap, cfg, registry=reg_off, observability=False,
+            max_batch=1, max_wait_ms=1.0, max_queue_depth=4,
+        ).start()
+        bases, deltas, images = [], [], []
+        try:
+            for d in (off, on):  # warm both arms once
+                code, r, _ = _post(d.url, body)
+                assert code == 200, r
+            for _ in range(self.PAIRS):
+                t0 = time.perf_counter()
+                code_off, r_off, _ = _post(off.url, body)
+                t1 = time.perf_counter()
+                code_on, r_on, _ = _post(on.url, body)
+                t2 = time.perf_counter()
+                assert code_off == 200 and code_on == 200
+                base = (t1 - t0) * 1000.0
+                bases.append(base)
+                deltas.append((t2 - t1) * 1000.0 - base)
+                images.append((r_off["image_b64"], r_on["image_b64"]))
+        finally:
+            on.stop()
+            off.stop()
+        # Observability must never touch numerics: both arms answer
+        # bit-identically (the solo-dispatch contract, cross-arm).
+        for off_b64, on_b64 in images:
+            assert off_b64 == on_b64
+        overhead = max(0.0, min(deltas) / statistics.median(bases))
+        get_registry().gauge(
+            "ia_serving_observability_overhead_frac",
+            "measured serving-observability overhead (min paired "
+            "on-minus-off delta / median bare warm request latency)",
+        ).set(round(overhead, 4))
+        assert overhead < OVERHEAD_BUDGET_FRAC, (
+            f"serving observability overhead {overhead:.4f} over "
+            f"budget {OVERHEAD_BUDGET_FRAC} "
+            f"(bases={bases}, deltas={deltas})"
+        )
+        # The sentinel watches this gauge under the shared budget.
+        health = evaluate_health(metrics=get_registry().to_dict())
+        check = {c["name"]: c for c in health["checks"]}[
+            "telemetry_overhead"
+        ]
+        assert check["status"] == "ok", check
+        assert (
+            "ia_serving_observability_overhead_frac"
+            in check["observed"]
+        )
 
 
 # ------------------------------------------- subprocess CLI lifecycle

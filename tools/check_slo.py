@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Validate an SLO_r15.json serving-SLO artifact (round 15).
+
+The observability acceptance bar, enforced by a validator instead of
+trusted to prose: the committed record must carry a real SLO report
+graded from the request-duration histogram (objectives with burn
+rates, none violated), a measured warm p99 and availability that MEET
+the declared objectives, a sample of the per-request ids the daemon
+echoed (request-scoped tracing is the tentpole — the artifact proves
+ids flowed end to end), and one reconstructed critical path whose
+phase attribution sums to within 5% of the measured end-to-end
+latency (the `ia-synth trace` acceptance bound, frozen into the
+artifact).
+
+Usage:
+    python tools/check_slo.py SLO_r15.json
+
+Runs under pytest too (tests/test_serving.py validates the COMMITTED
+artifact) so tier-1 fails if the record is missing, truncated, or
+structurally degraded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+SLO_SCHEMA_VERSION = 1
+
+# ia-synth trace acceptance bound: phase attribution must explain the
+# measured end-to-end latency to within this fraction.
+CRITICAL_PATH_GAP_FRAC = 0.05
+
+_OBJECTIVE_KINDS = ("latency", "availability", "shed_rate")
+_OBJECTIVE_STATUSES = ("ok", "fast_burn", "exhausted", "no_data")
+_PHASES = ("queue_ms", "compile_ms", "execute_ms", "demux_ms")
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_slo(record: dict) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if record.get("schema_version") != SLO_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {record.get('schema_version')!r} != "
+            f"{SLO_SCHEMA_VERSION}"
+        )
+    if record.get("kind") != "slo":
+        errs.append(f"kind {record.get('kind')!r} != 'slo'")
+    rnd = record.get("round")
+    if not (_num(rnd) and rnd >= 15):
+        errs.append(f"round {rnd!r} is not a round >= 15")
+
+    # -- the embedded SLO report (evaluate_slo output).
+    slo = record.get("slo")
+    if not isinstance(slo, dict):
+        errs.append("slo: missing report object")
+        slo = {}
+    objectives = slo.get("objectives")
+    if not isinstance(objectives, list) or not objectives:
+        errs.append("slo.objectives: missing/empty list")
+        objectives = []
+    targets = {}
+    for i, obj in enumerate(objectives):
+        if not isinstance(obj, dict):
+            errs.append(f"slo.objectives[{i}]: not an object")
+            continue
+        name = obj.get("name") or f"objectives[{i}]"
+        if obj.get("kind") not in _OBJECTIVE_KINDS:
+            errs.append(
+                f"{name}: kind {obj.get('kind')!r} not in "
+                f"{_OBJECTIVE_KINDS}"
+            )
+        target = obj.get("target")
+        if not (_num(target) and 0.0 < target <= 1.0):
+            errs.append(f"{name}: target {target!r} not in (0, 1]")
+        else:
+            targets[obj.get("kind")] = target
+        status = obj.get("status")
+        if status not in _OBJECTIVE_STATUSES:
+            errs.append(
+                f"{name}: status {status!r} not in {_OBJECTIVE_STATUSES}"
+            )
+        if status == "exhausted":
+            errs.append(
+                f"{name}: error budget exhausted — a committed "
+                "artifact must not document an SLO breach"
+            )
+        burn = obj.get("burn_rate")
+        budget = obj.get("budget_remaining")
+        if status == "no_data":
+            continue
+        if not (_num(burn) and burn >= 0.0):
+            errs.append(f"{name}: burn_rate {burn!r} is not a "
+                        "non-negative number")
+        if not _num(budget):
+            errs.append(f"{name}: budget_remaining {budget!r} is not "
+                        "a number")
+        elif _num(burn) and abs((burn + budget) - 1.0) > 1e-3:
+            errs.append(
+                f"{name}: burn_rate {burn} + budget_remaining "
+                f"{budget} != 1"
+            )
+    verdict = slo.get("verdict")
+    if verdict not in ("ok", "degraded", "skipped"):
+        errs.append(
+            f"slo.verdict {verdict!r} is not ok/degraded (a committed "
+            "artifact must not be violated)"
+        )
+
+    # -- headline numbers must meet the declared objectives.
+    p99 = record.get("p99_warm_ms")
+    if not (_num(p99) and p99 > 0):
+        errs.append(f"p99_warm_ms {p99!r} is not a positive number")
+    avail = record.get("availability")
+    if not (_num(avail) and 0.0 <= avail <= 1.0):
+        errs.append(f"availability {avail!r} not in [0, 1]")
+    elif "availability" in targets and avail < targets["availability"]:
+        errs.append(
+            f"availability {avail} < objective target "
+            f"{targets['availability']}"
+        )
+
+    # -- request-scoped tracing proof: echoed ids + one critical path.
+    rids = record.get("request_ids")
+    if not (isinstance(rids, list) and rids
+            and all(isinstance(r, str) and r for r in rids)):
+        errs.append(
+            "request_ids: must be a non-empty list of non-empty "
+            "strings (the ids the daemon echoed back)"
+        )
+    elif len(set(rids)) != len(rids):
+        errs.append("request_ids: duplicate ids in sample")
+
+    cp = record.get("critical_path")
+    if not isinstance(cp, dict):
+        errs.append("critical_path: missing object")
+        cp = {}
+    if not (isinstance(cp.get("request_id"), str) and cp.get("request_id")):
+        errs.append(
+            f"critical_path.request_id {cp.get('request_id')!r} is "
+            "not a non-empty string"
+        )
+    total = cp.get("total_ms")
+    if not (_num(total) and total > 0):
+        errs.append(
+            f"critical_path.total_ms {total!r} is not a positive number"
+        )
+    phases = cp.get("phases")
+    if not isinstance(phases, dict):
+        errs.append("critical_path.phases: missing object")
+        phases = {}
+    attributed = 0.0
+    for k in _PHASES:
+        v = phases.get(k)
+        if not (_num(v) and v >= 0.0):
+            errs.append(
+                f"critical_path.phases.{k} {v!r} is not a "
+                "non-negative number"
+            )
+        else:
+            attributed += v
+    if _num(total) and total > 0 and not errs_in_phases(phases):
+        gap_frac = abs(total - attributed) / total
+        if gap_frac > CRITICAL_PATH_GAP_FRAC:
+            errs.append(
+                f"critical_path: phases sum {attributed:.3f} ms "
+                f"deviates {100 * gap_frac:.1f}% from total_ms "
+                f"{total:.3f} (bound {100 * CRITICAL_PATH_GAP_FRAC:.0f}%)"
+            )
+    return errs
+
+
+def errs_in_phases(phases: dict) -> bool:
+    return any(
+        not (_num(phases.get(k)) and phases.get(k) >= 0.0)
+        for k in _PHASES
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="SLO_r15.json to validate")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"check_slo: cannot read {args.path}: {e}")
+        return 1
+    errs = validate_slo(record)
+    if errs:
+        print(f"check_slo: {args.path} INVALID:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    cp = record.get("critical_path", {})
+    print(
+        f"check_slo: {args.path} OK (verdict "
+        f"{record.get('slo', {}).get('verdict')!r}; p99 warm "
+        f"{record.get('p99_warm_ms')} ms; availability "
+        f"{record.get('availability')}; critical path "
+        f"{cp.get('request_id')!r} total {cp.get('total_ms')} ms)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,8 +22,19 @@ The artifact is validated with tools/check_serve.py before this tool
 exits 0 (the generator never commits a record its own validator
 rejects).
 
+Round 15 adds `--slo-out SLO_r15.json`: the same run additionally
+grades the DEFAULT_OBJECTIVES against the request-duration histogram
+the daemon booked (telemetry/slo.py `evaluate_slo` — the exact
+arithmetic the live `/slo` endpoint and the sentinel's check_slo
+run), records a sample of the per-request ids every response echoed,
+and reconstructs the warm probe's critical path from the daemon's
+structured access log — validated with tools/check_slo.py (phase
+attribution must sum within 5% of measured latency) before the write.
+
 Usage:
     python tools/serve_load.py --out SERVE_r13.json [--size 32]
+    python tools/serve_load.py --out /tmp/serve.json \\
+        --slo-out SLO_r15.json
 """
 
 from __future__ import annotations
@@ -43,13 +54,16 @@ from typing import List, Optional, Tuple
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from check_serve import validate_serve  # noqa: E402
+from check_slo import validate_slo  # noqa: E402
 
 
-def _post(url: str, body: bytes,
-          timeout: float = 600.0) -> Tuple[int, dict]:
+def _post(url: str, body: bytes, timeout: float = 600.0,
+          headers: Optional[dict] = None) -> Tuple[int, dict]:
+    h = {"Content-Type": "application/json"}
+    if headers:
+        h.update(headers)
     req = urllib.request.Request(
-        url + "/synthesize", data=body,
-        headers={"Content-Type": "application/json"}, method="POST",
+        url + "/synthesize", data=body, headers=h, method="POST",
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -110,8 +124,12 @@ def run_load(args) -> dict:
         max_queue_depth=args.max_queue_depth,
         cache_capacity=4, max_retries=1,
     ).start()
+    request_ids: List[str] = []
     try:
         # -- 1. cache probe: cold (compiles) vs warm repeat shape.
+        # The warm probe carries a CLIENT-CHOSEN X-Request-Id (round
+        # 15): the echoed id + its access-log critical path prove the
+        # request-scoped tracing flows end to end.
         t0 = time.perf_counter()
         code, r = _post(daemon.url, body)
         cold_ms = (time.perf_counter() - t0) * 1000.0
@@ -120,14 +138,24 @@ def run_load(args) -> dict:
                 f"cold probe: expected 200/miss, got {code}/"
                 f"{r.get('cache')!r} ({r.get('error')})"
             )
+        if r.get("request_id"):
+            request_ids.append(r["request_id"])
+        warm_rid = "slo-warm-probe"
         t0 = time.perf_counter()
-        code, r = _post(daemon.url, body)
+        code, r = _post(daemon.url, body,
+                        headers={"X-Request-Id": warm_rid})
         warm_ms = (time.perf_counter() - t0) * 1000.0
         if code != 200 or r.get("cache") != "hit":
             raise RuntimeError(
                 f"warm probe: expected 200/hit, got {code}/"
                 f"{r.get('cache')!r} ({r.get('error')})"
             )
+        if r.get("request_id") != warm_rid:
+            raise RuntimeError(
+                f"warm probe: request_id {r.get('request_id')!r} != "
+                f"supplied X-Request-Id {warm_rid!r}"
+            )
+        request_ids.append(warm_rid)
         print(
             f"serve_load: cache probe cold={cold_ms:.0f} ms "
             f"warm={warm_ms:.0f} ms "
@@ -155,6 +183,9 @@ def run_load(args) -> dict:
                             lat_ms.append(wall)
                             if r.get("cache") == "hit":
                                 counts["hits"] += 1
+                            if len(request_ids) < 8 and \
+                                    r.get("request_id"):
+                                request_ids.append(r["request_id"])
                         elif code == 429:
                             counts["shed"] += 1
                         else:
@@ -225,7 +256,63 @@ def run_load(args) -> dict:
             "ledger": ledger,
             "serving_check": serving_check,
         }
-        return record
+
+        # -- 4. SLO record (round 15, --slo-out): grade the default
+        # objectives against the duration histogram the daemon booked
+        # (the same arithmetic /slo serves), and reconstruct the warm
+        # probe's critical path from the structured access log.
+        slo_record = None
+        if args.slo_out:
+            from image_analogies_tpu.serving.accesslog import (
+                find_request,
+                phase_fields,
+            )
+            from image_analogies_tpu.telemetry.slo import evaluate_slo
+
+            slo_report = evaluate_slo(snap)
+            by_name = {o["name"]: o for o in slo_report["objectives"]}
+            warm = by_name.get("warm_p99_latency_ms", {})
+            access_rec = find_request(daemon.access.path, warm_rid)
+            if access_rec is None:
+                raise RuntimeError(
+                    f"slo: warm probe {warm_rid!r} missing from "
+                    f"access log {daemon.access.path}"
+                )
+            phases = dict(phase_fields(access_rec))
+            total_ms = float(access_rec["total_ms"])
+            attributed = sum(phases.values())
+            slo_record = {
+                "schema_version": 1,
+                "kind": "slo",
+                "round": 15,
+                "proxy_size": size,
+                "slo": slo_report,
+                "p99_warm_ms": warm.get("observed_p99_ms"),
+                "availability": by_name.get(
+                    "availability", {}
+                ).get("availability"),
+                "request_ids": request_ids[:8],
+                "critical_path": {
+                    "request_id": warm_rid,
+                    "total_ms": round(total_ms, 3),
+                    "phases": {
+                        k + "_ms": round(v, 3)
+                        for k, v in phases.items()
+                    },
+                    "attributed_ms": round(attributed, 3),
+                    "gap_pct": round(
+                        100.0 * abs(total_ms - attributed) / total_ms, 3
+                    ) if total_ms > 0 else None,
+                },
+            }
+            print(
+                f"serve_load: slo verdict {slo_report['verdict']!r} "
+                f"(p99 warm {slo_record['p99_warm_ms']} ms, "
+                f"availability {slo_record['availability']}, critical "
+                f"path gap {slo_record['critical_path']['gap_pct']}%)",
+                flush=True,
+            )
+        return record, slo_record
     finally:
         daemon.stop()
         set_registry(prev)
@@ -235,6 +322,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", required=True,
                     help="where to write SERVE_r13.json")
+    ap.add_argument("--slo-out", default=None, metavar="PATH",
+                    help="also write an SLO_r15.json SLO/critical-path "
+                    "artifact from the same run (round 15)")
     ap.add_argument("--size", type=int, default=32,
                     help="proxy image edge (default 32)")
     ap.add_argument("--max-batch", type=int, default=2)
@@ -257,24 +347,41 @@ def main(argv=None) -> int:
         return 1
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    record = run_load(args)
+    record, slo_record = run_load(args)
     errs = validate_serve(record)
     if errs:
         print("serve_load: generated record INVALID:")
         for e in errs:
             print(f"  - {e}")
         return 1
-    tmp = args.out + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(record, f, indent=2, sort_keys=True)
-        f.write("\n")
-    os.replace(tmp, args.out)
+    if args.slo_out:
+        slo_errs = validate_slo(slo_record)
+        if slo_errs:
+            print("serve_load: generated SLO record INVALID:")
+            for e in slo_errs:
+                print(f"  - {e}")
+            return 1
+    _write_json(args.out, record)
     print(
         f"serve_load: wrote {args.out} (compile saved "
         f"{record['cache']['latency_delta_ms']} ms; ledger "
         f"{record['ledger']})"
     )
+    if args.slo_out:
+        _write_json(args.slo_out, slo_record)
+        print(
+            f"serve_load: wrote {args.slo_out} (verdict "
+            f"{slo_record['slo']['verdict']!r})"
+        )
     return 0
+
+
+def _write_json(path: str, record: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
 
 
 if __name__ == "__main__":
