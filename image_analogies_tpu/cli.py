@@ -777,6 +777,11 @@ def cmd_serve(args) -> int:
             warm_entries = load_warmup_manifest(args.warmup)
         except (OSError, ValueError) as e:
             raise SystemExit(f"serve: --warmup: {e}")
+    # --takeover DIR is --state-dir DIR made explicit: both restart
+    # paths restore sessions, merge observed warmup, and replay the
+    # journal's pending entries (a restart IS a takeover of your own
+    # state dir).
+    state_dir = args.takeover or args.state_dir
     mesh = make_mesh(args.n_devices)
     # Daemon-lifetime telemetry session: trace_dir=None (no device
     # trace over an unbounded lifetime), artifacts + flight recorder
@@ -807,26 +812,56 @@ def cmd_serve(args) -> int:
                 os.path.join(args.trace_dir, "access.jsonl")
                 if args.trace_dir else None
             ),
-        ).start()
+            state_dir=state_dir,
+            drain_deadline_s=args.drain_deadline_s,
+            dispatch_deadline_s=args.dispatch_deadline_s,
+        )
         try:
-            if warm_entries:
-                report = daemon.warmup(warm_entries)
-                for rec in report:
-                    print(
-                        f"warmup: {rec['key']} compiled in "
-                        f"{rec['wall_ms']:.0f} ms"
-                    )
+            daemon.start()
+        except RuntimeError as e:
+            # The double-takeover guard: the state dir's lockfile
+            # names a pid that is still alive.
+            raise SystemExit(f"serve: {e}")
+        # Graceful drain on SIGTERM (round 16): override the flight
+        # recorder's flush-and-die disposition — in-flight requests
+        # and their response writes complete, hand-off state lands in
+        # the state dir, the flight dump carries reason=drain, and the
+        # main loop below exits 0.
+        import signal as _signal
+
+        _signal.signal(
+            _signal.SIGTERM,
+            lambda signum, frame: daemon.begin_drain(reason="sigterm"),
+        )
+        try:
+            restored = daemon.restore_sessions() if state_dir else 0
+            if restored:
+                print(f"takeover: restored {restored} session(s)")
+            report = daemon.warmup(warm_entries or [])
+            for rec in report:
+                print(
+                    f"warmup: {rec['key']} compiled in "
+                    f"{rec['wall_ms']:.0f} ms"
+                )
+            replayed = daemon.replay_journal() if state_dir else 0
+            if replayed:
+                print(
+                    f"takeover: replaying {replayed} journaled "
+                    "request(s)"
+                )
             # Rendezvous AFTER warmup: a live.json reader may assume
             # the manifest's shapes are already warm.
             if args.trace_dir:
                 daemon.live.announce(args.trace_dir)
             print(
-                f"serving on {daemon.url} (POST /synthesize; GET "
-                "/serving /slo /metrics /healthz /progress)",
+                f"serving on {daemon.url} (POST /synthesize /drain; "
+                "GET /serving /slo /journal /metrics /healthz "
+                "/progress)",
                 flush=True,
             )
-            while True:
-                time.sleep(3600)
+            while not daemon.drained.wait(1.0):
+                pass
+            print("serve: drained, exiting", flush=True)
         except KeyboardInterrupt:
             print("serve: interrupted, draining")
         finally:
@@ -1129,6 +1164,36 @@ def main(argv=None) -> int:
         "per-session warm-start stream; the least-recently-used "
         "stream beyond this count is dropped and its next frame runs "
         "cold (default 16)",
+    )
+    p.add_argument(
+        "--state-dir", default=None, metavar="DIR",
+        help="crash-resilience state dir (round 16): every admitted "
+        "request journals to DIR/journal.jsonl before its ack, drain "
+        "snapshots hand-off state there, and a restart replays the "
+        "journal's unfinished entries (bit-identical by the isolation "
+        "contract).  DIR/daemon.lock refuses a second live daemon",
+    )
+    p.add_argument(
+        "--takeover", default=None, metavar="DIR",
+        help="take over a dead/drained daemon's state dir: restore "
+        "its snapshotted sessions, merge its runtime-observed warmup "
+        "shapes, and replay its journaled unfinished requests "
+        "(equivalent to --state-dir DIR; refused while the previous "
+        "holder's pid is alive)",
+    )
+    p.add_argument(
+        "--drain-deadline-s", type=float, default=30.0, metavar="S",
+        help="graceful-drain budget (SIGTERM or POST /drain): new "
+        "requests 503 immediately; queued + in-flight work and their "
+        "response writes get this long to finish before the hand-off "
+        "snapshot is cut and the daemon exits 0 (default 30)",
+    )
+    p.add_argument(
+        "--dispatch-deadline-s", type=float, default=None, metavar="S",
+        help="bound one batch dispatch: past this wall the "
+        "dispatcher's abort token fires and the wedged attempt "
+        "unwinds as a failed (500) batch instead of freezing the "
+        "daemon (default: unbounded)",
     )
     _add_synth_flags(p)
     p.set_defaults(fn=cmd_serve)

@@ -18,6 +18,21 @@ an injected fault must fire per execution, not per trace):
     xfer     the host->device input transfer / prologue dispatch
              (key = ordinal: 0 for the first transfer of a run)
 
+plus the SERVING-PLANE points (round 16 — serving/daemon.py and
+serving/journal.py; their `fail` action is returned to the caller,
+which performs the simulated failure, rather than raised):
+
+    serve_crash     between journal-append and the response write
+                    (key = journal write ordinal): daemon hard-exits,
+                    simulating SIGKILL with an acked request on disk
+    serve_hang      dispatcher, before a batch executes (key =
+                    dispatch ordinal): interruptible hang, bounded by
+                    the daemon's dispatch deadline
+    serve_evict     dispatcher (key = dispatch ordinal): forced
+                    executable-cache epoch eviction before lookup
+    serve_diskfull  the journal write syscall (key = write ordinal):
+                    OSError counted on journal.errors, never raised
+
 armed by a FAULT PLAN (`IA_FAULT_PLAN` env var or `set_fault_plan`):
 comma/semicolon-separated entries
 
@@ -55,8 +70,35 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-POINTS = ("level", "kernel", "ckpt", "xfer")
+POINTS = (
+    "level", "kernel", "ckpt", "xfer",
+    # Serving-plane points (round 16, serving/daemon.py + journal.py).
+    # Their "fail" action is CALLER-INTERPRETED, not raised: the
+    # serving glue turns it into the simulated failure (hard process
+    # exit, journal-write OSError, forced cache epoch) — the engine's
+    # raising semantics would instead fail a supervised attempt that
+    # does not exist at these points.
+    "serve_crash",     # between journal-append and response (key =
+    #                    journal write ordinal): daemon hard-exits
+    "serve_hang",      # dispatcher, before executing a batch (key =
+    #                    dispatch ordinal): interruptible hang
+    "serve_evict",     # dispatcher (key = dispatch ordinal): forced
+    #                    executable-cache epoch eviction
+    "serve_diskfull",  # journal write (key = write ordinal): OSError,
+    #                    counted-not-raised
+)
 ACTIONS = ("raise", "hang", "truncate", "fail")
+
+# Serving-plane points: "fail" returns to the caller instead of
+# raising, and only the actions below are grammatical per point.
+SERVE_POINTS = ("serve_crash", "serve_hang", "serve_evict",
+                "serve_diskfull")
+_SERVE_ACTIONS = {
+    "serve_crash": ("fail",),
+    "serve_hang": ("hang",),
+    "serve_evict": ("fail",),
+    "serve_diskfull": ("fail",),
+}
 
 # Actions that raise out of the injection point (and therefore fail a
 # supervised attempt) vs. actions the CALLER interprets (`truncate`
@@ -135,6 +177,12 @@ class FaultPlan:
                 raise ValueError(
                     f"fault-plan entry {raw!r}: 'truncate' only "
                     "applies to the 'ckpt' point"
+                )
+            if point in _SERVE_ACTIONS and \
+                    action not in _SERVE_ACTIONS[point]:
+                raise ValueError(
+                    f"fault-plan entry {raw!r}: point {point!r} only "
+                    f"supports {_SERVE_ACTIONS[point]}"
                 )
             try:
                 key = int(key_s)
@@ -269,15 +317,22 @@ def fire(point: str, key: int) -> Optional[str]:
     logging.getLogger("image_analogies_tpu").warning(
         "fault injection: %s:%d:%s fired", point, key, entry.action
     )
+    if entry.action == "hang":
+        _hang(entry.arg, token, point, key)
+        return None
+    if point in SERVE_POINTS:
+        # Serving-plane faults are caller-interpreted: the daemon /
+        # journal glue performs the simulated failure (hard exit,
+        # counted OSError, forced eviction) — and the serving sentinel
+        # (`check_serving_recovery`), not the engine's recovery check,
+        # grades the aftermath.
+        return entry.action
     if entry.action == "raise":
         raise InjectedFault(f"injected fault at {point}:{key}")
     if entry.action == "fail":
         raise InjectedTransferError(
             f"injected transfer failure at {point}:{key}"
         )
-    if entry.action == "hang":
-        _hang(entry.arg, token, point, key)
-        return None
     return entry.action  # "truncate": the ckpt writer interprets it
 
 

@@ -72,6 +72,23 @@ dispatches; replaying a half-stepped stream would double-book its
 ledger).  Session dispatches still consult the executable cache
 (keyed at the stream's own batch-1 grain) so the serving sentinel's
 `hits + misses == dispatches` ledger stays exact.
+
+Crash resilience (round 16, serving/journal.py): when the daemon is
+given a `state_dir` it appends every ADMITTED request to a durable
+journal BEFORE acknowledging it, marks the entry retired when the
+response is written (`done`), when the client vanished (`cancelled`),
+or when a successor re-executed it (`replayed`), and on `--takeover`
+replays every un-retired entry through the normal queue — the
+isolation contract above (solo PRNG streams + bucket-center luma
+stats) is exactly what makes the replayed output bit-identical to the
+answer the dead daemon would have produced.  The same state dir
+carries the hot-restart hand-off: a graceful drain (SIGTERM or
+`POST /drain`) 503s new work, lets in-flight batches and their
+response writes finish under a deadline, then snapshots the runtime-
+observed warm shapes (`warmup.observed.json`) and every resident
+session's carried NNF state for the successor.  A `daemon.lock` file
+naming the holder pid makes double-takeover a refused startup, not a
+split-brain journal.
 """
 
 from __future__ import annotations
@@ -91,7 +108,20 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from .accesslog import AccessLog
-from .excache import ExecutableCache, exec_key, key_str, run_warmup
+from .excache import (
+    OBSERVED_WARMUP_FILE,
+    ExecutableCache,
+    exec_key,
+    key_str,
+    run_warmup,
+    save_observed_warmup,
+)
+from .journal import (
+    RequestJournal,
+    acquire_lock,
+    journal_path,
+    release_lock,
+)
 from .queueing import (
     AdmissionController,
     BatchingPolicy,
@@ -208,6 +238,9 @@ class SynthDaemon:
         observability: bool = True,
         access_log_path: Optional[str] = None,
         slo_window_s: float = 300.0,
+        state_dir: Optional[str] = None,
+        drain_deadline_s: float = 30.0,
+        dispatch_deadline_s: Optional[float] = None,
     ):
         from ..parallel.batch import make_mesh
         from ..telemetry.slo import SloEngine
@@ -257,6 +290,30 @@ class SynthDaemon:
         self._access_log_path = access_log_path
         self.access: Optional[AccessLog] = None
         self.slo = SloEngine(registry, window_s=slo_window_s)
+        # Round 16 resilience state (all inert when state_dir is None
+        # except drain, which still quiesces and exits cleanly).
+        self.state_dir = state_dir
+        self.journal: Optional[RequestJournal] = None
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.dispatch_deadline_s = dispatch_deadline_s
+        self._draining = threading.Event()
+        self.drained = threading.Event()
+        # Handler threads currently building/writing a response for an
+        # ADMITTED request — drain waits for this to hit zero so
+        # in-flight responses complete before the process exits (the
+        # round-12 SIGTERM handler used to cut them mid-write).
+        self._outstanding = 0
+        self._outstanding_lock = threading.Lock()
+        # Runtime-observed frame shapes, LRU order — the drift fix for
+        # hand-authored warmup manifests: snapshotted to
+        # warmup.observed.json and merged into the successor's warmup.
+        self._observed_shapes: "OrderedDict[Tuple[int, ...], None]" = \
+            OrderedDict()
+        self._dispatch_seq = 0  # client-dispatch ordinal (fault keys)
+        # request_id -> {"sha256", "shape"} for replayed requests; the
+        # chaos harness reads it from GET /journal to assert replay
+        # bit-identity against the original acked responses.
+        self._replayed: Dict[str, Dict[str, Any]] = {}
         self._init_metrics()
 
     # ------------------------------------------------------- metrics
@@ -282,6 +339,12 @@ class SynthDaemon:
             "ia_serve_failed_total",
             "admitted requests answered 5xx (supervisor give-up or "
             "dispatch error)",
+        )
+        self._c_cancelled = r.counter(
+            "ia_serve_cancelled_total",
+            "admitted requests retired before dispatch (client socket "
+            "gone or client deadline already blown in the queue) — a "
+            "ledger outcome, not an availability failure",
         )
         self._c_dispatches = r.counter(
             "ia_serve_dispatches_total",
@@ -329,6 +392,15 @@ class SynthDaemon:
             self.tracer = as_tracer(None)
         if self._own_work_dir:
             self._work_dir = tempfile.mkdtemp(prefix="ia-serve-")
+        if self.state_dir is not None:
+            # Lock FIRST (refuses when another live daemon holds the
+            # dir — the double-takeover guard), then open the journal,
+            # which scans surviving entries into the pending ledger.
+            os.makedirs(self.state_dir, exist_ok=True)
+            acquire_lock(self.state_dir)
+            self.journal = RequestJournal(
+                journal_path(self.state_dir), registry=self.registry
+            )
         if self.observability:
             self.access = AccessLog(
                 self._access_log_path
@@ -345,6 +417,8 @@ class SynthDaemon:
                 ("POST", "/synthesize"): self._route_synthesize,
                 ("GET", "/serving"): self._route_serving,
                 ("GET", "/slo"): self._route_slo,
+                ("GET", "/journal"): self._route_journal,
+                ("POST", "/drain"): self._route_drain,
             },
         ).start()
         self._dispatcher = threading.Thread(
@@ -358,7 +432,18 @@ class SynthDaemon:
         self._stop.set()
         for req in self.queue.drain():
             req.status = "failed"
-            req.error = "daemon shutting down"
+            if self._draining.is_set() and self.journal is not None \
+                    and not req.replay:
+                # Drain deadline expired with this request still
+                # queued: its journal entry stays PENDING so the
+                # takeover successor replays it (the 500 below tells
+                # the live client; a vanished client's answer arrives
+                # via the successor's /journal replay record).
+                req.error = ("daemon drained before dispatch; "
+                             "journaled for takeover replay")
+                req.journal_keep = True
+            else:
+                req.error = "daemon shutting down"
             self._c_failed.inc()
             req.done.set()
         self._g_depth.set(0)
@@ -371,6 +456,11 @@ class SynthDaemon:
         if self.access is not None:
             self.access.close()
             self.access = None
+        if self.journal is not None:
+            self.journal.close()
+            self.journal = None
+        if self.state_dir is not None:
+            release_lock(self.state_dir)
         if self._own_work_dir and self._work_dir:
             shutil.rmtree(self._work_dir, ignore_errors=True)
 
@@ -382,7 +472,21 @@ class SynthDaemon:
     def warmup(self, entries: List[Dict[str, Any]]) -> List[Dict]:
         """Compile the manifest's shapes through the real dispatch
         path BEFORE announcing the endpoint (cli.cmd_serve orders it
-        so): rendezvous implies warm."""
+        so): rendezvous implies warm.  With a state dir, the hand-
+        authored manifest is merged with the predecessor's RUNTIME-
+        OBSERVED shapes (warmup.observed.json) — the fix for manifest
+        drift, where the shapes clients actually send stopped matching
+        the shapes the manifest author guessed."""
+        if self.state_dir is not None:
+            from .excache import (
+                load_observed_warmup,
+                merge_warmup_entries,
+            )
+
+            entries = merge_warmup_entries(
+                entries,
+                load_observed_warmup(self.observed_warmup_path),
+            )
 
         def dispatch(shape):
             frame = np.zeros(shape, np.float32)
@@ -418,10 +522,11 @@ class SynthDaemon:
             b_stats=bucket, session=session, **kwargs,
         )
 
-    def _route_synthesize(self, body: Optional[bytes], headers=None):
+    def _route_synthesize(self, body: Optional[bytes], headers=None,
+                          ctx=None):
         """POST /synthesize handler (runs on an HTTP handler thread):
         assign/accept the request id -> validate -> admit-or-shed ->
-        enqueue -> block on completion.  Every exit echoes
+        journal -> enqueue -> block on completion.  Every exit echoes
         `request_id` in the body (the machine-parseable error
         contract), books the `ia_request_duration_ms` cell for its
         outcome, and appends the structured access-log line."""
@@ -432,6 +537,7 @@ class SynthDaemon:
             manifest = _parse_manifest(body)
             frame = _frame_from_manifest(manifest)
             session = _session_from_manifest(manifest)
+            deadline_ms = _deadline_from_manifest(manifest)
         except ValueError as e:
             payload = _json_bytes({
                 "status": "rejected", "error": str(e),
@@ -443,7 +549,34 @@ class SynthDaemon:
                 len(payload),
             )
             return 400, payload, "application/json"
+        if self._draining.is_set():
+            # Refused BEFORE the requests counter: the admission
+            # ledger (requests == admitted + shed) covers only
+            # requests the daemon actually triaged.  `unavailable` is
+            # excluded from the SLO availability denominator exactly
+            # like shed — a planned drain must not burn error budget.
+            retry = max(1.0, round(self.drain_deadline_s, 1))
+            payload = _json_bytes({
+                "status": "unavailable",
+                "error": "daemon is draining; retry against the "
+                         "successor",
+                "request_id": rid,
+                "retry_after_s": retry,
+            })
+            self._book_response(
+                rid, None, "unavailable", 503,
+                (time.monotonic() - t_in) * 1000.0, bytes_in,
+                len(payload),
+            )
+            return (
+                503, payload, "application/json",
+                {"Retry-After": str(int(np.ceil(retry)))},
+            )
         req = self._make_request(frame, session, req_id=rid)
+        if deadline_ms is not None:
+            req.deadline_t = t_in + deadline_ms / 1000.0
+        if ctx is not None:
+            req.alive = ctx.get("alive")
         req.span("queued")
         # Requests books FIRST (the serving sentinel check's ordering
         # contract), then exactly one of admitted/shed.
@@ -451,12 +584,21 @@ class SynthDaemon:
         ok, retry_after = self.admission.admit(
             len(self.queue), self._inflight
         )
+        shed_error = ("shed by admission control (queue at "
+                      "capacity); retry after retry_after_s")
+        if ok and not self.admission.deadline_permits(
+                req.deadline_t, len(self.queue), self._inflight):
+            # Deadline pricing: admitting work whose deadline the
+            # queue-depth x p50-service estimate already blows just
+            # burns a dispatch on an answer nobody is waiting for.
+            ok = False
+            shed_error = ("shed at admission: client deadline "
+                          "cannot be met at current queue depth")
         if not ok:
             self._c_shed.inc()
             payload = _json_bytes({
                 "status": "shed",
-                "error": "shed by admission control (queue at "
-                         "capacity); retry after retry_after_s",
+                "error": shed_error,
                 "request_id": rid,
                 "retry_after_s": retry_after,
             })
@@ -470,6 +612,35 @@ class SynthDaemon:
                 {"Retry-After": str(int(np.ceil(retry_after)))},
             )
         self._c_admitted.inc()
+        self._note_observed_shape(manifest)
+        if self.journal is not None:
+            self.journal.append(rid, manifest)
+            from ..runtime import faults
+
+            # serve_crash: the chaos harness's hard-kill window —
+            # the request is durably journaled but NOT yet enqueued
+            # or acknowledged; a takeover must replay it.  Keyed by
+            # the journal append ordinal.
+            if faults.fire(
+                "serve_crash", self.journal.appended - 1
+            ) == "fail":
+                os._exit(137)
+        with self._outstanding_lock:
+            self._outstanding += 1
+        try:
+            return self._await_response(
+                rid, req, t_in, bytes_in
+            )
+        finally:
+            with self._outstanding_lock:
+                self._outstanding -= 1
+
+    def _await_response(self, rid: str, req: ServeRequest,
+                        t_in: float, bytes_in: int):
+        """The admitted request's wait-and-respond tail, under the
+        drain machinery's outstanding-responses counter (graceful
+        drain waits for this to return before snapshotting state and
+        exiting — an in-flight response is never cut mid-write)."""
         self.queue.put(req)
         self._g_depth.set(len(self.queue))
         if not req.done.wait(REQUEST_TIMEOUT_S):
@@ -491,6 +662,19 @@ class SynthDaemon:
             return 504, payload, "application/json"
         total_ms = (time.monotonic() - req.enqueue_t) * 1000.0
         self._h_latency.observe(total_ms, labels={"phase": "total"})
+        if req.status == "cancelled":
+            # Retired before dispatch (socket gone / deadline blown in
+            # queue).  499 after nginx's "client closed request"; the
+            # body exists for the rare still-listening client.
+            payload = _json_bytes({
+                "status": "cancelled", "request_id": rid,
+                "error": req.error,
+            })
+            self._book_response(
+                rid, req, "cancelled", 499, total_ms, bytes_in,
+                len(payload),
+            )
+            return 499, payload, "application/json"
         if req.status != "ok":
             payload = _json_bytes({
                 "status": "failed", "request_id": rid,
@@ -525,7 +709,18 @@ class SynthDaemon:
                        bytes_in: int, bytes_out: int) -> None:
         """Response-time bookkeeping, one call per exit path: the
         request-duration observation (always — it is the SLO engine's
-        raw material) and the access-log line (observability only)."""
+        raw material) and the access-log line (observability only).
+        Also the journal's `done` mark — a response write IS what
+        retires a journal entry (cancellation marks happen at the
+        dispatcher, and drain-stranded requests skip the mark via
+        `journal_keep` so the successor still replays them)."""
+        if (
+            self.journal is not None and req is not None
+            and not req.replay
+            and outcome in ("ok", "failed", "timeout")
+            and not getattr(req, "journal_keep", False)
+        ):
+            self.journal.mark(rid, "done")
         cache = req.cache if req is not None and req.cache else "none"
         self._h_duration.observe(total_ms, labels={
             "route": "/synthesize", "outcome": outcome, "cache": cache,
@@ -592,6 +787,226 @@ class SynthDaemon:
         }
         return 200, _json_bytes(snap), "application/json"
 
+    def _route_journal(self, _body):
+        """GET /journal: the durability ledger — journal counts, the
+        drain state machine's position, and the replay record (rid ->
+        output sha256) a takeover successor accumulates.  The chaos
+        harness asserts zero acked loss and replay bit-identity from
+        exactly this snapshot."""
+        snap = {
+            "ledger": (self.journal.counts()
+                       if self.journal is not None else None),
+            "state_dir": self.state_dir,
+            "draining": self._draining.is_set(),
+            "drained": self.drained.is_set(),
+            "replayed": dict(self._replayed),
+        }
+        return 200, _json_bytes(snap), "application/json"
+
+    def _route_drain(self, _body):
+        """POST /drain: flip to draining (idempotent) and return
+        immediately — 202, the drain worker finishes asynchronously.
+        New requests now get 503 + Retry-After; `drained` flips once
+        in-flight work and response writes are settled and the
+        hand-off state is on disk."""
+        already = self._draining.is_set()
+        self.begin_drain(reason="drain")
+        payload = {
+            "status": "draining",
+            "already_draining": already,
+            "queue_depth": len(self.queue),
+            "inflight": self._inflight,
+            "drain_deadline_s": self.drain_deadline_s,
+        }
+        return 202, _json_bytes(payload), "application/json"
+
+    # ------------------------------------------------ drain machinery
+    def begin_drain(self, reason: str = "drain") -> None:
+        """Enter draining (idempotent): refuse new work, let queued +
+        in-flight requests and their response writes finish under
+        `drain_deadline_s`, snapshot hand-off state, set `drained`.
+        The caller (cli.cmd_serve's SIGTERM handler / main loop)
+        decides when to actually exit."""
+        if self._draining.is_set():
+            return
+        self._draining.set()
+        import logging
+
+        logging.getLogger("image_analogies_tpu").warning(
+            "serving drain started (reason=%s, queue=%d, inflight=%d)",
+            reason, len(self.queue), self._inflight,
+        )
+        t = threading.Thread(
+            target=self._drain_worker, name="ia-serve-drain",
+            daemon=True,
+        )
+        t.start()
+
+    def _drain_worker(self) -> None:
+        deadline = time.monotonic() + self.drain_deadline_s
+        while time.monotonic() < deadline:
+            with self._outstanding_lock:
+                outstanding = self._outstanding
+            if (len(self.queue) == 0 and self._inflight == 0
+                    and outstanding == 0):
+                break
+            time.sleep(0.02)
+        # A breath for the HTTP server threads to push the last
+        # payloads through their sockets (handlers return bytes; the
+        # server writes them just after).
+        time.sleep(0.1)
+        try:
+            self._drain_snapshot()
+        except Exception:  # noqa: BLE001 - drain must terminate
+            import logging
+
+            logging.getLogger("image_analogies_tpu").exception(
+                "drain snapshot failed (continuing to exit)"
+            )
+        if self.flight is not None:
+            try:
+                # Sticky "drain" label: distinguishes a graceful
+                # hand-off dump from the round-12 sigterm dump.
+                self.flight.flush(reason="drain")
+            except Exception:  # noqa: BLE001
+                pass
+        self.drained.set()
+
+    def _drain_snapshot(self) -> None:
+        """Persist the hand-off state a takeover successor restores:
+        the runtime-observed warm shapes and every resident session's
+        carried NNF/B' state (session ids are hashed into dir names —
+        they are client-chosen strings, not safe path components)."""
+        if self.state_dir is None:
+            return
+        self._save_observed_shapes()
+        import hashlib
+
+        index: Dict[str, str] = {}
+        for sid, stream in self._sessions.items():
+            dirname = hashlib.sha1(sid.encode()).hexdigest()[:16]
+            sdir = os.path.join(self.state_dir, "sessions", dirname)
+            try:
+                stream.save_state(sdir)
+                index[sid] = dirname
+            except Exception:  # noqa: BLE001 - skip broken streams
+                import logging
+
+                logging.getLogger("image_analogies_tpu").exception(
+                    "session %s snapshot failed (skipped)", sid
+                )
+        tmp = os.path.join(self.state_dir, "sessions.json.tmp")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"schema_version": 1, "sessions": index}, fh)
+        os.replace(tmp, os.path.join(self.state_dir, "sessions.json"))
+
+    # --------------------------------------------- takeover machinery
+    @property
+    def observed_warmup_path(self) -> Optional[str]:
+        if self.state_dir is None:
+            return None
+        return os.path.join(self.state_dir, OBSERVED_WARMUP_FILE)
+
+    def _note_observed_shape(self, manifest: Dict[str, Any]) -> None:
+        """LRU-track the (H, W, C) shapes real clients send; persisted
+        on first sighting and at drain so the successor's warmup
+        compiles what traffic actually needs, not what the manifest
+        author guessed."""
+        shape = manifest.get("shape")
+        if not (isinstance(shape, list) and len(shape) == 3):
+            return
+        key = tuple(int(d) for d in shape)
+        fresh = key not in self._observed_shapes
+        self._observed_shapes[key] = None
+        self._observed_shapes.move_to_end(key)
+        while len(self._observed_shapes) > 32:
+            self._observed_shapes.popitem(last=False)
+        if fresh and self.state_dir is not None:
+            try:
+                self._save_observed_shapes()
+            except OSError:
+                pass
+
+    def _save_observed_shapes(self) -> None:
+        if self.state_dir is None or not self._observed_shapes:
+            return
+        save_observed_warmup(
+            self.observed_warmup_path, list(self._observed_shapes)
+        )
+
+    def restore_sessions(self) -> int:
+        """Takeover: re-open every session stream the predecessor
+        snapshotted at drain.  Best-effort — a session that fails to
+        restore simply runs its next frame cold."""
+        if self.state_dir is None:
+            return 0
+        import dataclasses
+
+        from ..video.sequence import VideoStream
+
+        idx_path = os.path.join(self.state_dir, "sessions.json")
+        try:
+            with open(idx_path, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return 0
+        sessions = doc.get("sessions")
+        if not isinstance(sessions, dict):
+            return 0
+        cfg = dataclasses.replace(self.cfg, save_level_artifacts=None)
+        n = 0
+        for sid, dirname in sessions.items():
+            if not (isinstance(sid, str) and isinstance(dirname, str)):
+                continue
+            sdir = os.path.join(self.state_dir, "sessions",
+                                os.path.basename(dirname))
+            stream = VideoStream(
+                self.a, self.ap, cfg=cfg, registry=self.registry
+            )
+            if stream.restore_state(sdir):
+                self._sessions[sid] = stream
+                n += 1
+        return n
+
+    def replay_journal(self) -> int:
+        """Takeover: push every journal-pending request back through
+        the NORMAL queue (replay flag set — no client is waiting; the
+        settle path marks them `replayed` and records the output hash
+        for the bit-identity audit).  An entry whose manifest no
+        longer reconstructs is retired `cancelled` rather than left to
+        wedge the ledger forever."""
+        if self.journal is None:
+            return 0
+        n = 0
+        for rec in self.journal.pending_entries():
+            rid = rec.get("request_id", "")
+            try:
+                manifest = rec["manifest"]
+                frame = _frame_from_manifest(manifest)
+                session = _session_from_manifest(manifest)
+            except (ValueError, KeyError, TypeError):
+                self.journal.mark(rid, "cancelled")
+                self._c_cancelled.inc()
+                continue
+            req = self._make_request(frame, session, req_id=rid)
+            req.replay = True
+            req.span("queued")
+            # Replays walk the whole admission ledger (requests ->
+            # admitted -> completed/failed) so every serving-sentinel
+            # invariant holds on the successor's registry too.
+            self._c_requests.inc()
+            self._c_admitted.inc()
+            self.queue.put(req)
+            n += 1
+        self._g_depth.set(len(self.queue))
+        if n:
+            import logging
+
+            logging.getLogger("image_analogies_tpu").warning(
+                "takeover: replaying %d journaled request(s)", n
+            )
+        return n
+
     def health(self) -> Dict[str, Any]:
         """/healthz callback: the full sentinel evaluation (which now
         includes the serving ledger check) against the daemon's
@@ -609,8 +1024,11 @@ class SynthDaemon:
             if batch is None:
                 continue
             self._g_depth.set(len(self.queue))
+            batch = self._filter_batch(batch)
+            if not batch:
+                continue
             try:
-                self._execute(batch, kind="client")
+                self._dispatch_guarded(batch)
             except BaseException as e:  # noqa: BLE001 - daemon survives
                 import logging
 
@@ -623,6 +1041,75 @@ class SynthDaemon:
                         req.error = f"{type(e).__name__}: {e}"
                         self._c_failed.inc()
                         req.done.set()
+
+    def _dispatch_guarded(self, batch: List[ServeRequest]) -> None:
+        """Client dispatch under the round-16 guards: the serve_hang /
+        serve_evict fault points (keyed by client-dispatch ordinal)
+        and, when `dispatch_deadline_s` is set, a DispatchDeadline
+        whose abort token is installed on THIS thread — so a wedged
+        dispatch (the injected hang, or an engine stall at a `level`
+        fire point) unwinds as LevelAborted instead of freezing the
+        dispatcher forever."""
+        from ..runtime import faults
+        from ..runtime.supervisor import DispatchDeadline
+
+        seq = self._dispatch_seq
+        self._dispatch_seq += 1
+        dd = None
+        if self.dispatch_deadline_s:
+            dd = DispatchDeadline(self.dispatch_deadline_s).arm()
+            faults.set_abort_token(dd.token)
+        try:
+            faults.fire("serve_hang", seq)
+            if faults.fire("serve_evict", seq) == "fail":
+                # Forced cache-epoch eviction: the next lookup is an
+                # honest miss + recompile, not a wrong answer.
+                self.cache.force_epoch_eviction()
+            self._execute(batch, kind="client")
+        finally:
+            if dd is not None:
+                dd.cancel()
+                faults.set_abort_token(None)
+
+    def _filter_batch(
+        self, batch: List[ServeRequest]
+    ) -> List[ServeRequest]:
+        """Last call before the engine burns a dispatch: drop popped
+        requests whose client socket is already gone or whose client
+        deadline expired while queued.  Replays are exempt (their
+        client is the journal).  Runs on the dispatcher thread, so the
+        cancel path owns the ledger entry exactly like settle does."""
+        now = time.monotonic()
+        keep: List[ServeRequest] = []
+        for req in batch:
+            if req.replay:
+                keep.append(req)
+                continue
+            if req.alive is not None:
+                try:
+                    alive = bool(req.alive())
+                except Exception:  # noqa: BLE001 - probe never fatal
+                    alive = True
+                if not alive:
+                    self._cancel_request(
+                        req, "client disconnected before dispatch"
+                    )
+                    continue
+            if req.deadline_t is not None and now > req.deadline_t:
+                self._cancel_request(
+                    req, "client deadline expired in queue"
+                )
+                continue
+            keep.append(req)
+        return keep
+
+    def _cancel_request(self, req: ServeRequest, why: str) -> None:
+        req.status = "cancelled"
+        req.error = why
+        self._c_cancelled.inc()
+        if self.journal is not None:
+            self.journal.mark(req.req_id, "cancelled")
+        req.done.set()
 
     def _admit_batch(self, batch: List[ServeRequest],
                      kind: str) -> float:
@@ -675,9 +1162,30 @@ class SynthDaemon:
             self._h_latency.observe(
                 service_ms, labels={"phase": "service"}
             )
+            if req.replay:
+                self._settle_replay(req)
             req.done.set()
         self._inflight = 0
         self._g_inflight.set(0)
+
+    def _settle_replay(self, req: ServeRequest) -> None:
+        """A replayed request has no handler thread: the dispatcher
+        retires its journal entry here.  Success marks `replayed` and
+        records the output sha256 (the chaos harness's bit-identity
+        evidence); failure leaves the entry PENDING so the next
+        takeover tries again (at-least-once until a response exists
+        somewhere)."""
+        if req.status != "ok" or req.result is None:
+            return
+        import hashlib
+
+        out = np.ascontiguousarray(np.asarray(req.result, np.float32))
+        self._replayed[req.req_id] = {
+            "sha256": hashlib.sha256(out.tobytes()).hexdigest(),
+            "shape": list(out.shape),
+        }
+        if self.journal is not None:
+            self.journal.mark(req.req_id, "replayed")
 
     def _attach_request_trees(self, batch: List[ServeRequest],
                               run_roots) -> None:
@@ -932,6 +1440,23 @@ def _session_from_manifest(manifest: dict) -> Optional[str]:
             "session_id must be a non-empty string of <= 64 characters"
         )
     return sid
+
+
+def _deadline_from_manifest(manifest: dict) -> Optional[float]:
+    """The optional client deadline budget (`deadline_ms`): how long
+    the client will wait for its answer, measured from receipt.  A
+    finite positive number of milliseconds (bounded at an hour — a
+    'deadline' past REQUEST_TIMEOUT_S is a typo, not a budget)."""
+    ms = manifest.get("deadline_ms")
+    if ms is None:
+        return None
+    if isinstance(ms, bool) or not isinstance(ms, (int, float)) \
+            or not np.isfinite(ms) or not 0 < ms <= 3_600_000:
+        raise ValueError(
+            f"deadline_ms {ms!r} is not a positive number of "
+            f"milliseconds (<= 3600000)"
+        )
+    return float(ms)
 
 
 def _frame_from_manifest(manifest: dict) -> np.ndarray:

@@ -218,6 +218,39 @@ class ExecutableCache:
             key_str(evicted_key), len(self._entries),
         )
 
+    def force_epoch_eviction(self) -> int:
+        """Forced epoch eviction — the `serve_evict` chaos point
+        (round 16): clear the engine's compiled-function caches and
+        demote every resident entry to cold, exactly the aftermath of
+        a capacity eviction but without dropping any accounting entry.
+        The next lookup of each key is an honest miss.  Returns how
+        many entries were demoted."""
+        with self._lock:
+            from ..kernels.patchmatch_tile import (
+                clear_compiled_level_caches,
+            )
+
+            clear_compiled_level_caches()
+            demoted = 0
+            for entry in self._entries.values():
+                if entry.warm:
+                    entry.warm = False
+                    demoted += 1
+            self.evictions += 1
+            self._reg().counter(
+                "ia_serve_excache_evictions_total",
+                "serving executable-cache capacity evictions (epoch-"
+                "grained: one eviction clears the engine's jit caches "
+                "and demotes every resident entry to cold)",
+            ).inc()
+            import logging
+
+            logging.getLogger("image_analogies_tpu").warning(
+                "serving excache: FORCED epoch eviction (%d resident "
+                "entries demoted to cold)", demoted,
+            )
+            return demoted
+
     def note_compile_ms(self, key: ExecKey, wall_ms: float) -> None:
         with self._lock:
             entry = self._entries.get(key)
@@ -287,6 +320,83 @@ def load_warmup_manifest(path: str) -> List[Dict[str, Any]]:
                 f"{h}x{w}x{c} out of range (min 8x8, channels 1|3)"
             )
         out.append({"height": h, "width": w, "channels": c})
+    return out
+
+
+OBSERVED_WARMUP_FILE = "warmup.observed.json"
+OBSERVED_WARMUP_KIND = "serve_warmup_observed"
+
+
+def save_observed_warmup(path: str, shapes) -> None:
+    """Persist the runtime-observed working set (round 16 satellite:
+    warmup-manifest drift).  `shapes` is an LRU-ordered iterable of
+    (height, width, channels) actually served by this process; the
+    successor merges them into its warmup so restarts pre-compile the
+    REAL traffic mix, not just the hand-declared manifest.  Atomic
+    write (tmp + replace): a crash mid-write leaves the previous
+    generation readable."""
+    import os
+
+    doc = {
+        "schema_version": WARMUP_SCHEMA_VERSION,
+        "kind": OBSERVED_WARMUP_KIND,
+        "entries": [
+            {"height": int(h), "width": int(w), "channels": int(c)}
+            for (h, w, c) in shapes
+        ],
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def load_observed_warmup(path: str) -> List[Dict[str, Any]]:
+    """Best-effort read of `save_observed_warmup` output: a missing,
+    corrupt, or wrong-kind file yields [] — the observed set is an
+    optimization, and unlike the operator's manifest it must never
+    fail a takeover.  Entries that fail the manifest's own shape
+    bounds are skipped individually."""
+    import os
+
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return []
+    if not isinstance(doc, dict) \
+            or doc.get("kind") != OBSERVED_WARMUP_KIND:
+        return []
+    out = []
+    for e in doc.get("entries") or []:
+        try:
+            h, w = int(e["height"]), int(e["width"])
+            c = int(e.get("channels", 3))
+        except (TypeError, KeyError, ValueError):
+            continue
+        if h < 8 or w < 8 or c not in (1, 3):
+            continue
+        out.append({"height": h, "width": w, "channels": c})
+    return out
+
+
+def merge_warmup_entries(*entry_lists) -> List[Dict[str, Any]]:
+    """Concatenate warmup entry lists (manifest first, then observed)
+    deduplicated by (height, width, channels), order-preserving —
+    `run_warmup` dedupes by executable key anyway, this keeps the
+    startup report readable."""
+    seen = set()
+    out = []
+    for entries in entry_lists:
+        for e in entries or []:
+            ident = (e["height"], e["width"], e.get("channels", 3))
+            if ident in seen:
+                continue
+            seen.add(ident)
+            out.append(dict(e))
     return out
 
 

@@ -70,10 +70,24 @@ class ServeRequest:
     enqueue_perf: float = field(default_factory=time.perf_counter)
     done: threading.Event = field(default_factory=threading.Event,
                                   repr=False)
+    # Round-16 resilience fields.  `deadline_t` is the client deadline
+    # as a monotonic instant (None: no deadline); the dispatcher drops
+    # a queued request whose deadline already passed instead of paying
+    # a dispatch it cannot use.  `alive` is a zero-arg socket-liveness
+    # probe bound to the client connection (None: unknown — treat as
+    # alive); the dispatcher cancels queued requests whose probe says
+    # the client hung up.  `replay` marks a request reconstructed from
+    # the journal during takeover (no waiting client; the journal mark
+    # is the response).  `manifest` keeps the parsed request body for
+    # journaling at admission.
+    deadline_t: Optional[float] = None
+    alive: Any = None  # Optional[Callable[[], bool]]
+    replay: bool = False
+    manifest: Optional[Dict[str, Any]] = field(default=None, repr=False)
     # Filled by the dispatcher before `done` is set:
     result: Any = None  # np.ndarray output frame on success
     error: Optional[str] = None  # failure detail (maps to 5xx)
-    status: str = "queued"  # queued|ok|failed
+    status: str = "queued"  # queued|ok|failed|cancelled
     cache: Optional[str] = None  # hit|miss for this request's dispatch
     batch_size: int = 0  # real (unpadded) co-tenant count
     # Prologue wall of this request's dispatch (ms) — the compile-phase
@@ -255,13 +269,41 @@ class AdmissionController:
         """Seconds the shed client should wait: observed p50 service
         latency x backlog ahead of it (the closed-loop drain time),
         clamped to [1, 60] — an estimate, deliberately coarse."""
+        est = self.service_p50_s() * max(1, backlog)
+        return round(min(60.0, max(1.0, est)), 1)
+
+    def service_p50_s(self) -> float:
+        """Observed p50 service-phase latency in seconds (0.0 before
+        any request completed — cold daemons price deadlines at
+        queue-wait only)."""
         p50 = self._reg().histogram(
             "ia_serve_request_ms",
             "serving request latency by lifecycle phase (ms)",
         ).quantile(0.5, labels={"phase": "service"})
         p50_ms = float(p50) if isinstance(p50, (int, float)) else 0.0
-        est = (p50_ms / 1000.0) * max(1, backlog)
-        return round(min(60.0, max(1.0, est)), 1)
+        return p50_ms / 1000.0
+
+    def deadline_permits(self, deadline_t: Optional[float],
+                         queue_depth: int, inflight: int,
+                         now: Optional[float] = None) -> bool:
+        """The hedged-shedding decision (round 16): would this request
+        plausibly finish before its client deadline?  Prices the work
+        AHEAD of it — (backlog + itself) x p50 service — against the
+        time remaining; a request that cannot make it is shed at
+        admission instead of wasting a dispatch the client will never
+        read.  No deadline, or no latency history yet, admits."""
+        if deadline_t is None:
+            return True
+        if now is None:
+            now = time.monotonic()
+        remaining = deadline_t - now
+        if remaining <= 0.0:
+            return False
+        p50 = self.service_p50_s()
+        if p50 <= 0.0:
+            return True
+        est = p50 * (queue_depth + inflight + 1)
+        return est <= remaining
 
 
 def demux(batch: Sequence[ServeRequest], stacked) -> None:

@@ -66,6 +66,10 @@ FLIGHT_SCHEMA_VERSION = 1
 FLUSH_REASONS = (
     "sigterm", "sigint", "atexit", "violation", "watchdog",
     "session-end", "manual",
+    # Round 16: an ORDERLY serving handoff (graceful drain) — distinct
+    # from "sigterm" so a post-mortem can tell a planned takeover from
+    # a kill even though both may begin with the same signal.
+    "drain",
 )
 
 class FlightRecorder:
@@ -168,7 +172,12 @@ class FlightRecorder:
         from ..utils.io import atomic_write_json
 
         self._n_flushes += 1
-        if reason in ("sigterm", "sigint", "violation", "watchdog"):
+        if reason in ("sigterm", "sigint", "violation", "watchdog",
+                      "drain"):
+            # "drain" sticks too — after an orderly handoff the atexit
+            # re-flush must keep saying drain, not relabel it; and a
+            # drain that BEGAN as SIGTERM upgrades the label (the
+            # daemon's drain handler flushes after the signal one).
             self._sticky_reason = reason
         elif self._sticky_reason is not None and reason in (
             "session-end", "atexit"

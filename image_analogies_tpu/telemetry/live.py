@@ -52,22 +52,45 @@ from typing import Any, Dict, Optional
 LIVE_FILE = "live.json"
 
 
-def _wants_headers(handler) -> bool:
-    """True when a route handler declares a second positional
-    parameter (beyond `body`) — those receive the request headers as a
-    plain dict (round 15: the serving daemon reads X-Request-Id).
-    One-parameter handlers keep their historical `handler(body)` call
-    shape.  Resolved once per handler at route registration, never per
-    request."""
+def _handler_arity(handler) -> int:
+    """Positional-parameter count of a route handler, resolved once at
+    route registration: 1 -> `handler(body)` (historical), 2 ->
+    `handler(body, headers)` (round 15: X-Request-Id), 3+ ->
+    `handler(body, headers, ctx)` (round 16: `ctx` carries a
+    connection-liveness probe so the serving daemon can cancel queued
+    requests whose client already hung up)."""
     try:
         params = [
             p for p in
             inspect.signature(handler).parameters.values()
             if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
         ]
-        return len(params) >= 2
+        return len(params)
     except (TypeError, ValueError):
+        return 1
+
+
+def _wants_headers(handler) -> bool:
+    """True when a route handler declares a second positional
+    parameter (beyond `body`) — kept as the round-15 name for the
+    arity-2 question; `_handler_arity` is the full resolution."""
+    return _handler_arity(handler) >= 2
+
+
+def _socket_alive(sock) -> bool:
+    """Non-destructive client-liveness probe: peek one byte without
+    blocking.  b'' is the peer's FIN (client hung up); EAGAIN means
+    the connection is idle-but-open; any other socket error counts as
+    dead.  Never consumes request bytes (MSG_PEEK)."""
+    import socket as _socket
+
+    try:
+        data = sock.recv(1, _socket.MSG_PEEK | _socket.MSG_DONTWAIT)
+    except (BlockingIOError, InterruptedError):
+        return True
+    except OSError:
         return False
+    return data != b""
 
 
 def _walk_spans(spans):
@@ -166,7 +189,15 @@ class _Handler(BaseHTTPRequestHandler):
         handler = live.routes.get((method, path))
         if handler is None:
             return False
-        if live._route_headers.get((method, path)):
+        arity = live._route_arity.get((method, path), 1)
+        if arity >= 3:
+            conn = self.connection
+            ctx = {
+                "alive": lambda: _socket_alive(conn),
+                "client": self.client_address,
+            }
+            out = handler(body, dict(self.headers.items()), ctx)
+        elif arity >= 2:
             out = handler(body, dict(self.headers.items()))
         else:
             out = handler(body)
@@ -254,8 +285,11 @@ class LiveTelemetryServer:
         self.host = host
         self._health_cb = health_cb
         self.routes = dict(routes or {})
+        self._route_arity = {
+            key: _handler_arity(h) for key, h in self.routes.items()
+        }
         self._route_headers = {
-            key: _wants_headers(h) for key, h in self.routes.items()
+            key: arity >= 2 for key, arity in self._route_arity.items()
         }
         self._requested_port = int(port)
         self.port: Optional[int] = None

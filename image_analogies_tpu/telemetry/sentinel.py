@@ -130,6 +130,10 @@ _OVERHEAD_GAUGES = (
     # trees + run-subtree tracer + access log), measured by
     # tests/test_serving.py's paired daemon arms.
     "ia_serving_observability_overhead_frac",
+    # Round 16: the serving resilience layer (request journal writes +
+    # ledger bookkeeping on the request path), measured by
+    # tests/test_resilience.py's paired daemon arms.
+    "ia_serving_resilience_overhead_frac",
 )
 
 # Straggler watch (round 10): a level whose slowest shard finishes
@@ -616,6 +620,11 @@ def check_recovery(metrics: Optional[dict]) -> Dict:
     n_raising = sum(
         n for key, n in inj.items()
         if dict(key).get("action") in ("raise", "fail")
+        # Serving-plane points (round 16, serve_*) are caller-
+        # interpreted, never raise into a supervised attempt, and are
+        # graded by check_serving_recovery — pricing them here would
+        # demand retries that structurally cannot exist.
+        and not str(dict(key).get("point", "")).startswith("serve_")
     )
     problems = []
     invocations = sum(
@@ -687,7 +696,8 @@ def check_serving(metrics: Optional[dict]) -> Dict:
         (the increment order pins this: the request counter books
         first, so a scrape can never see admitted+shed ahead of
         requests).
-      - admitted == completed + failed + still-pending, with pending
+      - admitted == completed + failed + cancelled + still-pending,
+        with pending
         >= 0 and, when the queue-depth/in-flight gauges are exposed,
         pending equal to their sum.  A NEGATIVE pending is violated
         (responses the daemon never admitted); a gauge mismatch on a
@@ -713,6 +723,9 @@ def check_serving(metrics: Optional[dict]) -> Dict:
     failed = sum(
         _counter_values(metrics, "ia_serve_failed_total").values()
     )
+    cancelled = sum(
+        _counter_values(metrics, "ia_serve_cancelled_total").values()
+    )
     dispatches = sum(
         _counter_values(metrics, "ia_serve_dispatches_total").values()
     )
@@ -729,7 +742,10 @@ def check_serving(metrics: Optional[dict]) -> Dict:
     )
     n_hits = sum(hits.values())
     n_misses = sum(misses.values())
-    pending = admitted - completed - failed
+    # Round 16: "cancelled" is a third admitted terminal state (client
+    # hung up / deadline blown before dispatch) — admitted requests
+    # retired without a response written.
+    pending = admitted - completed - failed - cancelled
     gauges = (metrics or {}).get("ia_serve_queue_depth", {}).get(
         "values", {}
     )
@@ -743,7 +759,8 @@ def check_serving(metrics: Optional[dict]) -> Dict:
         ) + sum(v for v in inflight.values() if _is_num(v))
     observed = {
         "requests": requests, "admitted": admitted, "shed": shed,
-        "completed": completed, "failed": failed, "pending": pending,
+        "completed": completed, "failed": failed,
+        "cancelled": cancelled, "pending": pending,
         "gauge_backlog": gauge_backlog, "dispatches": dispatches,
         "cache_hits": n_hits, "cache_hits_client": client_hits,
         "cache_misses": n_misses,
@@ -758,8 +775,9 @@ def check_serving(metrics: Optional[dict]) -> Dict:
         )
     if pending < 0:
         problems.append(
-            f"completed ({completed}) + failed ({failed}) exceed "
-            f"admitted ({admitted}) — responses were never admitted"
+            f"completed ({completed}) + failed ({failed}) + cancelled "
+            f"({cancelled}) exceed admitted ({admitted}) — responses "
+            "were never admitted"
         )
     elif gauge_backlog is not None and pending != round(gauge_backlog):
         degraded.append(
@@ -784,10 +802,113 @@ def check_serving(metrics: Optional[dict]) -> Dict:
     return _check(
         "serving", status,
         expected="requests == admitted + shed; admitted == completed "
-        "+ failed + backlog (backlog >= 0, matching the gauges); "
-        "client cache hits <= requests; hits + misses == dispatches",
+        "+ failed + cancelled + backlog (backlog >= 0, matching the "
+        "gauges); client cache hits <= requests; hits + misses == "
+        "dispatches",
         observed=observed,
         detail="serving admission/cache ledger"
+        + ("" if not (problems or degraded)
+           else " — " + "; ".join(problems + degraded)),
+    )
+
+
+def check_serving_recovery(metrics: Optional[dict]) -> Dict:
+    """Request-journal ledger (round 16, serving/journal.py): every
+    request the daemon acknowledged is on disk until it is retired,
+    and the retirements must balance.
+
+    The journal publishes one gauge family, `ia_serve_journal{field}`,
+    with fields appended / done / replayed / cancelled / pending —
+    updated on every append/mark, so any scrape (or final metrics
+    dump) carries the ledger.  Skipped when the family is silent (no
+    state-dir daemon in the session).
+
+    Invariants:
+
+      - appended == done + replayed + cancelled + pending: a journaled
+        request that is neither retired nor pending has been LOST —
+        violated (this is the crash-resilience claim itself).
+      - pending < 0 is violated (more retirements than admissions —
+        double-marked or fabricated marks).
+      - pending > 0 while the daemon is quiescent (queue-depth and
+        in-flight gauges both zero) grades degraded: acknowledged work
+        is sitting unserved with nothing in flight — a takeover that
+        forgot to replay, or a replay that stalled.  With a non-zero
+        backlog the same pending is healthy mid-flight state.
+      - journal write errors (`ia_serve_journal_errors` > 0) grade
+        degraded, never violated: the contract is counted-not-raised
+        (serve_diskfull), so errors cost durability accounting, not
+        availability — but a post-mortem must see them."""
+    ledger = {
+        dict(key).get("field"): v
+        for key, v in _counter_values(
+            metrics, "ia_serve_journal"
+        ).items()
+        if _is_num(v)
+    }
+    if not ledger:
+        return _check(
+            "serving_recovery", "skipped",
+            detail="no request journal in this session (daemon ran "
+            "without --state-dir, or no daemon at all)",
+        )
+    appended = ledger.get("appended", 0)
+    done = ledger.get("done", 0)
+    replayed = ledger.get("replayed", 0)
+    cancelled = ledger.get("cancelled", 0)
+    pending = ledger.get("pending", 0)
+    errors = sum(
+        v for v in _counter_values(
+            metrics, "ia_serve_journal_errors"
+        ).values() if _is_num(v)
+    )
+    gauges = (metrics or {}).get("ia_serve_queue_depth", {}).get(
+        "values", {}
+    )
+    inflight = (metrics or {}).get("ia_serve_inflight", {}).get(
+        "values", {}
+    )
+    backlog = sum(v for v in gauges.values() if _is_num(v)) + sum(
+        v for v in inflight.values() if _is_num(v)
+    )
+    observed = {
+        "appended": appended, "done": done, "replayed": replayed,
+        "cancelled": cancelled, "pending": pending,
+        "write_errors": errors, "backlog_gauges": backlog,
+    }
+    problems = []
+    degraded = []
+    if pending < 0:
+        problems.append(
+            f"pending ({pending}) is negative — more retirements "
+            "than journal admissions"
+        )
+    if appended != done + replayed + cancelled + pending:
+        problems.append(
+            f"appended ({appended}) != done ({done}) + replayed "
+            f"({replayed}) + cancelled ({cancelled}) + pending "
+            f"({pending}) — an acknowledged request fell out of the "
+            "ledger"
+        )
+    if not problems and pending > 0 and backlog == 0:
+        degraded.append(
+            f"{pending} journaled request(s) pending with an idle "
+            "queue — unreplayed takeover debt"
+        )
+    if errors > 0:
+        degraded.append(
+            f"{errors} journal write error(s) counted (disk full?) — "
+            "durability accounting degraded"
+        )
+    status = (
+        "violated" if problems else ("degraded" if degraded else "ok")
+    )
+    return _check(
+        "serving_recovery", status,
+        expected="appended == done + replayed + cancelled + pending; "
+        "pending >= 0, zero at quiescence; zero write errors",
+        observed=observed,
+        detail="request-journal crash-resilience ledger"
         + ("" if not (problems or degraded)
            else " — " + "; ".join(problems + degraded)),
     )
@@ -1011,6 +1132,7 @@ def evaluate_health(
         check_straggler_skew(metrics),
         check_recovery(metrics),
         check_serving(metrics),
+        check_serving_recovery(metrics),
         check_warm_start(metrics),
         check_slo(metrics),
     ]

@@ -66,6 +66,10 @@ FAST_BURN_THRESHOLD = 0.5
 _OBJECTIVE_KINDS = ("latency", "availability", "shed_rate")
 
 # Outcomes that passed admission (denominator of availability).
+# "cancelled" (round 16: the client hung up before dispatch) and
+# "unavailable" (a draining daemon's 503) are EXCLUDED like "shed":
+# the backend never owed those requests a response, so they must not
+# dilute — or spuriously burn — the availability budget.
 _ADMITTED_OUTCOMES = ("ok", "failed", "timeout")
 _BAD_OUTCOMES = ("failed", "timeout")
 

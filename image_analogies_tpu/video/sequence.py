@@ -484,6 +484,83 @@ class VideoStream:
         self.t += 1
         return out
 
+    # -- drain handoff (round 16): carry warm state across processes --
+
+    def save_state(self, state_dir: str) -> dict:
+        """Snapshot the carried warm-start state (per-level converged
+        fields + B', the previous input frame, the frame counter, the
+        frozen style stats) under `state_dir` — the serving daemon's
+        drain path calls this per session so a takeover successor's
+        next frame warm-starts exactly where the predecessor stopped
+        instead of re-paying a cold frame.  Atomic (tmp + replace) so
+        a kill mid-drain leaves either the previous generation or the
+        new one, never a torn file."""
+        import json as _json
+
+        os.makedirs(state_dir, exist_ok=True)
+        arrays = {}
+        levels = sorted((self._fields or {}).keys())
+        for lv in levels:
+            arrays[f"field_{lv}"] = np.asarray(self._fields[lv])
+            if self._bps and lv in self._bps:
+                arrays[f"bp_{lv}"] = np.asarray(self._bps[lv])
+        if self._prev_frame is not None:
+            arrays["prev_frame"] = np.asarray(self._prev_frame)
+        if self.b_stats is not None:
+            arrays["b_stats"] = np.asarray(self.b_stats)
+        npz_path = os.path.join(state_dir, "stream_state.npz")
+        tmp = npz_path + ".tmp"
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **arrays)
+        os.replace(tmp, npz_path)
+        meta = {"t": int(self.t), "levels": levels,
+                "has_b_stats": self.b_stats is not None}
+        meta_path = os.path.join(state_dir, "stream_meta.json")
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            _json.dump(meta, fh, sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, meta_path)
+        return meta
+
+    def restore_state(self, state_dir: str) -> bool:
+        """Load a `save_state` snapshot into this (fresh) stream.
+        Best-effort: False (stream unchanged, next frame runs cold)
+        when the snapshot is missing or unreadable — restoring warm
+        state is an optimization, never a correctness gate."""
+        import json as _json
+
+        npz_path = os.path.join(state_dir, "stream_state.npz")
+        meta_path = os.path.join(state_dir, "stream_meta.json")
+        try:
+            with open(meta_path, "r", encoding="utf-8") as fh:
+                meta = _json.load(fh)
+            fields = {}
+            bps = {}
+            with np.load(npz_path) as z:
+                for lv in meta.get("levels") or []:
+                    lv = int(lv)
+                    fields[lv] = np.asarray(z[f"field_{lv}"])
+                    if f"bp_{lv}" in z:
+                        bps[lv] = np.asarray(z[f"bp_{lv}"])
+                prev = (
+                    np.asarray(z["prev_frame"])
+                    if "prev_frame" in z else None
+                )
+                if meta.get("has_b_stats") and "b_stats" in z:
+                    self.b_stats = tuple(
+                        np.asarray(z["b_stats"]).tolist()
+                    )
+        except Exception:  # noqa: BLE001 - snapshot is best-effort
+            return False
+        if not fields:
+            return False
+        self._fields = fields
+        self._bps = bps
+        self._prev_frame = prev
+        self.t = int(meta.get("t", 0))
+        return True
+
     # -- one frame through the batch level machinery -------------------
 
     def _run_frame(self, frame, run_cfg: SynthConfig, warm: bool,

@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Validate a CHAOS_SERVE_r16.json serving-chaos artifact (round 16).
+
+The serving-resilience acceptance bar, enforced by a validator instead
+of trusted to prose:
+
+  - ZERO acked loss: every journaled (acknowledged) request must be
+    retired — done by its own daemon, replayed by a takeover
+    successor, or cancelled with its client — never silently dropped
+    across a SIGKILL or an injected serve_crash;
+  - replay bit-identity: a takeover's replayed outputs must hash
+    identical to what a live daemon serves for the same frames (the
+    isolation contract made falsifiable);
+  - graceful drain: the drained daemon exits 0 with its in-flight
+    response delivered, new work 503-with-Retry-After'd, and a flight
+    dump labelled `drain` (not `sigterm` — the round-12 kill path);
+  - bounded faults: serve_diskfull is counted-not-raised with the
+    request still serving, serve_hang is bounded by the dispatch
+    deadline with the daemon surviving, serve_evict yields an honest
+    recompile, never a wrong answer.
+
+Usage:
+    python tools/check_chaos_serve.py CHAOS_SERVE_r16.json
+
+Runs under pytest too (tests/test_resilience.py validates the
+COMMITTED artifact) so tier-1 fails if the record is missing,
+truncated, or claims a recovery it cannot show.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+CHAOS_SERVE_SCHEMA_VERSION = 1
+
+_REQUIRED_ARMS = (
+    "kill_midburst_takeover",
+    "drain_handoff",
+    "serve_crash_torn",
+    "serve_diskfull",
+    "serve_hang",
+    "serve_evict",
+)
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _check_recovery_arm(name: str, arm: dict,
+                        errs: List[str]) -> None:
+    """The kill/crash -> takeover contract shared by both hard-death
+    arms: zero acked loss, a non-trivial replay set, verified
+    bit-identity."""
+    loss = arm.get("acked_loss")
+    if not (_num(loss) and loss == 0):
+        errs.append(
+            f"{name}: acked_loss {loss!r} != 0 — an acknowledged "
+            "request was lost across the kill -> takeover boundary"
+        )
+    pend = arm.get("pending_at_takeover")
+    need = arm.get("min_pending_required")
+    if not (_num(pend) and _num(need) and pend >= need):
+        errs.append(
+            f"{name}: pending_at_takeover {pend!r} below the arm's "
+            f"floor {need!r} — the kill landed too late to prove "
+            "anything was at risk"
+        )
+    if arm.get("replay_bit_identical") is not True:
+        errs.append(
+            f"{name}: replay_bit_identical is "
+            f"{arm.get('replay_bit_identical')!r} — a replay that "
+            "changes the answer is not a recovery"
+        )
+    if not (_num(arm.get("replay_verified"))
+            and arm["replay_verified"] >= 1):
+        errs.append(
+            f"{name}: replay_verified "
+            f"{arm.get('replay_verified')!r} — bit-identity was "
+            "never actually compared"
+        )
+    if _num(arm.get("replay_mismatched")) and arm["replay_mismatched"]:
+        errs.append(
+            f"{name}: {arm['replay_mismatched']} replayed output(s) "
+            "hash differently from the live daemon's answers"
+        )
+    rec = arm.get("recovery_warm_ms")
+    if not (_num(rec) and rec > 0):
+        errs.append(
+            f"{name}: recovery_warm_ms {rec!r} is not a positive "
+            "wall — the recovery price is part of the claim"
+        )
+
+
+def validate_chaos_serve(record: dict) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if record.get("schema_version") != CHAOS_SERVE_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {record.get('schema_version')!r} != "
+            f"{CHAOS_SERVE_SCHEMA_VERSION}"
+        )
+    if record.get("kind") != "chaos_serve":
+        errs.append(f"kind {record.get('kind')!r} != 'chaos_serve'")
+    size = record.get("proxy_size")
+    if not (_num(size) and size >= 16):
+        errs.append(f"proxy_size {size!r} is not a size >= 16")
+
+    arms = record.get("arms")
+    if not isinstance(arms, list) or not arms:
+        return errs + ["arms: missing/empty list"]
+    by_name = {
+        arm.get("name"): arm for arm in arms if isinstance(arm, dict)
+    }
+    for need in _REQUIRED_ARMS:
+        if need not in by_name:
+            errs.append(
+                f"arms is missing {need!r} — every declared serving "
+                "fault class must be exercised"
+            )
+    if set(_REQUIRED_ARMS) - set(by_name):
+        return errs  # per-arm checks need the arms present
+
+    _check_recovery_arm(
+        "kill_midburst_takeover", by_name["kill_midburst_takeover"],
+        errs,
+    )
+    kill = by_name["kill_midburst_takeover"]
+    if not (_num(kill.get("acked_before_kill"))
+            and kill["acked_before_kill"] >= 4):
+        errs.append(
+            "kill_midburst_takeover: acked_before_kill "
+            f"{kill.get('acked_before_kill')!r} < 4 — the acceptance "
+            "scenario requires a real mid-burst kill"
+        )
+    _check_recovery_arm(
+        "serve_crash_torn", by_name["serve_crash_torn"], errs
+    )
+    torn = by_name["serve_crash_torn"]
+    if torn.get("torn_line_appended") is not True:
+        errs.append(
+            "serve_crash_torn: torn_line_appended is not true — the "
+            "arm must prove a torn tail is skipped, not absent"
+        )
+
+    drain = by_name["drain_handoff"]
+    if drain.get("exit_code") != 0:
+        errs.append(
+            f"drain_handoff: exit_code {drain.get('exit_code')!r} != "
+            "0 — a graceful drain that dies dirty is not graceful"
+        )
+    if drain.get("inflight_delivered") is not True:
+        errs.append(
+            "drain_handoff: the in-flight response was not delivered "
+            "before exit (the round-12 mid-write kill bug)"
+        )
+    if drain.get("new_request_503") is not True:
+        errs.append(
+            "drain_handoff: a request posted while draining did not "
+            "get 503/unavailable"
+        )
+    if drain.get("retry_after_present") is not True:
+        errs.append(
+            "drain_handoff: the draining 503 carried no Retry-After"
+        )
+    if drain.get("flight_reason") != "drain":
+        errs.append(
+            f"drain_handoff: flight dump reason "
+            f"{drain.get('flight_reason')!r} != 'drain' — a graceful "
+            "hand-off must be distinguishable from a sigterm kill"
+        )
+    if drain.get("observed_warmup_written") is not True:
+        errs.append(
+            "drain_handoff: warmup.observed.json was not snapshotted "
+            "— the successor would warm up blind"
+        )
+
+    disk = by_name["serve_diskfull"]
+    if disk.get("response_ok") is not True:
+        errs.append(
+            "serve_diskfull: the request did not serve 200 — a full "
+            "disk must degrade durability accounting, not "
+            "availability"
+        )
+    if not (_num(disk.get("errors_counted"))
+            and disk["errors_counted"] >= 1):
+        errs.append(
+            "serve_diskfull: errors_counted "
+            f"{disk.get('errors_counted')!r} — the failed write must "
+            "be COUNTED, not silent"
+        )
+
+    hang = by_name["serve_hang"]
+    if hang.get("bounded") is not True:
+        errs.append(
+            "serve_hang: the injected hang was not bounded by the "
+            "dispatch deadline"
+        )
+    if hang.get("survived") is not True:
+        errs.append(
+            "serve_hang: the daemon did not serve the follow-up "
+            "request after aborting the hung dispatch"
+        )
+
+    evict = by_name["serve_evict"]
+    if evict.get("response_ok") is not True:
+        errs.append("serve_evict: a post-eviction request failed")
+    if evict.get("honest_miss") is not True:
+        errs.append(
+            "serve_evict: the forced eviction did not produce an "
+            "honest recompile (warm hit -> post-evict miss)"
+        )
+
+    # Headline cells the trajectory checker tracks.
+    if not (_num(record.get("acked_loss"))
+            and record["acked_loss"] == 0):
+        errs.append(
+            f"acked_loss {record.get('acked_loss')!r} != 0"
+        )
+    if record.get("replay_bit_identical") not in (1, 1.0, True):
+        errs.append(
+            "replay_bit_identical "
+            f"{record.get('replay_bit_identical')!r} != 1.0"
+        )
+    if not (_num(record.get("recovery_warm_ms"))
+            and record["recovery_warm_ms"] > 0):
+        errs.append(
+            f"recovery_warm_ms {record.get('recovery_warm_ms')!r} "
+            "is not positive"
+        )
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="CHAOS_SERVE_r16.json to validate")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"check_chaos_serve: cannot read {args.path}: {e}")
+        return 1
+    errs = validate_chaos_serve(record)
+    if errs:
+        print(f"check_chaos_serve: {args.path} INVALID:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print(
+        f"check_chaos_serve: {args.path} OK "
+        f"({len(record.get('arms', []))} arms, acked_loss="
+        f"{record.get('acked_loss')}, recovery_warm_ms="
+        f"{record.get('recovery_warm_ms')})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,7 @@ _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _SCALE_RE = re.compile(r"^SCALE_r(\d+)\.json$")
 _VIDEO_RE = re.compile(r"^VIDEO_r(\d+)\.json$")
 _SLO_RE = re.compile(r"^SLO_r(\d+)\.json$")
+_CHAOS_SERVE_RE = re.compile(r"^CHAOS_SERVE_r(\d+)\.json$")
 
 PROVENANCES = ("measured", "carried", "modeled")
 
@@ -125,6 +126,26 @@ SLO_SERIES: Tuple[Dict, ...] = (
     {"field": "availability", "direction": "higher", "abs_tol": 0.02,
      "floor": 0.95, "since": 15,
      "label": "serving availability over admitted requests"},
+)
+
+# CHAOS_SERVE artifacts (round 16: tools/chaos_serve.py) carry the
+# serving-resilience headline at top level.  acked_loss and
+# replay_bit_identical are ABSOLUTE invariants (ceiling/floor, no
+# drift allowed — losing one acknowledged request, or replaying one
+# request differently, is a broken tier, not a regression trend);
+# recovery_warm_ms is held loosely like the SLO latency series (CPU
+# proxy on shared machines: only a multiple-of-itself slowdown in
+# kill -> takeover -> fully-replayed is a signal).
+CHAOS_SERVE_SERIES: Tuple[Dict, ...] = (
+    {"field": "acked_loss", "direction": "lower", "abs_tol": 0.0,
+     "ceiling": 0.0, "since": 16,
+     "label": "acked requests lost across kill -> takeover"},
+    {"field": "recovery_warm_ms", "direction": "lower", "rel_tol": 1.0,
+     "since": 16,
+     "label": "kill -> takeover full-recovery wall (ms; CPU proxy)"},
+    {"field": "replay_bit_identical", "direction": "higher",
+     "abs_tol": 0.0, "floor": 1.0, "since": 16,
+     "label": "takeover replay bit-identity (1.0 = every replay)"},
 )
 
 # SCALE rows are keyed by size; each series is tracked per size.
@@ -224,8 +245,8 @@ def _flatten_video(rec):
 
 
 def load_history(root: str):
-    """(bench, scale, video, slo) lists of (round, filename, payload),
-    round-sorted.  BENCH payloads unwrap the driver's capture wrapper
+    """(bench, scale, video, slo, chaos_serve) lists of (round,
+    filename, payload), round-sorted.  BENCH payloads unwrap the driver's capture wrapper
     to the parsed record.  Builder probe files (BENCH_r*_builder*.json)
     do not match the round pattern and are deliberately out of scope —
     they are CPU-built field-builder exercises, not round records.
@@ -233,7 +254,7 @@ def load_history(root: str):
     modeled (`_mark_compressed_cells`); VIDEO payloads stay raw here
     (schema validation needs the nested record) and are flattened at
     the series check."""
-    bench, scale, video, slo = [], [], [], []
+    bench, scale, video, slo, chaos_serve = [], [], [], [], []
     for name in sorted(os.listdir(root)):
         m = _BENCH_RE.match(name)
         if m:
@@ -260,11 +281,16 @@ def load_history(root: str):
         if m:
             with open(os.path.join(root, name)) as f:
                 slo.append((int(m.group(1)), name, json.load(f)))
+        m = _CHAOS_SERVE_RE.match(name)
+        if m:
+            with open(os.path.join(root, name)) as f:
+                chaos_serve.append((int(m.group(1)), name, json.load(f)))
     bench.sort(key=lambda t: t[0])
     scale.sort(key=lambda t: t[0])
     video.sort(key=lambda t: t[0])
     slo.sort(key=lambda t: t[0])
-    return bench, scale, video, slo
+    chaos_serve.sort(key=lambda t: t[0])
+    return bench, scale, video, slo, chaos_serve
 
 
 # ------------------------------------------------------ schema (by era)
@@ -495,7 +521,7 @@ def check_series(
 def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
     """All schema + trajectory checks over the committed history.
     Returns (violations, machine-readable report rows)."""
-    bench, scale, video, slo = load_history(root)
+    bench, scale, video, slo, chaos_serve = load_history(root)
     errs: List[str] = []
     report: List[Dict] = []
 
@@ -516,6 +542,12 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
         from check_slo import validate_slo
 
         errs.extend(f"{name}: {e}" for e in validate_slo(rec))
+    for rnd, name, rec in chaos_serve:
+        # Serving-chaos artifacts carry their full contract in
+        # check_chaos_serve.
+        from check_chaos_serve import validate_chaos_serve
+
+        errs.extend(f"{name}: {e}" for e in validate_chaos_serve(rec))
 
     for decl in BENCH_SERIES:
         check_series(
@@ -532,6 +564,12 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
         check_series(
             decl, [(r, n, rec) for r, n, rec in slo],
             f"slo.{decl['field']}", errs, report,
+        )
+    for decl in CHAOS_SERVE_SERIES:
+        # Chaos-serve headline cells are top-level too.
+        check_series(
+            decl, [(r, n, rec) for r, n, rec in chaos_serve],
+            f"chaos_serve.{decl['field']}", errs, report,
         )
     def _rows(data):
         rows = data.get("rows") if isinstance(data, dict) else None
