@@ -309,7 +309,7 @@ def cmd_synth(args) -> int:
         args.progress or args.trace_dir or args.health
         or args.metrics_port is not None or args.supervise
     )
-    if args.bands > 1 and not args.spatial:
+    if args.bands is not None and args.bands > 1 and not args.spatial:
         raise SystemExit(
             "--bands requires --spatial (it names the A-band axis of "
             "the 2-D bands x slabs mesh); for A-side banding alone use "
@@ -342,18 +342,34 @@ def cmd_synth(args) -> int:
                 import jax
 
                 from .parallel.mesh import make_mesh
+                from .parallel.plan2d import override_plan, plan_mesh_shape
                 from .parallel.spatial import synthesize_spatial
 
-                if args.bands > 1:
-                    n_dev = args.n_devices or len(jax.devices())
+                n_dev = args.n_devices or len(jax.devices())
+                if args.bands is not None:
+                    # Explicit --bands/--mesh-rows: the user decided;
+                    # the run plan records the override.
                     if n_dev % args.bands:
                         raise SystemExit(
                             f"--bands {args.bands} must divide the "
                             f"device count ({n_dev})"
                         )
+                    plan = override_plan(
+                        args.bands, n_dev // args.bands
+                    )
+                else:
+                    # Default: the mesh-shape planner picks the
+                    # (bands, slabs) factorization from the modeled
+                    # collective + candidate traffic (de-leaned
+                    # levels penalized; parallel/plan2d.py); decision
+                    # and rejected alternatives land on the run plan.
+                    plan = plan_mesh_shape(
+                        n_dev, a.shape[:2], b.shape[:2], cfg
+                    )
+                if plan.n_bands > 1:
                     mesh = make_mesh(
                         n_dev, axis_names=("bands", "slabs"),
-                        shape=(args.bands, n_dev // args.bands),
+                        shape=(plan.n_bands, plan.n_slabs),
                     )
                 else:
                     mesh = make_mesh(args.n_devices)
@@ -362,6 +378,7 @@ def cmd_synth(args) -> int:
                     progress=level_progress,
                     resume_from=resume_from,
                     resume_strict=_resume_strict_for(args, resume_from, strict_state),
+                    mesh_plan=plan.as_attrs(),
                 )
             if mode == "sharded_a":
                 from .parallel.mesh import make_mesh
@@ -1056,10 +1073,14 @@ def main(argv=None) -> int:
     )
     p.add_argument("--n-devices", type=int, default=None)
     p.add_argument(
-        "--bands", type=int, default=1,
-        help="with --spatial: additionally band-shard the A side over "
-        "this many mesh rows (2-D bands x slabs mesh — style pair AND "
-        "target beyond one chip).  Must divide the device count",
+        "--bands", "--mesh-rows", dest="bands", type=int, default=None,
+        help="with --spatial: band-shard the A side over this many "
+        "mesh rows (2-D bands x slabs mesh — style pair AND target "
+        "beyond one chip).  Must divide the device count.  Default: "
+        "the mesh-shape planner (parallel/plan2d.py) picks the "
+        "factorization from the modeled comms volume + per-device "
+        "residency; pass an explicit value (1 = flat 1-D mesh) to "
+        "override it",
     )
     _add_synth_flags(p)
     p.set_defaults(fn=cmd_synth)

@@ -1013,7 +1013,8 @@ def _resolve_channels(a, ap, b, cfg: SynthConfig):
 def record_prologue(tracer, pyr_raw_b, levels: int, t0: float,
                     cfg: Optional[SynthConfig] = None,
                     a_hw=None, batched: bool = False,
-                    runner: str = "single") -> None:
+                    runner: str = "single",
+                    mesh_plan: Optional[dict] = None) -> None:
     """Drain the async prologue and record its span — shared by every
     runner so the sync barrier lives in ONE place.
 
@@ -1029,7 +1030,10 @@ def record_prologue(tracer, pyr_raw_b, levels: int, t0: float,
     /progress endpoint calibrates its ETA from (telemetry/live.py).
     `batched` says pyr_raw_b entries carry a leading frame axis;
     `a_hw` is the finest A shape (the sharded runners' comms term);
-    `runner` names which collective model applies."""
+    `runner` names which collective model applies; `mesh_plan` (the
+    2-D runner) is the parallel/plan2d.py verdict — chosen shape plus
+    rejected alternatives — carried verbatim on the run plan so flight
+    dumps show why THIS mesh."""
     if not tracer.enabled:
         return
     float(jnp.sum(pyr_raw_b[levels - 1]))
@@ -1043,6 +1047,7 @@ def record_prologue(tracer, pyr_raw_b, levels: int, t0: float,
         s = pyr_raw_b[lvl].shape
         hw = s[1:3] if batched else s[:2]
         shapes.append([int(hw[0]), int(hw[1])])
+    extra = {"mesh_plan": mesh_plan} if mesh_plan else {}
     tracer.annotate(
         "run_plan",
         levels=levels,
@@ -1051,6 +1056,7 @@ def record_prologue(tracer, pyr_raw_b, levels: int, t0: float,
         matcher=cfg.matcher,
         runner=runner,
         eta_cost_units=level_eta_cost_units(cfg, shapes, a_hw, runner),
+        **extra,
     )
 
 
@@ -1145,7 +1151,8 @@ def shard_sync_walls(level_t0: float, parts) -> List[float]:
 def record_level_span(tracer, cfg: SynthConfig, level_t0: float,
                       level: int, h, w, nnf_energy: Optional[float],
                       shard_walls: Optional[List[float]] = None,
-                      shard_axis: Optional[str] = None, **attrs):
+                      shard_axis: Optional[str] = None,
+                      extra_shard_walls=None, **attrs):
     """Timed `level` span + declared em_iter children — the shared
     form for the parallel runners (batch/spatial/sharded-A), whose
     level wall is clocked around one already-synced runner call.  The
@@ -1160,31 +1167,45 @@ def record_level_span(tracer, cfg: SynthConfig, level_t0: float,
     `ia_shard_level_wall_ms{level, shard, axis}` gauges and the
     `ia_shard_imbalance_ratio{level, axis}` max/median ratio the
     sentinel's `straggler_skew` check reads, and carries both on the
-    span's attrs so flight dumps and reports show them too."""
+    span's attrs so flight dumps and reports show them too.
+
+    Round-17: `extra_shard_walls` ({axis: walls}) publishes the same
+    gauge/ratio pair for further mesh axes — the 2-D runner stamps the
+    slabs walls as the primary set and the bands-axis assembly walls
+    here, so the straggler sentinel watches both axes of the
+    bands x slabs mesh.  Extra axes annotate the span as
+    `shard_walls_ms_<axis>` / `shard_imbalance_<axis>`."""
+    wall_sets = []
     if shard_walls:
+        wall_sets.append((shard_axis or "shard", shard_walls, True))
+    for ax, walls in (extra_shard_walls or {}).items():
+        if walls:
+            wall_sets.append((ax, walls, False))
+    for axis, walls, primary in wall_sets:
         # True median (even counts average the two middles): the upper
         # middle alone IS the max on a 2-shard mesh, which would pin
         # the ratio at 1.0 and blind the straggler watch exactly where
         # skew is most common.
-        s = sorted(shard_walls)
+        s = sorted(walls)
         n = len(s)
         med = s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
-        ratio = (
-            round(max(shard_walls) / med, 4) if med > 0 else 1.0
-        )
-        attrs["shard_walls_ms"] = shard_walls
-        attrs["shard_imbalance"] = ratio
+        ratio = round(max(walls) / med, 4) if med > 0 else 1.0
+        if primary:
+            attrs["shard_walls_ms"] = walls
+            attrs["shard_imbalance"] = ratio
+        else:
+            attrs[f"shard_walls_ms_{axis}"] = walls
+            attrs[f"shard_imbalance_{axis}"] = ratio
         reg = (
             tracer.registry if tracer.registry is not None
             else get_registry()
         )
-        axis = shard_axis or "shard"
         wall_g = reg.gauge(
             "ia_shard_level_wall_ms",
             "per-shard completion wall per pyramid level (ms since "
             "level start; post-hoc readback stamps — straggler watch)",
         )
-        for i, wall in enumerate(shard_walls):
+        for i, wall in enumerate(walls):
             wall_g.set(wall, labels={
                 "level": str(level), "shard": str(i), "axis": axis,
             })

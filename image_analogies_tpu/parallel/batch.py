@@ -317,7 +317,16 @@ _MESHES = {}
 
 
 def _mesh_token(mesh) -> tuple:
-    token = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+    # The mesh SHAPE is part of the identity: (2, 2) and (4, 1) meshes
+    # over the same four devices with the same axis names compile
+    # different programs (observed: the 2-D spatial runner reused a
+    # (2, 2)-mesh step fn for a (4, 1) mesh and crashed on spec
+    # mismatch — or worse, would silently mis-shard on agreeing shapes).
+    token = (
+        tuple(d.id for d in mesh.devices.flat),
+        mesh.axis_names,
+        tuple(mesh.devices.shape),
+    )
     _MESHES[token] = mesh
     return token
 

@@ -47,7 +47,15 @@ The four runners:
   the sharded-A terms on the bands axis (per single EM step —
   `sharded_a_allreduce_count` with em_iters=1 semantics via
   `per_em=True`) plus the spatial re-slab on the slabs axis; the two
-  axes carry disjoint traffic.
+  axes carry disjoint traffic.  On 2-D meshes the re-slab is the
+  MANUAL ppermute halo exchange (round-17; `_reslab_fn`'s 2-D branch —
+  GSPMD's select-and-sum stitch partitioning double-counts the
+  bands-replicated contributions on this jax), so the slabs axis is
+  exactly countable: `spatial_reslab_collectives` sites per re-slab,
+  pinned against the compiled HLO, and
+  `banded_spatial_level_collectives` composes the per-axis schedule
+  for one whole lean level (the run-plan/prologue term and the
+  sentinel ledger's expectation both draw from it).
 """
 
 from __future__ import annotations
@@ -171,6 +179,68 @@ def sharded_a_allreduce_sites(
             if cfg.kappa > 0.0:
                 total += 2 * 4  # Ashikhmin pass, Python-unrolled
     return total
+
+
+def spatial_reslab_collectives(n_arrays: int) -> int:
+    """Collective-permute SITES traced into one 2-D re-slab call
+    (`_reslab_fn`'s manual halo-exchange branch): each slab-stacked
+    array trades `halo` boundary rows with both mesh neighbors — one
+    `ppermute` site per direction per array.  Sites == compiled
+    collective-permute ops (the exchange is Python-unrolled over
+    arrays, no scan), which is what lets test_comms_model.py pin the
+    count against the HLO exactly."""
+    return 2 * n_arrays
+
+
+def banded_spatial_level_collectives(
+    cfg: SynthConfig, ha: int, wa: int, h: int, w: int,
+    mesh_shape,
+) -> Dict[str, Dict[str, int]]:
+    """Joint 2-D comms schedule for ONE lean banded spatial level on a
+    (n_bands, n_slabs) mesh: the per-axis collective counts and the
+    slabs-axis payload bytes, composed from the two already-pinned 1-D
+    models.  The two axes carry disjoint traffic:
+
+    - **bands**: `sharded_a_allreduce_sites(per_em=True)` per EM
+      iteration, with the polish schedule the spatial runner actually
+      passes (`polish_iters=0` on non-final iterations under
+      pm_polish_final_only) — the same expression
+      `_banded_lean_step_fn` books as the sentinel ledger's expected
+      side, so plan, ledger, and HLO pin cannot drift apart.
+    - **slabs**: one manual re-slab between consecutive EM iterations
+      (`em_iters - 1` per level), `spatial_reslab_collectives(3)`
+      permute sites each (lean state: py, px, bp) moving
+      `spatial_reslab_bytes` of boundary rows.
+
+    With one band or one slab the corresponding axis entry is zero —
+    the single-axis models apply directly (this function is the 2-D
+    composition, not a replacement)."""
+    n_bands, n_slabs = mesh_shape
+    from .spatial import slab_halo
+
+    halo = slab_halo(cfg)
+    bands_sites = 0
+    if n_bands > 1:
+        for em in range(cfg.em_iters):
+            final = em == cfg.em_iters - 1
+            override = (
+                None if (final or not cfg.pm_polish_final_only) else 0
+            )
+            bands_sites += sharded_a_allreduce_sites(
+                cfg, ha, wa, per_em=True, polish_iters=override
+            )
+    # The manual ppermute re-slab runs whenever the MESH is 2-D (its
+    # axis count, not the band count, selects `_reslab_fn`'s branch).
+    n_reslabs = max(cfg.em_iters - 1, 0)
+    permutes = n_reslabs * spatial_reslab_collectives(3)
+    return {
+        "bands": {"all_reduce_sites": bands_sites},
+        "slabs": {
+            "reslabs": n_reslabs,
+            "collective_permutes": permutes,
+            "reslab_bytes": n_reslabs * spatial_reslab_bytes(w, halo, 3),
+        },
+    }
 
 
 def sharded_a_band_merge_bytes(

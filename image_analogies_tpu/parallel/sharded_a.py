@@ -179,13 +179,26 @@ def _band_assembly_aligned(ha: int, hc, n_dev: int,
 @functools.lru_cache(maxsize=32)
 def _band_assemble_fn(cfg: SynthConfig, mesh_key, has_coarse: bool,
                       n_dev: int):
-    """Band-sharded lean A-table assembly: ONE jitted shard_map call in
-    which each device assembles its own band's (rows/n * wa, D) slice
-    from a halo-extended slab of the A pyramids, so no device ever
-    holds the full table OR the full assembly temps (module docstring;
-    the slab geometry is `_split_slabs`' — bit-exact per the spatial
-    runner's halo contract, pinned by
-    test_sharded_a_band_assembly_matches_full)."""
+    """Band-sharded lean A-table assembly: each device assembles its
+    own band's (rows/n * wa, D) slice from a halo-extended slab of the
+    A pyramids, so no device ever holds the full table OR the full
+    assembly temps (module docstring; the slab geometry is
+    `_split_slabs`' — bit-exact per the spatial runner's halo contract,
+    pinned by test_sharded_a_band_assembly_matches_full).
+
+    The slab stacks are split EAGERLY and PLACED with an explicit
+    (bands-sharded, otherwise-replicated) sharding before the jitted
+    shard_map consumes them, and the jit pins matching `in_shardings`.
+    Tracing `_split_slabs` into the same jit and letting GSPMD derive
+    the manual-region boundary layout miscompiles on this jax (0.4.x)
+    when the mesh has a second axis the specs leave unmentioned: GSPMD
+    materializes the stacks as per-device dynamic-update-slice
+    contributions summed by an all-reduce over ALL devices, which
+    double-counts the slabs-replicated contributions — the assembled
+    table comes back exactly n_slabs x the true values (root cause of
+    the round-6 "2.5% of pixels diverge" 2-D measurement; regression-
+    pinned by tests/test_sharded_a.py
+    test_band_assembly_2d_mesh_matches_full)."""
     from jax.sharding import PartitionSpec as P
 
     from .batch import _MESHES
@@ -193,45 +206,55 @@ def _band_assemble_fn(cfg: SynthConfig, mesh_key, has_coarse: bool,
 
     mesh = _MESHES[mesh_key]
     halo = slab_halo(cfg)
+    band_shard = NamedSharding(mesh, P(_AXIS))
+    n_in = 4 if has_coarse else 2
 
-    def call(src_a, flt_a, src_c=None, flt_c=None):
-        rows_pb = src_a.shape[0] // n_dev
-        wa = src_a.shape[1]
-        slabs = [
-            _split_slabs(src_a, n_dev, halo),
-            _split_slabs(flt_a, n_dev, halo),
+    def body(*bslabs):
+        parts = [s[0] for s in bslabs]
+        s_src, s_flt = parts[0], parts[1]
+        s_src_c = parts[2] if has_coarse else None
+        s_flt_c = parts[3] if has_coarse else None
+        rows_pb = s_src.shape[0] - 2 * halo
+        wa = s_src.shape[1]
+        tab = assemble_features_lean(
+            s_src, s_flt, cfg, s_src_c, s_flt_c
+        )
+        d = tab.shape[1]
+        core = tab.reshape(rows_pb + 2 * halo, wa, d)[
+            halo : halo + rows_pb
         ]
-        if has_coarse:
-            slabs += [
-                _split_slabs(src_c, n_dev, halo // 2),
-                _split_slabs(flt_c, n_dev, halo // 2),
-            ]
+        return core.reshape(rows_pb * wa, d)
 
-        def body(*bslabs):
-            parts = [s[0] for s in bslabs]
-            s_src, s_flt = parts[0], parts[1]
-            s_src_c = parts[2] if has_coarse else None
-            s_flt_c = parts[3] if has_coarse else None
-            tab = assemble_features_lean(
-                s_src, s_flt, cfg, s_src_c, s_flt_c
-            )
-            d = tab.shape[1]
-            core = tab.reshape(rows_pb + 2 * halo, wa, d)[
-                halo : halo + rows_pb
-            ]
-            return core.reshape(rows_pb * wa, d)
-
-        return shard_map(
+    shmapped = jax.jit(
+        shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(_AXIS),) * len(slabs),
+            in_specs=(P(_AXIS),) * n_in,
             out_specs=P(_AXIS),
             # assemble_features_lean's fori_loop body carries no
             # varying-mesh-axes info (same pattern as the level fns).
             check_vma=False,
-        )(*slabs)
+        ),
+        in_shardings=(band_shard,) * n_in,
+    )
 
-    return jax.jit(call)
+    def call(src_a, flt_a, src_c=None, flt_c=None):
+        slabs = [
+            jax.device_put(_split_slabs(src_a, n_dev, halo), band_shard),
+            jax.device_put(_split_slabs(flt_a, n_dev, halo), band_shard),
+        ]
+        if has_coarse:
+            slabs += [
+                jax.device_put(
+                    _split_slabs(src_c, n_dev, halo // 2), band_shard
+                ),
+                jax.device_put(
+                    _split_slabs(flt_c, n_dev, halo // 2), band_shard
+                ),
+            ]
+        return shmapped(*slabs)
+
+    return call
 
 
 @functools.lru_cache(maxsize=32)

@@ -131,10 +131,88 @@ def _reslab_fn(halo: int, n_slabs: int, n_arrays: int, mesh_key,
     here).  Array count is generic: the standard path re-halos
     (stacked-nnf, bp), the lean path (py, px, bp).  `axis` names the
     mesh axis the slab stack shards over ('slabs' when
-    `synthesize_spatial` runs on the 2-D bands x slabs mesh)."""
+    `synthesize_spatial` runs on the 2-D bands x slabs mesh).
+
+    On 2-D meshes the merge+split CANNOT be left to GSPMD: on this jax
+    (0.4.x) the SPMD partitioner materializes pad/concat of an array
+    that is sharded along one mesh axis and replicated along the other
+    as per-device dynamic-update-slice contributions summed by an
+    all-reduce over ALL devices, double-counting the replicated-axis
+    contributions once per band — the re-slabbed state comes back
+    scaled by n_bands^2 (one doubling per stage; measured 4x on a
+    (2, 2) mesh, 16x on (4, 2); regression-pinned by
+    test_reslab_2d_mesh_bit_identical).  The 2-D path therefore runs
+    the halo exchange MANUALLY under shard_map: each slab keeps its
+    core and trades `halo` boundary rows with its mesh neighbors via
+    two `ppermute`s per array, edge slabs re-clamping their outer halo
+    (`jnp.pad` edge semantics).  The explicit permutes are also what
+    makes the slabs axis exactly countable for the sentinel's comms
+    ledger (parallel/comms.py `spatial_reslab_collectives`)."""
     from .batch import _MESHES
 
-    shard = batch_sharding(_MESHES[mesh_key], axis)
+    mesh = _MESHES[mesh_key]
+    shard = batch_sharding(mesh, axis)
+
+    if len(mesh.axis_names) > 1:
+        from jax.sharding import PartitionSpec as P
+
+        perm_fwd = [(i, i + 1) for i in range(n_slabs - 1)]
+        perm_bwd = [(i + 1, i) for i in range(n_slabs - 1)]
+
+        def body(*slabs):
+            from ..telemetry.metrics import (
+                count_collectives,
+                count_expected_collectives,
+            )
+
+            # EXPECTED side of the slabs-axis comms ledger, booked in
+            # the same traced body as the observed permute sites so
+            # both skip together on jit cache hits.
+            count_expected_collectives(2 * n_arrays, axis)
+            idx = jax.lax.axis_index(axis)
+            outs = []
+            for s in slabs:
+                x = s[0]
+                core = x[halo : x.shape[0] - halo]
+                # OBSERVED: one collective-permute site per direction.
+                count_collectives(1, axis, kind="collective_permute")
+                from_prev = jax.lax.ppermute(
+                    core[-halo:], axis, perm_fwd
+                )
+                count_collectives(1, axis, kind="collective_permute")
+                from_next = jax.lax.ppermute(
+                    core[:halo], axis, perm_bwd
+                )
+                top = jnp.where(
+                    idx == 0,
+                    jnp.repeat(core[:1], halo, axis=0),
+                    from_prev,
+                )
+                bot = jnp.where(
+                    idx == n_slabs - 1,
+                    jnp.repeat(core[-1:], halo, axis=0),
+                    from_next,
+                )
+                outs.append(
+                    jnp.concatenate([top, core, bot], axis=0)[None]
+                )
+            return tuple(outs)
+
+        S = P(axis)
+        return jax.jit(
+            shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(S,) * n_arrays,
+                out_specs=(S,) * n_arrays,
+                # Outputs are band-invariant (pure function of the
+                # band-replicated slab state); no varying-mesh-axes
+                # info crosses the boundary.
+                check_vma=False,
+            ),
+            in_shardings=(shard,) * n_arrays,
+            out_shardings=(shard,) * n_arrays,
+        )
 
     def reslab(*slabs):
         return tuple(
@@ -221,7 +299,25 @@ def _banded_lean_step_fn(cfg: SynthConfig, level: int, has_coarse: bool,
         )(f_a_tab, a_stacked, bounds_stacked, src_b_s, flt_s,
           src_b_c_s, flt_c_s, copy_a, py_s, px_s, keys)
 
-    return jax.jit(call)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    band = NamedSharding(mesh, P(_BANDS_AXIS))
+    slab = NamedSharding(mesh, P(_SLABS_AXIS))
+    repl = NamedSharding(mesh, P())
+    # Pin every input's PHYSICAL sharding at the jit boundary.  On this
+    # jax (0.4.x) an input whose layout GSPMD is left to derive can be
+    # miscompiled where it crosses into the shard_map's manual region
+    # when the specs leave a mesh axis unmentioned (see
+    # sharded_a._band_assemble_fn for the measured double-count); with
+    # committed shardings that match the in_specs the boundary is a
+    # no-op and the hazard cannot arise.
+    return jax.jit(
+        call,
+        in_shardings=(
+            band, band, band, slab, slab, slab, slab, repl, slab, slab,
+            slab,
+        ),
+    )
 
 
 def synthesize_spatial(
@@ -233,6 +329,7 @@ def synthesize_spatial(
     progress=None,
     resume_from: Optional[str] = None,
     resume_strict: bool = False,
+    mesh_plan: Optional[dict] = None,
 ):
     """B' for one (large) `b`, rows sharded over the mesh's batch axis.
 
@@ -257,6 +354,10 @@ def synthesize_spatial(
     a prior run) — restarts from the finest completed level like
     create_image_analogy.  The fingerprint covers the *padded* B shape,
     so checkpoints only resume onto a mesh with the same padding grain.
+
+    `mesh_plan`: the parallel/plan2d.py verdict dict (`MeshPlan
+    .as_attrs()`) when the mesh shape was planned (or overridden) by
+    the caller — recorded verbatim on the run plan.
     """
     import time
 
@@ -266,6 +367,7 @@ def synthesize_spatial(
     cfg = cfg or SynthConfig()
     mesh = mesh or make_mesh()
     token = _mesh_token(mesh)
+    sub_mesh = sub_token = None
     if _BANDS_AXIS in mesh.axis_names:
         if mesh.axis_names != (_BANDS_AXIS, _SLABS_AXIS):
             raise ValueError(
@@ -275,6 +377,21 @@ def synthesize_spatial(
         n_bands = int(mesh.shape[_BANDS_AXIS])
         slab_axis = _SLABS_AXIS
         n_slabs = int(mesh.shape[_SLABS_AXIS])
+        # Non-banded levels (sub-lean, or lean with one band) run on a
+        # 1-D SLABS SUBMESH — the first band row of devices.  Their
+        # GSPMD-partitioned step fns are only proven on 1-D meshes: on
+        # a 2-D mesh the partitioner's select-and-sum handling of
+        # slabs-sharded / bands-replicated arrays double-counts the
+        # replicated contributions (the same jax-0.4.x miscompile the
+        # banded path routes around with explicit shardings and the
+        # manual re-slab — see `_reslab_fn`).  Those levels are 4^-l
+        # of the finest's work, so idling the other band rows costs
+        # marginally while keeping every compiled program in its
+        # test-pinned regime.
+        from jax.sharding import Mesh
+
+        sub_mesh = Mesh(mesh.devices[0, :], (_SLABS_AXIS,))
+        sub_token = _mesh_token(sub_mesh)
     else:
         n_bands = 1
         slab_axis = mesh.axis_names[0]
@@ -320,6 +437,7 @@ def synthesize_spatial(
         tracer, pyr_raw_b, levels, prologue_t0, cfg=cfg,
         a_hw=a.shape[:2],
         runner="spatial-banded" if n_bands > 1 else "spatial",
+        mesh_plan=mesh_plan,
     )
 
     key = jax.random.PRNGKey(cfg.seed)
@@ -378,27 +496,36 @@ def synthesize_spatial(
         _fault_fire("kernel", level)
 
         banded = lean and n_bands > 1
-        if banded and not hasattr(jax, "shard_map"):
-            # The 1-D paths are bit-identity-tested under the 0.4.x
-            # fallback (parallel/mesh.shard_map), but the 2-D bands x
-            # slabs composition produces numerically WRONG results on
-            # it (measured: 2.5% of pixels diverge from the 1-D
-            # reference on jax 0.4.37) — an exit-0 wrong image is the
-            # one failure mode observability cannot catch, so refuse
-            # loudly instead.
-            raise NotImplementedError(
-                "2-D bands x slabs lean levels require the public "
-                "jax.shard_map (jax >= 0.5); this jax only has the "
-                "experimental fallback, whose 2-D composition is "
-                "numerically unreliable here.  Use --sharded-a or a "
-                "1-D --spatial mesh instead."
-            )
         a_stacked = bounds_stacked = None
-        if banded and ha % n_bands:
-            raise ValueError(
-                f"2-D spatial level {level}: A rows ({ha}) must split "
-                f"evenly over {n_bands} bands"
-            )
+        a_pad = 0
+        if banded:
+            # A rows that don't split evenly over the bands pad with
+            # EDGE rows to band grain (round-17; replaces the hard
+            # ValueError): the lean table and kernel planes are built
+            # from the padded A so every band's shard is uniform (the
+            # shard_map requirement), while the band BOUNDS stay
+            # cropped to the real rows — no candidate is ever
+            # generated or owned in the pad, so ownership semantics
+            # and the bit-identity contract are unchanged.  With a
+            # coarse level the grain doubles so the coarse pyramid
+            # pads to exactly half the fine rows and splits on the
+            # same band boundaries.
+            a_grain = 2 * n_bands if has_coarse else n_bands
+            a_pad = (-ha) % a_grain
+            rows_pb = (ha + a_pad) // n_bands
+            if (n_bands - 1) * rows_pb >= ha:
+                raise ValueError(
+                    f"2-D spatial level {level}: A rows ({ha}) leave "
+                    f"band {n_bands - 1} of {n_bands} without a real "
+                    f"row to own — use fewer bands"
+                )
+        # Banded levels use the full 2-D mesh; everything else runs on
+        # the 1-D slabs submesh (or the 1-D mesh itself) — see the
+        # sub_mesh comment above.
+        lvl_mesh, lvl_token = mesh, token
+        if sub_mesh is not None and not banded:
+            lvl_mesh, lvl_token = sub_mesh, sub_token
+        band_walls = None
         if lean:
             proj = None
             if banded:
@@ -411,10 +538,7 @@ def synthesize_spatial(
                 # _band_assemble_fn), so no device holds the full
                 # table or its assembly temps.
                 from jax.sharding import NamedSharding, PartitionSpec as P
-                from ..kernels.patchmatch_tile import (
-                    band_bounds,
-                    prepare_a_planes,
-                )
+                from ..kernels.patchmatch_tile import prepare_a_planes
                 from ..models.analogy import _level_plan, _strip_noncompute
                 from .sharded_a import (
                     _band_assemble_fn,
@@ -422,24 +546,45 @@ def synthesize_spatial(
                 )
 
                 band_shard = NamedSharding(mesh, P(_BANDS_AXIS))
-                hc = pyr_src_a[level + 1].shape[0] if has_coarse else None
-                if _band_assembly_aligned(ha, hc, n_bands, has_coarse):
+                # Band-grain edge padding of the A-side inputs (a_pad
+                # rows on the fine arrays, half that on the coarse —
+                # see the a_pad comment above).  The pad rows sit at
+                # the END of the last band's shard, past its cropped
+                # bounds, so they are assembled but never evaluated.
+                def _pad_a_rows(x, n):
+                    if not n:
+                        return x
+                    return jnp.pad(
+                        x, [(0, n)] + [(0, 0)] * (x.ndim - 1),
+                        mode="edge",
+                    )
+
+                ha_k = ha + a_pad
+                src_a_k = _pad_a_rows(f_a_src, a_pad)
+                flt_a_k = _pad_a_rows(pyr_flt_a[level], a_pad)
+                src_c_k = flt_c_k = None
+                hc_k = None
+                if has_coarse:
+                    hc = pyr_src_a[level + 1].shape[0]
+                    hc_k = ha_k // 2
+                    src_c_k = _pad_a_rows(
+                        pyr_src_a[level + 1], hc_k - hc
+                    )
+                    flt_c_k = _pad_a_rows(
+                        pyr_flt_a[level + 1], hc_k - hc
+                    )
+                if _band_assembly_aligned(ha_k, hc_k, n_bands,
+                                          has_coarse):
                     coarse_args = (
-                        (pyr_src_a[level + 1], pyr_flt_a[level + 1])
-                        if has_coarse
-                        else ()
+                        (src_c_k, flt_c_k) if has_coarse else ()
                     )
                     f_a = _band_assemble_fn(
                         _strip_noncompute(cfg), token, has_coarse, n_bands
-                    )(f_a_src, pyr_flt_a[level], *coarse_args)
+                    )(src_a_k, flt_a_k, *coarse_args)
                 else:
                     f_a = jax.device_put(
                         assemble_features_lean(
-                            f_a_src,
-                            pyr_flt_a[level],
-                            cfg,
-                            pyr_src_a[level + 1] if has_coarse else None,
-                            pyr_flt_a[level + 1] if has_coarse else None,
+                            src_a_k, flt_a_k, cfg, src_c_k, flt_c_k
                         ),
                         band_shard,
                     )
@@ -449,17 +594,47 @@ def synthesize_spatial(
                 )
                 specs, use_coarse, _ = chan_plan
                 bands_p = prepare_a_planes(
-                    f_a_src,
-                    pyr_flt_a[level],
-                    pyr_src_a[level + 1] if use_coarse else None,
-                    pyr_flt_a[level + 1] if use_coarse else None,
+                    src_a_k,
+                    flt_a_k,
+                    src_c_k if use_coarse else None,
+                    flt_c_k if use_coarse else None,
                     specs,
                     n_bands=n_bands,
                 )
                 a_stacked = jax.device_put(jnp.stack(bands_p), band_shard)
+                # Bounds from the PADDED row grid, validity cropped to
+                # the real rows (band_bounds' own convention when the
+                # pad fits inside its ceil split).
+                rows_pb = ha_k // n_bands
                 bounds_stacked = jax.device_put(
-                    jnp.stack(band_bounds(ha, n_bands)), band_shard
+                    jnp.stack([
+                        jnp.asarray(
+                            [i * rows_pb,
+                             min(rows_pb, ha - i * rows_pb)],
+                            jnp.int32,
+                        )
+                        for i in range(n_bands)
+                    ]),
+                    band_shard,
                 )
+                if tracer.enabled:
+                    # Bands-axis straggler signal (round-17 mirror of
+                    # the sharded-A runner's): the EM body's pmin/psum
+                    # merges synchronize the bands every pm iteration,
+                    # so post-merge skew is unobservable — the
+                    # band-sharded ASSEMBLY, each band building its
+                    # table slice independently, is where a slow band
+                    # shows.  One readback barrier per band slice.
+                    from ..models.analogy import shard_sync_walls
+
+                    tab_rows = f_a.shape[0] // n_bands
+                    band_walls = shard_sync_walls(
+                        level_t0,
+                        [
+                            f_a[i * tab_rows:(i + 1) * tab_rows, :1]
+                            for i in range(n_bands)
+                        ],
+                    )
             else:
                 # 1-D lean: the A side is replicated (its single-chip
                 # ceiling applies per device by design; the bands axis
@@ -499,7 +674,7 @@ def synthesize_spatial(
         # Level-invariant slab views of the match-side images (the
         # coarse B' estimate is frozen for the whole level, so its slab
         # split is hoisted with them), placed on the mesh once per level.
-        shard = batch_sharding(mesh, slab_axis)
+        shard = batch_sharding(lvl_mesh, slab_axis)
         slab_src_b = jax.device_put(
             _split_slabs(pyr_src_b[level], n_slabs, halo), shard
         )
@@ -545,11 +720,11 @@ def synthesize_spatial(
         else:
             mk_step = (  # noqa: E731
                 (lambda p: _spatial_lean_step_fn(
-                    cfg, level, has_coarse, token, polish_iters=p,
+                    cfg, level, has_coarse, lvl_token, polish_iters=p,
                     axis=slab_axis))
                 if lean
                 else (lambda p: _spatial_step_fn(
-                    cfg, level, has_coarse, token, polish_iters=p,
+                    cfg, level, has_coarse, lvl_token, polish_iters=p,
                     axis=slab_axis))
             )
         step_final = mk_step(None)
@@ -597,12 +772,12 @@ def synthesize_spatial(
             if em < cfg.em_iters - 1:
                 if lean:
                     py_s, px_s, slab_flt = _reslab_fn(
-                        halo, n_slabs, 3, token, slab_axis
+                        halo, n_slabs, 3, lvl_token, slab_axis
                     )(nnf_s[0], nnf_s[1], bp_s)
                     slab_nnf = (py_s, px_s)
                 else:
                     slab_nnf, slab_flt = _reslab_fn(
-                        halo, n_slabs, 2, token, slab_axis
+                        halo, n_slabs, 2, lvl_token, slab_axis
                     )(nnf_s, bp_s)
         shard_walls = None
         if tracer.enabled:
@@ -637,6 +812,9 @@ def synthesize_spatial(
                 tracer, cfg, level_t0, level, h, w, float(dist.mean()),
                 spatial_slabs=n_slabs,
                 shard_walls=shard_walls, shard_axis=slab_axis,
+                extra_shard_walls=(
+                    {_BANDS_AXIS: band_walls} if band_walls else None
+                ),
             )
         if cfg.save_level_artifacts:
             nnf_save = nnf

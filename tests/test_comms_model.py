@@ -190,6 +190,175 @@ class TestSpatialReslab:
         )
 
 
+class TestBanded2D:
+    """Round-17: the joint 2-D comms schedule held against the compiled
+    artifacts on the (2, 4) bands x slabs mesh — bands-axis all-reduce
+    sites in the banded EM step, slabs-axis collective-permutes in the
+    manual re-slab, and the per-level composition formula."""
+
+    def _banded_inputs(self, rng, cfg, n_bands, n_slabs, h, w, ha, wa):
+        from image_analogies_tpu.kernels.patchmatch_tile import (
+            band_bounds,
+            prepare_a_planes,
+        )
+        from image_analogies_tpu.models.analogy import (
+            _level_plan,
+            assemble_features_lean,
+        )
+        from image_analogies_tpu.parallel.spatial import (
+            _split_slabs,
+            slab_halo,
+        )
+
+        halo = slab_halo(cfg)
+        src_a, flt_a = _imgs(rng, ha, wa)
+        src_b, flt_b = _imgs(rng, h, w)
+        f_a = assemble_features_lean(src_a, flt_a, cfg, None, None)
+        slab_shape = (h // n_slabs + 2 * halo, w)
+        specs, _use_coarse, _n = _level_plan(
+            cfg, src_a, flt_a, False, *slab_shape
+        )
+        bands = prepare_a_planes(
+            src_a, flt_a, None, None, specs, n_bands=n_bands
+        )
+        py = jnp.zeros((h, w), jnp.int32)
+        return dict(
+            f_a_tab=f_a,
+            a_stacked=jnp.stack(bands),
+            bounds_stacked=jnp.stack(band_bounds(ha, n_bands)),
+            src_b_s=_split_slabs(src_b, n_slabs, halo),
+            flt_s=_split_slabs(flt_b, n_slabs, halo),
+            py_s=_split_slabs(py, n_slabs, halo),
+            copy_a=src_a,
+            keys=jax.random.split(jax.random.PRNGKey(0), n_slabs),
+        )
+
+    def test_banded_step_allreduce_sites_match_model(self, rng):
+        """Lower the REAL 2-D banded EM step on the (2, 4) mesh and
+        count stablehlo.all_reduce ops: must equal
+        `sharded_a_allreduce_sites(per_em=True)` exactly — the bands
+        axis carries the same schedule as the 1-D sharded-A runner, and
+        the slabs axis contributes NO all-reduces to the step body (the
+        re-slab between EM iterations is a separate jit).
+        pm_polish_iters=1 keeps sites == runtime count (scan
+        subtlety — see sharded_a_allreduce_sites)."""
+        from image_analogies_tpu.parallel.comms import (
+            sharded_a_allreduce_sites,
+        )
+        from image_analogies_tpu.parallel.spatial import (
+            _banded_lean_step_fn,
+        )
+
+        cfg = SynthConfig(
+            levels=1, matcher="patchmatch", pallas_mode="interpret",
+            em_iters=2, pm_iters=1, pm_polish_iters=1,
+            pm_polish_random=1,
+        )
+        n_bands, n_slabs = 2, 4
+        h, w = 512, 128
+        ha = wa = 136
+        mesh = make_mesh(
+            n_bands * n_slabs, axis_names=("bands", "slabs"),
+            shape=(n_bands, n_slabs),
+        )
+        token = _mesh_token(mesh)
+        kw = self._banded_inputs(rng, cfg, n_bands, n_slabs, h, w, ha, wa)
+        # Final-EM semantics (polish engaged): the step the model's
+        # per_em=True unit describes.
+        run = _banded_lean_step_fn(cfg, 0, False, token, True, None)
+        txt = run.lower(
+            kw["f_a_tab"], kw["a_stacked"], kw["bounds_stacked"],
+            kw["src_b_s"], kw["flt_s"], kw["src_b_s"], kw["flt_s"],
+            kw["copy_a"], kw["py_s"], kw["py_s"], kw["keys"],
+        ).as_text()
+        want = sharded_a_allreduce_sites(cfg, ha, wa, per_em=True)
+        # 4*pm_iters + 2 entry/exact + engaged polish 1 + 8 + n_random.
+        assert want == 4 * 1 + 2 + (1 + 8 + 1)
+        assert txt.count("all_reduce") == want, (
+            txt.count("all_reduce"), want
+        )
+
+    def test_reslab_2d_collective_permute_count_matches_model(self, rng):
+        """The 2-D manual re-slab's slabs-axis traffic is exactly
+        countable (that is WHY it is manual — parallel/spatial.py):
+        `spatial_reslab_collectives(n_arrays)` collective-permute sites
+        per re-slab, and ZERO all-reduces / all-gathers in the compiled
+        HLO (GSPMD's select-and-sum stitch emitted partitioner-chosen
+        all-reduces; the manual path must not)."""
+        from image_analogies_tpu.parallel.comms import (
+            spatial_reslab_collectives,
+        )
+        from image_analogies_tpu.parallel.spatial import (
+            _reslab_fn,
+            _split_slabs,
+            slab_halo,
+        )
+
+        cfg = SynthConfig()
+        halo = slab_halo(cfg)
+        n_bands, n_slabs = 2, 4
+        mesh = make_mesh(
+            n_bands * n_slabs, axis_names=("bands", "slabs"),
+            shape=(n_bands, n_slabs),
+        )
+        token = _mesh_token(mesh)
+        x = jnp.asarray(rng.random((n_slabs * 16, 64), np.float32))
+        slabs = _split_slabs(x, n_slabs, halo)
+        fn = _reslab_fn(halo, n_slabs, 3, token, "slabs")
+        lowered = fn.lower(slabs, slabs, slabs)
+        want = spatial_reslab_collectives(3)
+        assert want == 6
+        assert lowered.as_text().count("collective_permute") == want
+        comp = lowered.compile().as_text()
+        assert comp.count("all-reduce(") == 0
+        assert comp.count("all-gather(") == 0
+
+    def test_banded_level_composition_model(self):
+        """`banded_spatial_level_collectives` is the exact composition
+        of the two pinned 1-D models: bands-axis sites follow the
+        spatial runner's per-EM polish overrides (engaged only on the
+        final EM under pm_polish_final_only), slabs-axis permutes are
+        `em_iters - 1` re-slabs x `spatial_reslab_collectives(3)`, and
+        degenerate axes contribute zero."""
+        from image_analogies_tpu.parallel.comms import (
+            banded_spatial_level_collectives,
+            sharded_a_allreduce_sites,
+            spatial_reslab_collectives,
+        )
+        from image_analogies_tpu.parallel.spatial import slab_halo
+
+        cfg = SynthConfig(
+            levels=1, matcher="patchmatch", pallas_mode="interpret",
+            em_iters=2, pm_iters=1, pm_polish_iters=1,
+            pm_polish_random=1,
+        )
+        h, w = 512, 128
+        ha = wa = 136
+        halo = slab_halo(cfg)
+        sched = banded_spatial_level_collectives(
+            cfg, ha, wa, h, w, (2, 4)
+        )
+        mid = sharded_a_allreduce_sites(
+            cfg, ha, wa, per_em=True, polish_iters=0
+        )
+        final = sharded_a_allreduce_sites(cfg, ha, wa, per_em=True)
+        assert sched["bands"]["all_reduce_sites"] == mid + final
+        assert sched["slabs"]["reslabs"] == cfg.em_iters - 1
+        assert sched["slabs"]["collective_permutes"] == (
+            (cfg.em_iters - 1) * spatial_reslab_collectives(3)
+        )
+        assert sched["slabs"]["reslab_bytes"] == (
+            (cfg.em_iters - 1) * spatial_reslab_bytes(w, halo, 3)
+        )
+        # Degenerate bands axis: a (1, n) mesh books no bands traffic
+        # but still re-slabs manually (the mesh is still 2-D).
+        one_band = banded_spatial_level_collectives(
+            cfg, ha, wa, h, w, (1, 4)
+        )
+        assert one_band["bands"]["all_reduce_sites"] == 0
+        assert one_band["slabs"] == sched["slabs"]
+
+
 class TestBatchStep:
     def test_batch_em_step_has_no_collectives(self, rng):
         """Data parallelism's defining property, asserted on the

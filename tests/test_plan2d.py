@@ -1,0 +1,140 @@
+"""Mesh-shape planner tests (r17).
+
+Pure host arithmetic — no devices, no tracing.  Pins the decision
+rule (feasibility -> kernel coverage -> modeled bytes), the HBM
+capacity constraint that forces bands on, the override path, and the
+run-plan annotation payload the prologue span records.
+"""
+
+import pytest
+
+from image_analogies_tpu.config import SynthConfig
+from image_analogies_tpu.parallel.plan2d import (
+    MeshCandidate,
+    _factorizations,
+    override_plan,
+    plan_mesh_shape,
+)
+
+
+def _cfg(**kw):
+    kw.setdefault("levels", 1)
+    kw.setdefault("matcher", "patchmatch")
+    kw.setdefault("em_iters", 2)
+    kw.setdefault("pm_iters", 2)
+    return SynthConfig(**kw)
+
+
+def test_factorization_enumeration():
+    assert _factorizations(8) == [(1, 8), (2, 4), (4, 2), (8, 1)]
+    assert _factorizations(12) == [
+        (1, 12), (2, 6), (3, 4), (4, 3), (6, 2), (12, 1)]
+    assert _factorizations(1) == [(1, 1)]
+    assert _factorizations(7) == [(1, 7), (7, 1)]
+
+
+def test_delean_penalty_beats_flat_mesh():
+    # 512-row B over 8 slabs = 64-row slab cores: below the kernel's
+    # LANE floor, so (1, 8) de-leans the whole run and its candidate
+    # traffic is charged the standard-path penalty.  The planner must
+    # pick (2, 4) — the exact decision that un-caps the runner.
+    plan = plan_mesh_shape(8, (128, 128), (512, 128), _cfg())
+    assert (plan.n_bands, plan.n_slabs) == (2, 4)
+    assert plan.chosen.kernel_levels == 1
+    assert plan.chosen.feasible
+    by_shape = {(c.n_bands, c.n_slabs): c for c in plan.rejected}
+    flat = by_shape[(1, 8)]
+    assert flat.feasible and flat.kernel_levels == 0
+    # The de-lean penalty is what prices the flat mesh out.
+    assert flat.score_bytes > plan.chosen.score_bytes
+    # (4, 2) also keeps the level eligible but models more bytes.
+    tall = by_shape[(4, 2)]
+    assert tall.kernel_levels == 1
+    assert tall.score_bytes > plan.chosen.score_bytes
+
+
+def test_flat_mesh_wins_when_everything_fits():
+    # At 8192^2 every factorization keeps the level kernel-eligible
+    # and nothing overflows: max slabs minimizes per-device DMA and
+    # the bands axis would only add all-reduce traffic.
+    plan = plan_mesh_shape(8, (8192, 8192), (8192, 8192), _cfg())
+    assert (plan.n_bands, plan.n_slabs) == (1, 8)
+    assert plan.chosen.feasible
+    assert len(plan.rejected) == 3
+
+
+def test_hbm_cap_forces_bands_on():
+    cfg = _cfg()
+    flat = plan_mesh_shape(8, (8192, 8192), (8192, 8192), cfg)
+    cap = flat.chosen.residency_bytes - 1
+    plan = plan_mesh_shape(
+        8, (8192, 8192), (8192, 8192), cfg, hbm_bytes=cap)
+    assert plan.n_bands > 1
+    assert plan.chosen.feasible
+    assert plan.chosen.residency_bytes <= cap
+    by_shape = {(c.n_bands, c.n_slabs): c for c in plan.rejected}
+    over = by_shape[(1, 8)]
+    assert not over.feasible
+    assert "HBM budget" in over.reason
+    assert over.residency_bytes > cap
+
+
+def test_hbm_cap_unsatisfiable_falls_back_to_min_residency():
+    plan = plan_mesh_shape(
+        8, (8192, 8192), (8192, 8192), _cfg(), hbm_bytes=1)
+    assert not plan.chosen.feasible
+    assert "HBM budget" in plan.chosen.reason
+    # Least-overflowing candidate, not an exception.
+    all_res = [plan.chosen.residency_bytes] + [
+        c.residency_bytes for c in plan.rejected if c.residency_bytes]
+    assert plan.chosen.residency_bytes == min(all_res)
+
+
+def test_band_ownership_infeasibility():
+    # 16 bands over a 161-row A with a coarse pair: the 2*n_bands
+    # grain pads ha to 192, giving 12 rows per band — bands 14..15
+    # own only pad rows.  The runner would refuse, so the planner
+    # must too.
+    plan = plan_mesh_shape(
+        16, (161, 512), (4096, 512), _cfg(levels=2))
+    by_shape = {(c.n_bands, c.n_slabs): c for c in plan.rejected}
+    by_shape[(plan.n_bands, plan.n_slabs)] = plan.chosen
+    col = by_shape[(16, 1)]
+    assert not col.feasible
+    assert "owns no real A row" in col.reason
+
+
+def test_single_device_degenerates():
+    plan = plan_mesh_shape(1, (64, 64), (64, 64), _cfg())
+    assert (plan.n_bands, plan.n_slabs) == (1, 1)
+    assert plan.rejected == ()
+
+
+def test_override_plan_records_source():
+    plan = override_plan(4, 2)
+    assert plan.source == "override"
+    assert (plan.n_bands, plan.n_slabs) == (4, 2)
+    attrs = plan.as_attrs()
+    assert attrs["mesh_shape"] == [4, 2]
+    assert attrs["source"] == "override"
+    assert attrs["rejected"] == []
+
+
+def test_as_attrs_payload_shape():
+    plan = plan_mesh_shape(8, (128, 128), (512, 128), _cfg())
+    attrs = plan.as_attrs()
+    assert attrs["mesh_shape"] == [2, 4]
+    assert attrs["source"] == "planner"
+    assert attrs["chosen"]["n_bands"] == 2
+    assert len(attrs["rejected"]) == 3
+    # Every rejected entry carries the full priced field so the
+    # flight dump shows what the chosen mesh beat.
+    for rej in attrs["rejected"]:
+        assert set(rej) == set(MeshCandidate.__dataclass_fields__)
+
+
+def test_planner_is_deterministic():
+    cfg = _cfg()
+    a = plan_mesh_shape(8, (512, 512), (2048, 512), cfg)
+    b = plan_mesh_shape(8, (512, 512), (2048, 512), cfg)
+    assert a == b

@@ -264,3 +264,53 @@ def test_sharded_a_band_assembly_matches_full(rng):
         _strip_noncompute(cfg), token, False, n_dev
     )(src, flt)
     np.testing.assert_array_equal(np.asarray(sharded0), full0)
+
+
+def test_band_assembly_2d_mesh_matches_full(rng):
+    """Regression (round-17 root cause, leg 1 of 3): on a 2-D
+    bands x slabs mesh the assembled table came back exactly n_slabs x
+    the true values — jax 0.4.x's SPMD partitioner materializes the
+    traced `_split_slabs` stacks (bands-sharded, slabs-REPLICATED) as
+    per-device dynamic-update-slice contributions summed by an
+    all-reduce over ALL devices, double-counting the slabs-replicated
+    contributions (`replica_groups={{0,1,2,3}}` in the compiled HLO).
+    `_band_assemble_fn` now splits eagerly, places with an explicit
+    sharding, and pins matching jit in_shardings; the result must be
+    BIT-IDENTICAL to the full single-device assembly and stay
+    row-sharded over bands / replicated over slabs."""
+    from image_analogies_tpu.models.analogy import (
+        _strip_noncompute,
+        assemble_features_lean,
+    )
+    from image_analogies_tpu.parallel.batch import _mesh_token
+    from image_analogies_tpu.parallel.sharded_a import _band_assemble_fn
+
+    n_bands, n_slabs = 2, 2
+    cfg = SynthConfig(levels=2, matcher="patchmatch")
+    src = rng.random((64, 48), np.float32)
+    flt = rng.random((64, 48), np.float32)
+    src_c = rng.random((32, 24), np.float32)
+    flt_c = rng.random((32, 24), np.float32)
+
+    full = np.asarray(assemble_features_lean(src, flt, cfg, src_c, flt_c))
+    mesh = make_mesh(
+        n_bands * n_slabs, axis_names=("bands", "slabs"),
+        shape=(n_bands, n_slabs),
+    )
+    token = _mesh_token(mesh)
+    sharded = _band_assemble_fn(
+        _strip_noncompute(cfg), token, True, n_bands
+    )(src, flt, src_c, flt_c)
+    # One addressable shard per device; each holds its band's rows
+    # (replicated across the slabs axis).
+    per_dev = [s.data.shape[0] for s in sharded.addressable_shards]
+    assert len(per_dev) == n_bands * n_slabs
+    assert all(r == full.shape[0] // n_bands for r in per_dev), per_dev
+    np.testing.assert_array_equal(np.asarray(sharded), full)
+
+    # Coarsest-level variant (no coarse pyramid).
+    full0 = np.asarray(assemble_features_lean(src, flt, cfg, None, None))
+    sharded0 = _band_assemble_fn(
+        _strip_noncompute(cfg), token, False, n_bands
+    )(src, flt)
+    np.testing.assert_array_equal(np.asarray(sharded0), full0)

@@ -356,12 +356,6 @@ def test_spatial_lean_checkpoint_roundtrip(rng, tmp_path):
     np.testing.assert_array_equal(resumed, full)
 
 
-@pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
-    reason="2-D bands x slabs needs the public jax.shard_map; the "
-    "0.4.x experimental fallback is numerically unreliable for the "
-    "2-D composition and the runner refuses it (parallel/spatial.py)",
-)
 def test_spatial_2d_bands_bit_identical_to_1d(rng):
     """2-D bands x slabs composition (round-4: the 'remaining step' of
     spatial.py / sharded_a.py): on a ("bands", "slabs") mesh the lean
@@ -415,12 +409,6 @@ def test_spatial_2d_bands_bit_identical_to_1d(rng):
         assert all(r == total // 2 for r in per_dev)
 
 
-@pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
-    reason="2-D bands x slabs needs the public jax.shard_map; the "
-    "0.4.x experimental fallback is numerically unreliable for the "
-    "2-D composition and the runner refuses it (parallel/spatial.py)",
-)
 def test_spatial_2d_kappa_same_accept_family(rng):
     """kappa>0 on the 2-D mesh: not bit-identical to 1-D (cross-band
     coherence bias is marginally weaker — sharded_a.py 'Equivalence'),
@@ -452,5 +440,125 @@ def test_spatial_2d_mesh_validation():
     bad = make_mesh(4, axis_names=("slabs", "bands"), shape=(2, 2))
     with _pytest.raises(ValueError, match="bands"):
         synthesize_spatial(a, a, b, SynthConfig(levels=1), bad)
+
+
+def test_reslab_2d_mesh_bit_identical(rng):
+    """Regression (round-17 root cause, leg 2 of 3): on a 2-D mesh the
+    GSPMD merge+split re-slab came back scaled n_bands^2 — jax 0.4.x's
+    SPMD partitioner materializes pad/concat of a slabs-sharded,
+    bands-REPLICATED array as per-device dynamic-update-slice
+    contributions summed by an all-reduce over ALL devices, double-
+    counting the replicated axis once per band (measured 4x on (2, 2),
+    16x on (4, 2)).  `_reslab_fn`'s 2-D branch therefore runs the halo
+    exchange manually (ppermute under shard_map); it must reproduce the
+    eager stitch+re-split bitwise, including edge-clamped outer halos,
+    with STALE input halos fully refreshed."""
+    import jax.numpy as jnp
+
+    from image_analogies_tpu.parallel.batch import _mesh_token
+    from image_analogies_tpu.parallel.spatial import _reslab_fn
+
+    halo = 4
+    for n_bands, n_slabs in ((2, 2), (4, 2)):
+        mesh = make_mesh(
+            n_bands * n_slabs, axis_names=("bands", "slabs"),
+            shape=(n_bands, n_slabs),
+        )
+        token = _mesh_token(mesh)
+        globals_ = [rng.random((64, 16)).astype(np.float32) for _ in range(3)]
+        stale = []
+        for x in globals_:
+            s = np.asarray(_split_slabs(jnp.asarray(x), n_slabs, halo)).copy()
+            s[:, :halo] = rng.random(s[:, :halo].shape)
+            s[:, -halo:] = rng.random(s[:, -halo:].shape)
+            stale.append(s)
+        outs = _reslab_fn(halo, n_slabs, 3, token, "slabs")(*stale)
+        for x, out in zip(globals_, outs):
+            expect = np.asarray(_split_slabs(jnp.asarray(x), n_slabs, halo))
+            np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+@pytest.mark.slow  # r17 budget rule: end-to-end 2-D chains are
+# minutes-class; tier-1 keeps the single-EM 2-D pin plus the unit-level
+# reslab/assembly regressions, which localize the same three bugs.
+def test_spatial_2d_em_chain_bit_identical_to_1d(rng):
+    """em_iters=2 on (2, 2): the between-EM re-slab runs on the 2-D
+    mesh.  This exact config diverged ~99.8% of pixels before the
+    manual-ppermute re-slab (the round-6 'fallback divergence' at full
+    strength); it must now be bit-identical to the 1-D runner."""
+    a = rng.random((128, 128)).astype(np.float32)
+    ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
+    b = np.concatenate([a, a[:, ::-1]], axis=0).astype(np.float32)
+    cfg = SynthConfig(
+        levels=1, matcher="patchmatch", pallas_mode="interpret",
+        em_iters=2, pm_iters=2, feature_bytes_budget=1,
+    )
+    ref = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(2)))
+    mesh = make_mesh(4, axis_names=("bands", "slabs"), shape=(2, 2))
+    out = np.asarray(synthesize_spatial(a, ap, b, cfg, mesh))
+    np.testing.assert_array_equal(out, ref)
+
+
+@pytest.mark.slow  # r17 budget rule (see above)
+def test_spatial_2d_mesh_2x4_bit_identical(rng):
+    """(2, 4) — the ISSUE's acceptance mesh — with a B tall enough that
+    all four slabs stay kernel-eligible (>= 128 core rows: a short B
+    would silently fall back to the standard path and the banding would
+    never run).  Bit-identical to the 1-D runner at 4 slabs."""
+    a = rng.random((128, 128)).astype(np.float32)
+    ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
+    b = np.concatenate([a, a[:, ::-1], a[::-1], a[::-1, ::-1]], axis=0)
+    b = np.concatenate([b, b], axis=0).astype(np.float32)  # 1024 rows
+    cfg = SynthConfig(
+        levels=1, matcher="patchmatch", pallas_mode="interpret",
+        em_iters=2, pm_iters=2, feature_bytes_budget=1,
+    )
+    ref = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(4)))
+    mesh = make_mesh(8, axis_names=("bands", "slabs"), shape=(2, 4))
+    out = np.asarray(synthesize_spatial(a, ap, b, cfg, mesh))
+    np.testing.assert_array_equal(out, ref)
+
+
+@pytest.mark.slow  # r17 budget rule (see above)
+def test_spatial_2d_uneven_a_rows_padded(rng):
+    """A with 130 rows on 4 bands (130 % 4 != 0): the runner edge-pads
+    A to the band grain instead of refusing (round-17 satellite); the
+    padded rows never win ownership (bounds are cropped to the real
+    ha), so the output is bit-identical to the 1-D runner on the
+    unpadded A."""
+    a = rng.random((130, 128)).astype(np.float32)
+    ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
+    b = rng.random((256, 128)).astype(np.float32)
+    cfg = SynthConfig(
+        levels=1, matcher="patchmatch", pallas_mode="interpret",
+        em_iters=2, pm_iters=2, feature_bytes_budget=1,
+    )
+    ref = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(2)))
+    mesh = make_mesh(8, axis_names=("bands", "slabs"), shape=(4, 2))
+    out = np.asarray(synthesize_spatial(a, ap, b, cfg, mesh))
+    np.testing.assert_array_equal(out, ref)
+
+
+@pytest.mark.slow  # r17 budget rule (see above)
+def test_spatial_2d_two_level_coarse_bit_identical(rng):
+    """Two-level pyramid on the (2, 2) mesh: the coarse level's B slabs
+    are too narrow for the kernel, so that level must route to the 1-D
+    slabs SUBMESH (regression leg 3 of 3: the standard-path GSPMD jits
+    hit the same replicated-axis double-count on the full 2-D mesh —
+    80%+ divergence), while the fine level runs banded with the coarse
+    A pyramid sharded alongside.  ha=258 additionally exercises the
+    coarse-grain pad (258 % (2*n_bands) != 0)."""
+    for ha in (256, 258):
+        a = rng.random((ha, 128)).astype(np.float32)
+        ap = np.clip(1.0 - a, 0, 1).astype(np.float32)
+        b = rng.random((512, 128)).astype(np.float32)
+        cfg = SynthConfig(
+            levels=2, matcher="patchmatch", pallas_mode="interpret",
+            em_iters=2, pm_iters=2, feature_bytes_budget=1,
+        )
+        ref = np.asarray(synthesize_spatial(a, ap, b, cfg, make_mesh(2)))
+        mesh = make_mesh(4, axis_names=("bands", "slabs"), shape=(2, 2))
+        out = np.asarray(synthesize_spatial(a, ap, b, cfg, mesh))
+        np.testing.assert_array_equal(out, ref)
 
 

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Validate a MESH2D_r17.json 2-D-mesh scale artifact (round 17).
+
+The 2-D scale story mixes measured rows (what the box could run) with
+modeled rows (the 8192^2/16384^2/32768^2 projections the box cannot).
+This validator is what keeps that mix honest:
+
+- **Measured rows** must carry a positive warm wall, the planner
+  verdict that chose their mesh, and `bit_identical_to_1d: true` —
+  the numerics contract the 2-D tests pin.  A measured row that lost
+  bit-identity is not a scale result, it is a miscompile report.
+- **Modeled rows** are RE-PRICED from their recorded inputs: the
+  planner is re-run on `model_inputs` (shapes, cfg knobs, HBM budget)
+  and every cell — mesh_shape, comms_bytes, dma_bytes,
+  residency_bytes, and the bandwidth-priced wall — must match what
+  the current models produce.  A hand-edited projection, or a model
+  change that silently re-prices committed cells, fails loudly here.
+- Rows for the headline scale sizes (8192 and 16384) must exist; a
+  modeled row may later be REPLACED by a measured one (real metal),
+  never merely reworded.
+
+Usage:
+    python tools/check_mesh2d.py MESH2D_r17.json
+
+Runs under pytest too (tests/test_mesh2d.py validates the COMMITTED
+artifact) so tier-1 fails if the record is missing, truncated, or
+structurally degraded.  Exit codes: 0 valid, 1 violations, 2
+unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+MESH2D_SCHEMA_VERSION = 1
+PROVENANCES = ("measured", "modeled")
+REQUIRED_SIZES = (8192, 16384)
+_WALL_REL_TOL = 1e-3
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _pos_int(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool) and v > 0
+
+
+def _check_modeled(i: int, row: dict, n_devices: int) -> List[str]:
+    """Re-price a modeled row from its recorded inputs."""
+    errs: List[str] = []
+    where = f"rows[{i}] (size {row.get('size')})"
+    mi = row.get("model_inputs")
+    bw = row.get("model_bandwidths")
+    if not isinstance(mi, dict) or not isinstance(bw, dict):
+        return [f"{where}: modeled row lacks model_inputs/"
+                "model_bandwidths — an unpriceable projection"]
+    if not isinstance(row.get("basis"), str) or not row["basis"]:
+        errs.append(f"{where}: modeled row lacks its basis statement")
+    hbm_bps, ici_bps = bw.get("hbm_Bps"), bw.get("ici_Bps")
+    if not (_num(hbm_bps) and hbm_bps > 0 and _num(ici_bps)
+            and ici_bps > 0):
+        return errs + [f"{where}: model_bandwidths not positive"]
+    if mi.get("n_devices") != n_devices:
+        errs.append(
+            f"{where}: model_inputs.n_devices {mi.get('n_devices')!r} "
+            f"!= artifact n_devices {n_devices}"
+        )
+    try:
+        from image_analogies_tpu import SynthConfig
+        from image_analogies_tpu.parallel.plan2d import plan_mesh_shape
+
+        cfg = SynthConfig(**mi["cfg"])
+        plan = plan_mesh_shape(
+            mi["n_devices"], tuple(mi["a_shape"]), tuple(mi["b_shape"]),
+            cfg, hbm_bytes=mi["hbm_bytes"],
+        )
+    except Exception as e:  # noqa: BLE001 — any re-price failure is a finding
+        return errs + [f"{where}: model_inputs do not re-price: {e}"]
+    c = plan.chosen
+    if row.get("mesh_shape") != [plan.n_bands, plan.n_slabs]:
+        errs.append(
+            f"{where}: recorded mesh_shape {row.get('mesh_shape')} != "
+            f"re-planned [{plan.n_bands}, {plan.n_slabs}]"
+        )
+    for field, want in (
+        ("comms_bytes", c.comms_bytes),
+        ("dma_bytes", c.dma_bytes),
+        ("residency_bytes", c.residency_bytes),
+    ):
+        if row.get(field) != want:
+            errs.append(
+                f"{where}: recorded {field} {row.get(field)!r} != "
+                f"re-priced {want} — the cell no longer matches the "
+                "model that claims to have produced it"
+            )
+    want_wall = c.dma_bytes / hbm_bps + c.comms_bytes / ici_bps
+    wall = row.get("wall_s")
+    if not _num(wall) or abs(wall - want_wall) > max(
+        _WALL_REL_TOL * want_wall, 1e-3
+    ):
+        errs.append(
+            f"{where}: modeled wall_s {wall!r} != re-priced "
+            f"{want_wall:.3f} at the stated bandwidths"
+        )
+    return errs
+
+
+def validate_mesh2d(record: dict) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if record.get("schema_version") != MESH2D_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {record.get('schema_version')!r} != "
+            f"{MESH2D_SCHEMA_VERSION}"
+        )
+    if not isinstance(record.get("comment"), str) or not record["comment"]:
+        errs.append("missing provenance comment")
+    n_devices = record.get("n_devices")
+    if not _pos_int(n_devices):
+        errs.append(f"n_devices {n_devices!r} not a positive int")
+        n_devices = 0
+    rows = record.get("rows")
+    if not isinstance(rows, list) or not rows:
+        return errs + ["rows missing or empty"]
+    last_size = 0
+    seen = set()
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            errs.append(f"rows[{i}] is not an object")
+            continue
+        size = row.get("size")
+        where = f"rows[{i}] (size {size})"
+        if not _pos_int(size):
+            errs.append(f"rows[{i}] size {size!r} not a positive int")
+            continue
+        seen.add(size)
+        if size <= last_size:
+            errs.append(f"{where}: size not strictly increasing")
+        last_size = size
+        prov = row.get("provenance")
+        if prov not in PROVENANCES:
+            errs.append(
+                f"{where}: provenance {prov!r} names none of "
+                f"{PROVENANCES}"
+            )
+            continue
+        ms = row.get("mesh_shape")
+        if (
+            not isinstance(ms, list) or len(ms) != 2
+            or not all(_pos_int(v) for v in ms)
+            or (n_devices and ms[0] * ms[1] != n_devices)
+        ):
+            errs.append(
+                f"{where}: mesh_shape {ms!r} is not a (bands, slabs) "
+                f"factorization of {n_devices} devices"
+            )
+        plan = row.get("plan")
+        if not isinstance(plan, dict) or "chosen" not in plan or \
+                "source" not in plan:
+            errs.append(
+                f"{where}: planner verdict (plan.chosen/plan.source) "
+                "not recorded — the decision is unauditable"
+            )
+        if prov == "measured":
+            if not (_num(row.get("wall_s")) and row["wall_s"] > 0):
+                errs.append(
+                    f"{where}: measured wall_s {row.get('wall_s')!r} "
+                    "not positive"
+                )
+            if row.get("bit_identical_to_1d") is not True:
+                errs.append(
+                    f"{where}: measured row without "
+                    "bit_identical_to_1d: true — a 2-D run that "
+                    "diverged from the 1-D runner is a miscompile "
+                    "report, not a scale result"
+                )
+            if "model_inputs" in row or "basis" in row:
+                errs.append(
+                    f"{where}: measured row carries modeled-row "
+                    "fields — provenance is ambiguous"
+                )
+        else:
+            errs.extend(_check_modeled(i, row, n_devices))
+    for size in REQUIRED_SIZES:
+        if size not in seen:
+            errs.append(
+                f"no row for the headline scale size {size} — the "
+                "un-cap claim has no cell backing it"
+            )
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifact", help="MESH2D_r*.json path")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.artifact) as f:
+            record = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"check_mesh2d: cannot read {args.artifact}: {e}",
+              file=sys.stderr)
+        return 2
+    errs = validate_mesh2d(record)
+    if errs:
+        for e in errs:
+            print(f"check_mesh2d: {e}", file=sys.stderr)
+        print(f"check_mesh2d: FAIL — {len(errs)} violation(s)",
+              file=sys.stderr)
+        return 1
+    rows = record["rows"]
+    n_meas = sum(1 for r in rows if r.get("provenance") == "measured")
+    print(
+        f"check_mesh2d: OK — {len(rows)} rows ({n_meas} measured, "
+        f"{len(rows) - n_meas} modeled re-priced) on "
+        f"{record['n_devices']} devices"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,7 @@ _SCALE_RE = re.compile(r"^SCALE_r(\d+)\.json$")
 _VIDEO_RE = re.compile(r"^VIDEO_r(\d+)\.json$")
 _SLO_RE = re.compile(r"^SLO_r(\d+)\.json$")
 _CHAOS_SERVE_RE = re.compile(r"^CHAOS_SERVE_r(\d+)\.json$")
+_MESH2D_RE = re.compile(r"^MESH2D_r(\d+)\.json$")
 
 PROVENANCES = ("measured", "carried", "modeled")
 
@@ -146,6 +147,22 @@ CHAOS_SERVE_SERIES: Tuple[Dict, ...] = (
     {"field": "replay_bit_identical", "direction": "higher",
      "abs_tol": 0.0, "floor": 1.0, "since": 16,
      "label": "takeover replay bit-identity (1.0 = every replay)"},
+)
+
+# MESH2D artifacts (round 17: tools/scale_bench.py --mesh2d) carry
+# size-keyed rows like SCALE.  The wall series is held LOOSELY
+# (rel_tol 1.0): committed rows so far are interpret-mode CPU proxies
+# on shared machines, so only a multiple-of-itself slowdown signals.
+# The modeled 8192^2/16384^2/32768^2 projections ride in the same rows
+# under `provenance: "modeled"` — the standard discipline makes them
+# inert here (listed, never a bar), and tools/check_mesh2d.py
+# separately re-prices each from its recorded inputs.
+MESH2D_SERIES: Tuple[Dict, ...] = (
+    {"field": "wall_s", "direction": "lower", "rel_tol": 1.0,
+     "since": 17, "label": "2-D mesh warm wall (s; CPU proxy so far)"},
+    {"field": "wall_1d_same_slabs_s", "direction": "lower",
+     "rel_tol": 1.0, "since": 17,
+     "label": "1-D same-slab-count reference wall (s)"},
 )
 
 # SCALE rows are keyed by size; each series is tracked per size.
@@ -245,8 +262,8 @@ def _flatten_video(rec):
 
 
 def load_history(root: str):
-    """(bench, scale, video, slo, chaos_serve) lists of (round,
-    filename, payload), round-sorted.  BENCH payloads unwrap the driver's capture wrapper
+    """(bench, scale, video, slo, chaos_serve, mesh2d) lists of
+    (round, filename, payload), round-sorted.  BENCH payloads unwrap the driver's capture wrapper
     to the parsed record.  Builder probe files (BENCH_r*_builder*.json)
     do not match the round pattern and are deliberately out of scope —
     they are CPU-built field-builder exercises, not round records.
@@ -254,7 +271,9 @@ def load_history(root: str):
     modeled (`_mark_compressed_cells`); VIDEO payloads stay raw here
     (schema validation needs the nested record) and are flattened at
     the series check."""
-    bench, scale, video, slo, chaos_serve = [], [], [], [], []
+    bench, scale, video, slo, chaos_serve, mesh2d = (
+        [], [], [], [], [], []
+    )
     for name in sorted(os.listdir(root)):
         m = _BENCH_RE.match(name)
         if m:
@@ -285,12 +304,17 @@ def load_history(root: str):
         if m:
             with open(os.path.join(root, name)) as f:
                 chaos_serve.append((int(m.group(1)), name, json.load(f)))
+        m = _MESH2D_RE.match(name)
+        if m:
+            with open(os.path.join(root, name)) as f:
+                mesh2d.append((int(m.group(1)), name, json.load(f)))
     bench.sort(key=lambda t: t[0])
     scale.sort(key=lambda t: t[0])
     video.sort(key=lambda t: t[0])
     slo.sort(key=lambda t: t[0])
     chaos_serve.sort(key=lambda t: t[0])
-    return bench, scale, video, slo, chaos_serve
+    mesh2d.sort(key=lambda t: t[0])
+    return bench, scale, video, slo, chaos_serve, mesh2d
 
 
 # ------------------------------------------------------ schema (by era)
@@ -521,7 +545,7 @@ def check_series(
 def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
     """All schema + trajectory checks over the committed history.
     Returns (violations, machine-readable report rows)."""
-    bench, scale, video, slo, chaos_serve = load_history(root)
+    bench, scale, video, slo, chaos_serve, mesh2d = load_history(root)
     errs: List[str] = []
     report: List[Dict] = []
 
@@ -548,6 +572,12 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
         from check_chaos_serve import validate_chaos_serve
 
         errs.extend(f"{name}: {e}" for e in validate_chaos_serve(rec))
+    for rnd, name, rec in mesh2d:
+        # 2-D mesh artifacts carry their full contract — including the
+        # modeled-row re-pricing — in check_mesh2d.
+        from check_mesh2d import validate_mesh2d
+
+        errs.extend(f"{name}: {e}" for e in validate_mesh2d(rec))
 
     for decl in BENCH_SERIES:
         check_series(
@@ -591,6 +621,24 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
             ]
             check_series(
                 decl, cells, f"scale.{size}.{decl['field']}", errs,
+                report,
+            )
+    mesh2d_sizes = sorted({
+        row.get("size")
+        for _, _, data in mesh2d
+        for row in _rows(data)
+        if _num(row.get("size"))
+    })
+    for decl in MESH2D_SERIES:
+        for size in mesh2d_sizes:
+            cells = [
+                (r, n, row)
+                for r, n, data in mesh2d
+                for row in _rows(data)
+                if row.get("size") == size
+            ]
+            check_series(
+                decl, cells, f"mesh2d.{size}.{decl['field']}", errs,
                 report,
             )
     return errs, report

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Hardware A/B recipe for the 2-D bands x slabs mesh (round 17), the
+way tools/layout_ab.py recorded the layout decision: both arms under
+one harness, and the kill criterion stated BEFORE the run.
+
+Kill criterion (pre-stated, WALL-ONLY): at every probed size where the
+planner chooses n_bands > 1 while the flat 1-D mesh still fits the
+per-chip HBM budget, the 2-D warm wall must stay within 1.10x the 1-D
+warm wall (min of --runs warm runs each).  If any such size breaks
+that bound, the verdict is KILL: the planner must then choose bands
+ONLY under HBM pressure (pass hbm_bytes and nothing else — the
+residency constraint still un-caps A, but bands stop competing on
+modeled bytes).  Quality is OUT of the criterion by construction:
+kappa=0 bit-identity between the 2-D and 1-D runners is test-pinned
+(tests/test_spatial.py), and this script re-checks it as a harness
+sanity gate, not as a trade axis — a bit divergence aborts the A/B as
+invalid rather than entering the verdict.
+
+Sizes where the 1-D mesh does NOT fit HBM have no A arm to lose to:
+they report the 2-D wall alone (that is the un-cap, not a race).
+
+Run on the TPU box:
+    python tools/mesh2d_ab.py --sizes 4096 8192 [--runs 3] \
+        [--hbm-gib 16] [--out MESH2D_AB.json]
+
+On CPU (no accelerator) the walls are interpret-mode proxies; the
+artifact records platform so nobody mistakes them for chip numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+KILL_WALL_RATIO = 1.10
+
+
+def _ab_one(size: int, runs: int, hbm_bytes: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from image_analogies_tpu import SynthConfig
+    from image_analogies_tpu.parallel.mesh import make_mesh
+    from image_analogies_tpu.parallel.plan2d import plan_mesh_shape
+    from image_analogies_tpu.parallel.spatial import synthesize_spatial
+    from image_analogies_tpu.utils.examples import super_resolution
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    kw = dict(levels=1, matcher="patchmatch", em_iters=2, pm_iters=2)
+    if platform == "cpu":
+        kw["pallas_mode"] = "interpret"
+    cfg = SynthConfig(**kw)
+    a, ap, b = super_resolution(size)
+    a, ap, b = (jnp.asarray(x, jnp.float32) for x in (a, ap, b))
+
+    plan = plan_mesh_shape(
+        n_dev, a.shape[:2], b.shape[:2], cfg, hbm_bytes=hbm_bytes
+    )
+    flat = plan_mesh_shape(n_dev, a.shape[:2], b.shape[:2], cfg)
+    flat_fits = any(
+        c.n_bands == 1 and c.feasible and (
+            hbm_bytes is None or c.residency_bytes <= hbm_bytes
+        )
+        for c in (flat.chosen, *flat.rejected)
+    )
+
+    def timed(mesh, mp):
+        out = synthesize_spatial(a, ap, b, cfg, mesh, mesh_plan=mp)
+        jax.block_until_ready(out)          # compile run
+        walls = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            out = synthesize_spatial(a, ap, b, cfg, mesh, mesh_plan=mp)
+            jax.block_until_ready(out)
+            walls.append(round(time.perf_counter() - t0, 3))
+        return np.asarray(out), walls
+
+    mesh2d = make_mesh(
+        n_dev, axis_names=("bands", "slabs"),
+        shape=(plan.n_bands, plan.n_slabs),
+    )
+    out_2d, walls_2d = timed(mesh2d, plan.as_attrs())
+    row = {
+        "size": size,
+        "mesh_shape": [plan.n_bands, plan.n_slabs],
+        "wall_2d_s": min(walls_2d),
+        "wall_2d_runs_s": walls_2d,
+        "flat_fits_hbm": flat_fits,
+        "banded": plan.n_bands > 1,
+    }
+    if not flat_fits:
+        row["verdict"] = "uncapped"     # nothing to race: 1-D cannot run
+        return row
+    out_1d, walls_1d = timed(make_mesh(n_dev), None)
+    row["wall_1d_s"] = min(walls_1d)
+    row["wall_1d_runs_s"] = walls_1d
+    # Harness sanity gate, NOT a trade axis (see module docstring).
+    # 1-D at n_dev slabs only matches bit-for-bit when both arms run
+    # the same slab count; with bands > 1 the arms differ in slab
+    # count, so the gate compares against 1-D at plan.n_slabs.
+    ref, _ = timed(make_mesh(plan.n_slabs), None)
+    if not np.array_equal(out_2d, ref):
+        raise SystemExit(
+            f"mesh2d_ab: size {size}: 2-D output diverged from the "
+            "1-D runner at the same slab count — A/B invalid, fix the "
+            "miscompile before measuring anything"
+        )
+    ratio = row["wall_2d_s"] / max(row["wall_1d_s"], 1e-9)
+    row["wall_ratio_2d_over_1d"] = round(ratio, 3)
+    if plan.n_bands > 1:
+        row["verdict"] = (
+            "keep" if ratio <= KILL_WALL_RATIO else "KILL"
+        )
+    else:
+        row["verdict"] = "no-contest"   # planner chose flat anyway
+    return row
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sizes", type=int, nargs="+", required=True)
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument(
+        "--hbm-gib", type=float, default=16.0,
+        help="per-chip HBM budget the planner is held to (v5e: 16)",
+    )
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    import jax
+
+    hbm = int(args.hbm_gib * (1 << 30))
+    rows = [_ab_one(s, args.runs, hbm) for s in sorted(args.sizes)]
+    verdicts = [r.get("verdict") for r in rows]
+    record = {
+        "kill_criterion": (
+            f"wall-only: 2-D wall <= {KILL_WALL_RATIO}x 1-D wall at "
+            "every size where bands engaged while flat still fit "
+            f"{args.hbm_gib} GiB HBM; quality excluded by the "
+            "test-pinned kappa=0 bit-identity (sanity-gated here)"
+        ),
+        "platform": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+        "rows": rows,
+        "verdict": "KILL" if "KILL" in verdicts else "keep",
+    }
+    text = json.dumps(record, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    print(text, flush=True)
+    return 1 if record["verdict"] == "KILL" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
