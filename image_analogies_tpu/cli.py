@@ -832,6 +832,8 @@ def cmd_serve(args) -> int:
             state_dir=state_dir,
             drain_deadline_s=args.drain_deadline_s,
             dispatch_deadline_s=args.dispatch_deadline_s,
+            pipeline_window=args.pipeline_window,
+            warmup_workers=args.warmup_workers,
         )
         try:
             daemon.start()
@@ -1215,6 +1217,19 @@ def main(argv=None) -> int:
         "dispatcher's abort token fires and the wedged attempt "
         "unwinds as a failed (500) batch instead of freezing the "
         "daemon (default: unbounded)",
+    )
+    p.add_argument(
+        "--pipeline-window", type=int, default=2, metavar="N",
+        help="pipelined-dispatch in-flight window (round 18): up to N "
+        "dispatched batches may be unsettled at once, so host-side "
+        "demux/response work of batch t overlaps device execution of "
+        "batch t+1.  1 = the serial round-13 loop (default 2)",
+    )
+    p.add_argument(
+        "--warmup-workers", type=int, default=4, metavar="N",
+        help="threads compiling distinct warmup shapes concurrently "
+        "before the endpoint announces (round 18; default 4, 1 = "
+        "sequential)",
     )
     _add_synth_flags(p)
     p.set_defaults(fn=cmd_serve)

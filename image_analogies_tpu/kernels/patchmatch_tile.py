@@ -261,6 +261,12 @@ def clear_compiled_level_caches() -> None:
     _vid = sys.modules.get("image_analogies_tpu.video.sequence")
     if _vid is not None:
         _vid._video_level_fn_cached.cache_clear()
+    # Round 18: the serving tier's persist hook holds its own table of
+    # loaded/AOT-compiled executables — an epoch eviction must demote
+    # those too (same honesty rule as the lru caches) while leaving
+    # the DISK tier intact, so a demoted key's next use restores from
+    # disk instead of recompiling.
+    _pb.clear_persist_loaded()
 
 
 def set_packed_layout(layout: str) -> None:

@@ -110,12 +110,78 @@ def _lean_step_fn_cached(
     )
 
 
+# Round 18: the serving tier's persistent executable cache interposes
+# here.  When a hook is installed (`set_persist_hook` — the daemon's
+# serving/excache.DiskExecCache), the prologue/level jit factories
+# return a thin wrapper that consults the hook AT CALL TIME: the hook
+# either runs a restored (deserialized) executable, or AOT-compiles
+# the jit function itself (lower().compile()) so the cold path's one
+# compile produces a serializable artifact — `jax.jit`'s internal
+# executable cache is NOT reused by AOT lowering, so the hook must own
+# compilation or the cold path would compile twice.  With no hook
+# installed the factories return the plain jit functions: non-serving
+# paths are bit-and-perf unchanged.  The hook key is (role, ident) —
+# ident is the SAME stripped-config tuple the lru caches key on, so
+# the persisted identity can never split or alias entries the in-
+# process caches share.
+_PERSIST_HOOK = None
+
+
+def set_persist_hook(hook) -> None:
+    """Install (or clear, with None) the process-wide executable
+    persist hook.  Caller contract: the hook's `call(role, ident,
+    jit_fn, args)` must return exactly `jit_fn(*args)`'s value and
+    must fall back to `jit_fn` on any persistence failure — the hook
+    is a cache, never a semantic layer."""
+    global _PERSIST_HOOK
+    _PERSIST_HOOK = hook
+
+
+def get_persist_hook():
+    return _PERSIST_HOOK
+
+
+def clear_persist_loaded() -> None:
+    """Epoch-eviction funnel (kernels.patchmatch_tile
+    .clear_compiled_level_caches): drop the hook's in-memory loaded-
+    executable table alongside the jit lru caches, leaving the DISK
+    tier intact — a demoted key's next use either restores from disk
+    or recompiles, both honest."""
+    hook = _PERSIST_HOOK
+    if hook is not None:
+        hook.clear_loaded()
+
+
+class _PersistWrap:
+    """Callable facade over one jit function: routes through the
+    persist hook when one is installed at call time (the hook can be
+    installed/removed between factory call and invocation — daemons
+    start after import), else calls the jit function directly."""
+
+    __slots__ = ("role", "ident", "jit_fn")
+
+    def __init__(self, role, ident, jit_fn):
+        self.role = role
+        self.ident = ident
+        self.jit_fn = jit_fn
+
+    def __call__(self, *args):
+        hook = _PERSIST_HOOK
+        if hook is None:
+            return self.jit_fn(*args)
+        return hook.call(self.role, self.ident, self.jit_fn, args)
+
+
 def _batch_prologue_fn(cfg: SynthConfig, levels: int, mesh_key):
     from ..models.analogy import _strip_noncompute
 
-    return _batch_prologue_fn_cached(
-        _strip_noncompute(cfg), levels, mesh_key
-    )
+    cfg_s = _strip_noncompute(cfg)
+    fn = _batch_prologue_fn_cached(cfg_s, levels, mesh_key)
+    if _PERSIST_HOOK is not None:
+        return _PersistWrap(
+            "batch_prologue", (cfg_s, levels, mesh_key), fn
+        )
+    return fn
 
 
 @functools.lru_cache(maxsize=32)
@@ -169,10 +235,20 @@ def _batch_level_fn(cfg: SynthConfig, level: int, has_coarse: bool,
                     fuse: bool = True):
     from ..models.analogy import _strip_noncompute
 
-    return _batch_level_fn_cached(
-        _strip_noncompute(cfg), level, has_coarse, mesh_key, fa_external,
+    cfg_s = _strip_noncompute(cfg)
+    fn = _batch_level_fn_cached(
+        cfg_s, level, has_coarse, mesh_key, fa_external,
         lean, prev_kind, fuse,
     )
+    # fuse=False returns an EAGER function (no .lower) — never wrapped.
+    if fuse and _PERSIST_HOOK is not None:
+        return _PersistWrap(
+            "batch_level",
+            (cfg_s, level, has_coarse, mesh_key, fa_external, lean,
+             prev_kind, fuse),
+            fn,
+        )
+    return fn
 
 
 @functools.lru_cache(maxsize=64)

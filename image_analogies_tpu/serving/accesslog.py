@@ -4,8 +4,11 @@ request-scoped tracing in serving/daemon.py).
 One JSONL line per finished request — every outcome, including the
 ones that never reached the queue (400 rejected) or never left it
 (429 shed, 504 timeout) — carrying the request id, session, executable
-key + cache verdict, the phase attribution (queue/compile/execute/
-demux milliseconds), and byte counts.  This is the flat, grep-able
+key + cache verdict (`hit` | `disk` | `miss`: `disk` marks a dispatch
+served by a DESERIALIZED executable from the persistent tier, booked
+as `restore_ms` rather than `compile_ms` in the phase attribution),
+the phase attribution (queue/compile/restore/execute/demux
+milliseconds), and byte counts.  This is the flat, grep-able
 counterpart to the per-request span tree: the span tree answers "what
 happened inside THIS request", the access log answers "which requests
 should I look at".
@@ -126,7 +129,8 @@ def phase_fields(rec: Dict[str, Any]) -> List[tuple]:
     order — shared by the trace CLI and tools/serve_load.py so the
     committed critical path and the printed waterfall agree."""
     out = []
-    for phase in ("queue_ms", "compile_ms", "execute_ms", "demux_ms"):
+    for phase in ("queue_ms", "compile_ms", "restore_ms",
+                  "execute_ms", "demux_ms"):
         v = rec.get(phase)
         if isinstance(v, (int, float)):
             out.append((phase[:-3], float(v)))

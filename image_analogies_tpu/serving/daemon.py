@@ -89,6 +89,25 @@ observed warm shapes (`warmup.observed.json`) and every resident
 session's carried NNF state for the successor.  A `daemon.lock` file
 naming the holder pid makes double-takeover a refused startup, not a
 split-brain journal.
+
+Persistent executables + pipelined dispatch (round 18): a state dir
+also carries `excache/` — the DISK tier of the executable cache
+(serving/excache.DiskExecCache).  The daemon installs the tier as the
+engine's process-wide persist hook at start, restores every sealed
+executable set from disk BEFORE the port is announced, and on an
+in-memory cache miss probes the disk tier for an admission-visible
+third verdict: `disk` (span `disk-restored`) — the request runs a
+deserialized executable with no jit trace, which is what makes a
+restart's first request ~restore-priced instead of compile-priced.
+Separately, the dispatcher is split into dispatch and completion
+stages over a bounded in-flight window (`pipeline_window`, default 2):
+the dispatcher thread pops, admits, and executes batch t+1 while the
+completer thread demuxes/settles batch t — host-side response work
+overlaps device execution.  Admission control, drain, the journal,
+and the gauges all read the lock-guarded in-flight count, so every
+round-16 ledger claim holds with the window open; responses stay
+bit-identical to solo dispatch because the split moves WHERE settle
+runs, never what the engine computes.
 """
 
 from __future__ import annotations
@@ -96,6 +115,7 @@ from __future__ import annotations
 import base64
 import json
 import os
+import queue as stdqueue
 import re
 import shutil
 import tempfile
@@ -166,7 +186,13 @@ def _phase_attribution(req: ServeRequest,
       queue_ms   = enqueue -> admitted
       compile_ms = the dispatch's prologue wall (0 when none carried),
                    clamped into the execution window
-      execute_ms = cache-verdict -> executed, minus compile_ms
+      restore_ms = the same prologue wall when the cache verdict was
+                   `disk` — a deserialize-and-run, NOT a jit compile;
+                   booked under its own name (round-18 bugfix) so the
+                   SLO histograms and the trace waterfall never blend
+                   the restore population into the compile population
+                   (compile_ms is 0 on a disk-restored dispatch)
+      execute_ms = cache-verdict -> executed, minus compile/restore
       demux_ms   = executed -> the response (demux + settle + handler
                    wakeup — everything after the engine returned)
     The parts deliberately sum to total_ms minus only the sub-ms
@@ -176,12 +202,18 @@ def _phase_attribution(req: ServeRequest,
     out: Dict[str, float] = {}
     if "admitted" in t:
         out["queue_ms"] = round(t["admitted"], 3)
-    verdict = t.get("cache-hit", t.get("compiled"))
+    verdict = t.get(
+        "cache-hit", t.get("disk-restored", t.get("compiled"))
+    )
     executed = t.get("executed")
     if executed is not None and verdict is not None:
         window = max(0.0, executed - verdict)
         c = min(float(req.compile_ms or 0.0), window)
-        out["compile_ms"] = round(c, 3)
+        if "disk-restored" in t:
+            out["compile_ms"] = 0.0
+            out["restore_ms"] = round(c, 3)
+        else:
+            out["compile_ms"] = round(c, 3)
         out["execute_ms"] = round(window - c, 3)
         out["demux_ms"] = round(max(0.0, total_ms - executed), 3)
     return out
@@ -241,6 +273,8 @@ class SynthDaemon:
         state_dir: Optional[str] = None,
         drain_deadline_s: float = 30.0,
         dispatch_deadline_s: Optional[float] = None,
+        pipeline_window: int = 2,
+        warmup_workers: int = 4,
     ):
         from ..parallel.batch import make_mesh
         from ..telemetry.slo import SloEngine
@@ -278,9 +312,36 @@ class SynthDaemon:
         self.live = None  # LiveTelemetryServer after start()
         self._work_dir = work_dir
         self._own_work_dir = work_dir is None
+        # True in-flight REQUEST count, summed across every dispatched-
+        # but-unsettled batch (the pipelined dispatcher can hold up to
+        # `pipeline_window` of them): admission control, drain, and the
+        # inflight gauge all read it, so their round-16 claims survive
+        # the window opening past 1.  Lock-guarded because admit runs
+        # on the dispatcher thread and settle on the completer.
         self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._stop = threading.Event()
         self._dispatcher: Optional[threading.Thread] = None
+        # Round 18 pipelined dispatch: the dispatcher acquires one
+        # window slot per batch, runs the engine, and hands the batch's
+        # settle closure (demux + counters + done) to the completer
+        # thread, which releases the slot — so host-side response work
+        # of batch t overlaps device execution of batch t+1, bounded
+        # at `pipeline_window` unsettled batches.  Window 1 degrades
+        # to the round-13 serial loop (settle still runs on the
+        # completer, but the single slot serializes dispatches).
+        if pipeline_window < 1:
+            raise ValueError(
+                f"pipeline_window must be >= 1 ({pipeline_window})"
+            )
+        self.pipeline_window = int(pipeline_window)
+        self.warmup_workers = int(warmup_workers)
+        self._window = threading.BoundedSemaphore(self.pipeline_window)
+        self._settle_q: "stdqueue.Queue" = stdqueue.Queue()
+        self._completer: Optional[threading.Thread] = None
+        self._pipeline_busy = 0
+        # Round 18 disk tier (DiskExecCache when state_dir is set).
+        self.disk = None
         # Round 15 observability: per-request span trees + run-subtree
         # tracer + structured access log, all gated on ONE switch so
         # the overhead-pin harness can run a bit-identical bare arm.
@@ -357,7 +418,13 @@ class SynthDaemon:
         )
         self._g_inflight = r.gauge(
             "ia_serve_inflight",
-            "requests inside the currently-executing dispatch",
+            "requests inside dispatched-but-unsettled batches (summed "
+            "across the pipeline window)",
+        )
+        self._g_pipeline = r.gauge(
+            "ia_serve_pipeline_inflight_batches",
+            "dispatched-but-unsettled batches (pipelined-dispatch "
+            "window occupancy; bounded by pipeline_window)",
         )
         self._h_latency = r.histogram(
             "ia_serve_request_ms",
@@ -382,6 +449,7 @@ class SynthDaemon:
         )
         self._g_depth.set(0)
         self._g_inflight.set(0)
+        self._g_pipeline.set(0)
 
     # ------------------------------------------------------ lifecycle
     def start(self) -> "SynthDaemon":
@@ -401,6 +469,29 @@ class SynthDaemon:
             self.journal = RequestJournal(
                 journal_path(self.state_dir), registry=self.registry
             )
+            # Disk executable tier: restore the persisted warm set
+            # BEFORE the dispatcher exists (and hence before cmd_serve
+            # can announce the endpoint) — rendezvous implies the
+            # sealed executables are already resident — then install
+            # the tier as the engine's process-wide persist hook so
+            # this daemon's dispatches read/write it.
+            from ..parallel import batch as _pbatch
+
+            from .excache import DiskExecCache
+
+            self.disk = DiskExecCache(
+                os.path.join(self.state_dir, "excache"),
+                registry=self.registry,
+            )
+            restored = self.disk.restore_warm_set()
+            if restored:
+                import logging
+
+                logging.getLogger("image_analogies_tpu").info(
+                    "disk excache: restored %d executable set(s) "
+                    "in %.1f ms", len(restored), self.disk.restore_ms,
+                )
+            _pbatch.set_persist_hook(self.disk)
         if self.observability:
             self.access = AccessLog(
                 self._access_log_path
@@ -421,6 +512,11 @@ class SynthDaemon:
                 ("POST", "/drain"): self._route_drain,
             },
         ).start()
+        self._completer = threading.Thread(
+            target=self._completer_loop, name="ia-serve-complete",
+            daemon=True,
+        )
+        self._completer.start()
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="ia-serve-dispatch",
             daemon=True,
@@ -450,6 +546,22 @@ class SynthDaemon:
         if self._dispatcher is not None:
             self._dispatcher.join(timeout=30.0)
             self._dispatcher = None
+        if self._completer is not None:
+            # Sentinel AFTER the dispatcher joined: every settle
+            # closure it enqueued is already in the queue, so FIFO
+            # order settles them all before the completer exits.
+            self._settle_q.put(None)
+            self._completer.join(timeout=30.0)
+            self._completer = None
+        if self.disk is not None:
+            # Uninstall only OUR hook: a successor daemon (takeover
+            # chaos overlaps lifetimes briefly) may have already
+            # installed its own tier.
+            from ..parallel import batch as _pbatch
+
+            if _pbatch.get_persist_hook() is self.disk:
+                _pbatch.set_persist_hook(None)
+            self.disk.release_jax_cache()
         if self.live is not None:
             self.live.stop()
             self.live = None
@@ -476,7 +588,12 @@ class SynthDaemon:
         authored manifest is merged with the predecessor's RUNTIME-
         OBSERVED shapes (warmup.observed.json) — the fix for manifest
         drift, where the shapes clients actually send stopped matching
-        the shapes the manifest author guessed."""
+        the shapes the manifest author guessed — plus the disk tier's
+        sealed shapes, so a restart re-warms its persisted working set
+        (cheap: those dispatches restore, they don't compile).  Round
+        18: distinct shapes warm concurrently on `warmup_workers`
+        threads, with per-shape compile walls on the warmup span tree
+        (run_warmup's docstring)."""
         if self.state_dir is not None:
             from .excache import (
                 load_observed_warmup,
@@ -486,6 +603,8 @@ class SynthDaemon:
             entries = merge_warmup_entries(
                 entries,
                 load_observed_warmup(self.observed_warmup_path),
+                self.disk.warmup_shapes() if self.disk is not None
+                else [],
             )
 
         def dispatch(shape):
@@ -501,6 +620,8 @@ class SynthDaemon:
         return run_warmup(
             entries, dispatch, self.cache,
             lambda shape: exec_key(shape, self.cfg, self.policy.max_batch),
+            max_workers=self.warmup_workers,
+            tracer=self.tracer if self.observability else None,
         )
 
     # ------------------------------------------------------- serving
@@ -764,7 +885,13 @@ class SynthDaemon:
                 "max_queue_depth": self.admission.max_depth,
                 "effective_queue_depth": self.admission.effective_depth(),
             },
+            "pipeline": {
+                "window": self.pipeline_window,
+                "inflight_batches": self._pipeline_busy,
+            },
             "cache": self.cache.snapshot(),
+            "disk_cache": (self.disk.snapshot()
+                           if self.disk is not None else None),
             "sessions": {
                 "active": len(self._sessions),
                 "max": self.max_sessions,
@@ -1018,6 +1145,41 @@ class SynthDaemon:
         )
 
     # ---------------------------------------------------- dispatcher
+    def _note_pipeline(self, delta: int) -> None:
+        with self._inflight_lock:
+            self._pipeline_busy = max(0, self._pipeline_busy + delta)
+            self._g_pipeline.set(self._pipeline_busy)
+
+    def _completer_loop(self) -> None:
+        """Completion stage of the pipelined dispatcher: run each
+        batch's settle closure (demux -> counters -> done events) and
+        only then release its window slot.  A settle that dies still
+        releases the slot and fails its undone requests — a wedged
+        completer must degrade to failed requests, never to a daemon
+        whose window never reopens."""
+        while True:
+            item = self._settle_q.get()
+            if item is None:
+                return
+            settle, batch = item
+            try:
+                settle()
+            except BaseException as e:  # noqa: BLE001 - daemon survives
+                import logging
+
+                logging.getLogger("image_analogies_tpu").exception(
+                    "serving settle error"
+                )
+                for req in batch:
+                    if not req.done.is_set():
+                        req.status = "failed"
+                        req.error = f"{type(e).__name__}: {e}"
+                        self._c_failed.inc()
+                        req.done.set()
+            finally:
+                self._note_pipeline(-1)
+                self._window.release()
+
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
             batch = self.queue.next_batch(self.policy, timeout=0.25)
@@ -1027,8 +1189,24 @@ class SynthDaemon:
             batch = self._filter_batch(batch)
             if not batch:
                 continue
+            # One window slot per dispatched batch; timed re-checks so
+            # stop() can't be wedged behind a full window.
+            acquired = False
+            while not self._stop.is_set():
+                if self._window.acquire(timeout=0.25):
+                    acquired = True
+                    break
+            if not acquired:
+                for req in batch:
+                    req.status = "failed"
+                    req.error = "daemon shutting down"
+                    self._c_failed.inc()
+                    req.done.set()
+                continue
+            self._note_pipeline(+1)
+            deferred: List[Any] = []
             try:
-                self._dispatch_guarded(batch)
+                self._dispatch_guarded(batch, defer=deferred.append)
             except BaseException as e:  # noqa: BLE001 - daemon survives
                 import logging
 
@@ -1041,8 +1219,21 @@ class SynthDaemon:
                         req.error = f"{type(e).__name__}: {e}"
                         self._c_failed.inc()
                         req.done.set()
+            finally:
+                if deferred:
+                    # Engine work is done; settle (demux + response
+                    # fields + done) happens on the completer while
+                    # this thread pops the next batch.
+                    self._settle_q.put((deferred[0], batch))
+                else:
+                    # Settle already ran inline (session batch, or an
+                    # exception path that must not race the guard
+                    # above): the slot frees immediately.
+                    self._note_pipeline(-1)
+                    self._window.release()
 
-    def _dispatch_guarded(self, batch: List[ServeRequest]) -> None:
+    def _dispatch_guarded(self, batch: List[ServeRequest],
+                          defer=None) -> None:
         """Client dispatch under the round-16 guards: the serve_hang /
         serve_evict fault points (keyed by client-dispatch ordinal)
         and, when `dispatch_deadline_s` is set, a DispatchDeadline
@@ -1065,7 +1256,7 @@ class SynthDaemon:
                 # Forced cache-epoch eviction: the next lookup is an
                 # honest miss + recompile, not a wrong answer.
                 self.cache.force_epoch_eviction()
-            self._execute(batch, kind="client")
+            self._execute(batch, kind="client", defer=defer)
         finally:
             if dd is not None:
                 dd.cancel()
@@ -1116,8 +1307,14 @@ class SynthDaemon:
         """Shared dispatch preamble: admission spans/latency, the
         in-flight gauges, the dispatch counter, and the executable-
         cache verdict (booked exactly once per dispatch — the serving
-        sentinel's `hits + misses == dispatches` contract).  Returns
-        the admission timestamp."""
+        sentinel's `hits + misses == dispatches` contract).  When the
+        disk tier exists, every in-memory MISS is resolved one level
+        further down — `probe` books exactly one of disk-hit/disk-miss
+        (the sentinel's new `disk hits + disk misses == misses`
+        reconciliation) and a disk hit upgrades the verdict to the
+        three-valued `disk` (span `disk-restored`): the dispatch runs
+        deserialized executables, no jit trace.  Returns the admission
+        timestamp."""
         admit_t = time.monotonic()
         for req in batch:
             req.span("admitted")
@@ -1126,13 +1323,18 @@ class SynthDaemon:
                 (admit_t - req.enqueue_t) * 1000.0,
                 labels={"phase": "queued"},
             )
-        self._inflight = len(batch)
-        self._g_inflight.set(len(batch))
+        with self._inflight_lock:
+            self._inflight += len(batch)
+            self._g_inflight.set(self._inflight)
         self._c_dispatches.inc(labels={"kind": kind})
         cache_status = self.cache.lookup(
             batch[0].key, kind=kind, request_id=batch[0].req_id
         )
-        span_name = "cache-hit" if cache_status == "hit" else "compiled"
+        if cache_status == "miss" and self.disk is not None:
+            cache_status = self.disk.probe(batch[0].key, kind=kind)
+        span_name = {
+            "hit": "cache-hit", "disk": "disk-restored",
+        }.get(cache_status, "compiled")
         for req in batch:
             req.cache = cache_status
             req.span(span_name)
@@ -1165,8 +1367,9 @@ class SynthDaemon:
             if req.replay:
                 self._settle_replay(req)
             req.done.set()
-        self._inflight = 0
-        self._g_inflight.set(0)
+        with self._inflight_lock:
+            self._inflight = max(0, self._inflight - len(batch))
+            self._g_inflight.set(self._inflight)
 
     def _settle_replay(self, req: ServeRequest) -> None:
         """A replayed request has no handler thread: the dispatcher
@@ -1232,11 +1435,21 @@ class SynthDaemon:
             self.tracer.attach_tree(root)
 
     def _execute(self, batch: List[ServeRequest],
-                 kind: str = "client") -> None:
+                 kind: str = "client", defer=None) -> None:
         """One dispatch: cache verdict -> pad to the static grain ->
         supervised `synthesize_batch` -> demux -> settle requests.
         Session batches (compat pins them to one session id) detour
-        through the per-session warm-start stream instead."""
+        through the per-session warm-start stream instead.
+
+        Pipelining seam (round 18): when `defer` is given (the client
+        dispatcher), the settle tail — demux, outcome counters, done
+        events — is packaged as a closure and handed over instead of
+        run inline, so it executes on the completer thread while this
+        thread starts the next batch.  The split is PLACEMENT only:
+        the engine call, the device sync (`np.asarray`), and the
+        `executed` timestamp all stay here, and every exception path
+        settles inline before propagating — the dispatch-loop guard's
+        "fail the undone" sweep never races a deferred settle."""
         import dataclasses
 
         from ..parallel.batch import synthesize_batch
@@ -1248,83 +1461,127 @@ class SynthDaemon:
 
         grain = self.policy.max_batch
         admit_t = self._admit_batch(batch, kind)
-
-        frames = np.stack([r.frame for r in batch])
-        if frames.shape[0] < grain:
-            frames = np.concatenate(
-                [frames]
-                + [frames[-1:]] * (grain - frames.shape[0]), axis=0
-            )
-        b_stats = batch[0].b_stats
-        ckpt_dir = tempfile.mkdtemp(
-            prefix="dispatch-", dir=self._work_dir
-        )
-        cfg = dataclasses.replace(
-            self.cfg, save_level_artifacts=ckpt_dir
-        )
-        # Per-dispatch run tracer (observability on): the batch
-        # runner's run->level->em_iter tree, grafted under the batch
-        # lead's serve_request root at settle.  Instrumentation only —
-        # `synthesize_batch` reads the tracer, never branches numerics
-        # on it (the solo-dispatch bit-identity test pins this) — and
-        # LEAN: the runner keeps the span tree but skips its optional
-        # per-level device readbacks (energy means, shard-sync walls),
-        # so request tracing adds no device syncs to the hot path.
+        ckpt_dir = None
         run_tracer = None
-        if self.observability and self.tracer is not None \
-                and self.tracer.enabled:
-            from ..telemetry.spans import Tracer
+        out = None
+        ok = False
+        gaveup = None
 
-            run_tracer = Tracer(lean=True)
-
-        def attempt(resume_from):
-            return synthesize_batch(
-                self.a, self.ap, frames, cfg, self.mesh,
-                progress=run_tracer,
-                resume_from=resume_from,
-                frame_indices=[0] * grain,
-                _b_stats=b_stats,
-            )
+        def settle():
+            try:
+                if ok:
+                    demux(batch, out[: len(batch)])
+                    for req in batch:
+                        if kind == "client":
+                            self._c_completed.inc()
+                elif gaveup is not None:
+                    for req in batch:
+                        req.status = "failed"
+                        req.error = f"supervisor gave up: {gaveup}"
+                        if kind == "client":
+                            self._c_failed.inc()
+            finally:
+                if ckpt_dir is not None:
+                    shutil.rmtree(ckpt_dir, ignore_errors=True)
+                run_roots, compile_ms = (), None
+                if run_tracer is not None:
+                    run_roots = tuple(run_tracer.roots)
+                    walls = [
+                        sp.wall_ms
+                        for sp in run_tracer.find("prologue")
+                        if sp.wall_ms is not None
+                    ]
+                    if walls:
+                        compile_ms = round(sum(walls), 3)
+                self._settle_batch(
+                    batch, admit_t, run_roots=run_roots,
+                    compile_ms=compile_ms,
+                )
 
         try:
-            out = supervise(
-                attempt,
-                ckpt_dir=ckpt_dir,
-                tracer=None,
-                registry=self.registry,
-                max_retries=self.max_retries,
-                ladder=[],
-                backoff_s=0.05,
-                max_backoff_s=1.0,
+            frames = np.stack([r.frame for r in batch])
+            if frames.shape[0] < grain:
+                frames = np.concatenate(
+                    [frames]
+                    + [frames[-1:]] * (grain - frames.shape[0]), axis=0
+                )
+            b_stats = batch[0].b_stats
+            ckpt_dir = tempfile.mkdtemp(
+                prefix="dispatch-", dir=self._work_dir
             )
-            out = np.asarray(out, np.float32)
-            for req in batch:
-                req.span("executed")
-            demux(batch, out[: len(batch)])
-            for req in batch:
-                if kind == "client":
-                    self._c_completed.inc()
-        except SupervisorGaveUp as e:
-            for req in batch:
-                req.status = "failed"
-                req.error = f"supervisor gave up: {e}"
-                if kind == "client":
-                    self._c_failed.inc()
-        finally:
-            shutil.rmtree(ckpt_dir, ignore_errors=True)
-            run_roots, compile_ms = (), None
-            if run_tracer is not None:
-                run_roots = tuple(run_tracer.roots)
-                walls = [
-                    sp.wall_ms for sp in run_tracer.find("prologue")
-                    if sp.wall_ms is not None
-                ]
-                if walls:
-                    compile_ms = round(sum(walls), 3)
-            self._settle_batch(
-                batch, admit_t, run_roots=run_roots,
-                compile_ms=compile_ms,
+            cfg = dataclasses.replace(
+                self.cfg, save_level_artifacts=ckpt_dir
             )
+            # Per-dispatch run tracer (observability on): the batch
+            # runner's run->level->em_iter tree, grafted under the
+            # batch lead's serve_request root at settle.
+            # Instrumentation only — `synthesize_batch` reads the
+            # tracer, never branches numerics on it (the solo-dispatch
+            # bit-identity test pins this) — and LEAN: the runner
+            # keeps the span tree but skips its optional per-level
+            # device readbacks (energy means, shard-sync walls), so
+            # request tracing adds no device syncs to the hot path.
+            if self.observability and self.tracer is not None \
+                    and self.tracer.enabled:
+                from ..telemetry.spans import Tracer
+
+                run_tracer = Tracer(lean=True)
+
+            # Disk-tier recording: the window opens INSIDE the attempt
+            # closure because supervise runs attempts on its worker
+            # threads, and the recording context is thread-local to
+            # wherever the engine invokes the persist hook.  Retried
+            # attempts union their captures; the entry seals only
+            # after the dispatch succeeds.
+            disk = self.disk
+            recorded: set = set()
+
+            def attempt(resume_from):
+                if disk is not None:
+                    disk.begin_recording()
+                try:
+                    return synthesize_batch(
+                        self.a, self.ap, frames, cfg, self.mesh,
+                        progress=run_tracer,
+                        resume_from=resume_from,
+                        frame_indices=[0] * grain,
+                        _b_stats=b_stats,
+                    )
+                finally:
+                    if disk is not None:
+                        recorded.update(disk.end_recording())
+
+            try:
+                out = supervise(
+                    attempt,
+                    ckpt_dir=ckpt_dir,
+                    tracer=None,
+                    registry=self.registry,
+                    max_retries=self.max_retries,
+                    ladder=[],
+                    backoff_s=0.05,
+                    max_backoff_s=1.0,
+                )
+                out = np.asarray(out, np.float32)
+                for req in batch:
+                    req.span("executed")
+                ok = True
+            except SupervisorGaveUp as e:
+                gaveup = e
+            if ok and disk is not None:
+                fs = batch[0].frame.shape
+                disk.seal(
+                    batch[0].key,
+                    fs if len(fs) == 3 else fs + (1,),
+                    recorded,
+                )
+        except BaseException:
+            settle()
+            raise
+        if defer is not None:
+            defer(settle)
+        else:
+            settle()
 
     # ---------------------------------------------- session dispatch
     def _session_stream(self, sid: str, proto: ServeRequest):

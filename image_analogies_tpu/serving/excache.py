@@ -38,6 +38,7 @@ graphs without living in the config.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import threading
@@ -402,7 +403,9 @@ def merge_warmup_entries(*entry_lists) -> List[Dict[str, Any]]:
 
 def run_warmup(entries: List[Dict[str, Any]],
                dispatch_fn: Callable[[tuple], Any],
-               cache: "ExecutableCache", key_fn) -> List[Dict[str, Any]]:
+               cache: "ExecutableCache", key_fn,
+               max_workers: int = 4,
+               tracer=None) -> List[Dict[str, Any]]:
     """Drive each manifest entry's shape through the daemon's dispatch
     path (a synthetic zero image; `dispatch_fn` performs the cache
     lookup itself, exactly as a client dispatch would, with
@@ -410,18 +413,742 @@ def run_warmup(entries: List[Dict[str, Any]],
     Entries are deduplicated by executable key so a manifest that
     repeats a shape never books a warmup "hit" (the sentinel's
     `cache hits <= requests` ledger is a claim about CLIENT traffic).
-    Returns per-entry {key, wall_ms} records."""
-    done = set()
-    report = []
+
+    Round 18: distinct shapes compile CONCURRENTLY on a small thread
+    pool (`max_workers`, clamped to the shape count; <= 1 keeps the
+    old sequential path) — shape compiles are independent jit traces,
+    so the port-announce delay is the SLOWEST shape's compile, not the
+    sum.  When `tracer` is a live Tracer, one `warmup` span tree is
+    attached carrying a child span per shape with its compile wall —
+    the per-shape attribution an operator reads instead of one opaque
+    startup stall.  Returns per-entry {key, wall_ms} records in
+    manifest order."""
+    work = []
+    seen = set()
     for e in entries:
         shape = (e["height"], e["width"], e["channels"])
         key = key_fn(shape)
-        if key in done:
+        if key in seen:
             continue
-        done.add(key)
+        seen.add(key)
+        work.append((key, shape))
+    t_start = time.perf_counter()
+    spans: List[tuple] = []
+
+    def one(key, shape):
         t0 = time.perf_counter()
         dispatch_fn(shape)
-        wall_ms = (time.perf_counter() - t0) * 1000.0
+        t1 = time.perf_counter()
+        wall_ms = (t1 - t0) * 1000.0
         cache.note_compile_ms(key, wall_ms)
-        report.append({"key": key_str(key), "wall_ms": round(wall_ms, 1)})
+        spans.append((key, t0, t1))
+        return {"key": key_str(key), "wall_ms": round(wall_ms, 1)}
+
+    if len(work) <= 1 or max_workers <= 1:
+        report = [one(key, shape) for key, shape in work]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=min(int(max_workers), len(work)),
+            thread_name_prefix="ia-serve-warmup",
+        ) as pool:
+            futures = [pool.submit(one, key, shape)
+                       for key, shape in work]
+            # In submission order (manifest order) — a failed shape
+            # raises here exactly as the sequential loop did.
+            report = [f.result() for f in futures]
+    if tracer is not None and getattr(tracer, "enabled", False) \
+            and work:
+        from ..telemetry.spans import span_at
+
+        t_end = time.perf_counter()
+        root = span_at(
+            "warmup", t_start, t_end,
+            shapes=len(work),
+            workers=min(int(max_workers), len(work)),
+        )
+        for key, t0, t1 in sorted(spans, key=lambda s: s[1]):
+            root.children.append(span_at(
+                "warmup_shape", t0, t1, key=key_str(key),
+                compile_ms=round((t1 - t0) * 1000.0, 1),
+            ))
+        tracer.attach_tree(root)
     return report
+
+
+# ------------------------------------------------------------ disk tier
+# Round 18 tentpole: a persistent executable store under
+# <state_dir>/excache/.  The in-memory ExecutableCache above stays the
+# accounting layer ("will this dispatch compile or reuse?"); the disk
+# tier makes the answer survive the process.  Architecture:
+#
+#   - The engine's jit factories expose a persist hook
+#     (parallel/batch.set_persist_hook).  On the COLD path the hook
+#     owns compilation: it AOT-lowers the jit function
+#     (`lower(*args).compile()`), serializes the executable
+#     (jax.experimental.serialize_executable — the AOT API that
+#     survives jax 0.4.37), writes one checksummed blob file, and
+#     calls the compiled object — one compile total, because jit's
+#     internal executable cache is NOT reused by AOT lowering.
+#   - On restore the blob is deserialized and matched AT CALL TIME by
+#     (role, ident, argument signature): ident is the stripped-config
+#     lru key (stable across processes — dataclass repr of compute
+#     fields only) plus the process-wide compression mode, the
+#     signature is the argument pytree structure + leaf shapes/dtypes.
+#     No tracing happens on a restored path.
+#   - Entries are keyed by exec_key x a BACKEND FINGERPRINT (jax/
+#     jaxlib versions, platform, device kind + count, XLA env seams):
+#     any mismatch is an honest miss — recompile + overwrite, never a
+#     wrong answer.  Corrupt or torn blob files are skipped with a
+#     counted error (`ia_excache_disk_errors_total`), journal-style.
+#   - `index.json` maps exec_key -> its blob set (sealed only after a
+#     successful dispatch), giving the daemon an admission-visible
+#     "disk" verdict and the warm-set shapes a restart restores before
+#     the port is announced.
+DISK_SCHEMA_VERSION = 1
+_BLOB_MAGIC = b"IAXC1\n"
+_INDEX_FILE = "index.json"
+
+
+def backend_fingerprint() -> Dict[str, Any]:
+    """The environment a serialized executable is only valid in: jax
+    wire format + compiler version + device topology + the env seams
+    that change generated code without appearing in any config field.
+    (The kernel-compression mode is already inside `exec_key` /
+    the hook ident, so it is deliberately absent here.)"""
+    import os
+
+    import jax
+    import jaxlib
+
+    devs = jax.devices()
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": devs[0].platform if devs else "none",
+        "device_kind": devs[0].device_kind if devs else "none",
+        "device_count": len(devs),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+    }
+
+
+def _digest(s: str) -> str:
+    return hashlib.sha1(s.encode()).hexdigest()[:16]
+
+
+def _arg_signature(args) -> tuple:
+    """Stable cross-process identity of a call's arguments: the pytree
+    structure plus each leaf's (shape, dtype) — exactly what shape-
+    specializes a jit trace.  Python-scalar leaves (the luma-bucket
+    stats tuple) are identified by type, not value: they trace as
+    dynamic scalars, so one executable serves every value."""
+    import jax
+
+    leaves, tree = jax.tree_util.tree_flatten(args)
+    sig = []
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sig.append((tuple(int(d) for d in leaf.shape),
+                        str(leaf.dtype)))
+        else:
+            sig.append(("py", type(leaf).__name__))
+    return (repr(tree), tuple(sig))
+
+
+_BYPASS_LOCK = threading.Lock()
+_BYPASS_DEPTH = 0
+_BYPASS_SAVED: tuple = ()
+
+
+@contextlib.contextmanager
+def _jax_cache_bypass():
+    """Disable jax's persistent compilation cache around one AOT
+    compile.  Serializing an executable that was itself LOADED from
+    jax's cache produces a blob whose deserialize later fails with XLA
+    "Symbols not found" — the object code is not self-contained — so a
+    persisted blob must always come from a fresh XLA compile (the AOT
+    store IS the persistence layer for hook-covered functions; losing
+    the jax-cache write for them costs nothing).  The config knob
+    can't express this: `is_cache_used`/`_cache` are memoized once per
+    process, so the only off switch after first use is the module
+    state itself.  Swapping `_cache = None` makes both the read and
+    write paths report "disabled" (`_initialize_cache` is memoized via
+    `_cache_initialized`, which we force True so a first-ever compile
+    landing inside the window can't lazily resurrect it).  The swap is
+    process-global, not thread-local — a concurrent eager-op compile
+    on another thread just skips one jax-cache write, which is a
+    missed optimization, never a correctness problem — and the depth
+    counter keeps parallel warmup compiles from restoring early."""
+    global _BYPASS_DEPTH, _BYPASS_SAVED
+    try:
+        from jax._src import compilation_cache as jax_cc
+    except Exception:  # noqa: BLE001 - private API probe
+        yield
+        return
+    with _BYPASS_LOCK:
+        if _BYPASS_DEPTH == 0:
+            _BYPASS_SAVED = (
+                getattr(jax_cc, "_cache", None),
+                getattr(jax_cc, "_cache_initialized", True),
+            )
+            jax_cc._cache = None
+            jax_cc._cache_initialized = True
+        _BYPASS_DEPTH += 1
+    try:
+        yield
+    finally:
+        with _BYPASS_LOCK:
+            _BYPASS_DEPTH -= 1
+            if _BYPASS_DEPTH == 0:
+                jax_cc._cache = _BYPASS_SAVED[0]
+                jax_cc._cache_initialized = _BYPASS_SAVED[1]
+                _BYPASS_SAVED = ()
+
+
+def _jax_cache_bypass_available() -> bool:
+    try:
+        from jax._src import compilation_cache as jax_cc
+
+        return hasattr(jax_cc, "_cache") and hasattr(
+            jax_cc, "_cache_initialized"
+        )
+    except Exception:  # noqa: BLE001 - private API probe
+        return False
+
+
+class DiskExecCache:
+    """Persistent disk tier for the serving executable cache.
+
+    Store layout under `root` (= <state_dir>/excache/):
+
+        index.json            {schema_version, fingerprint, entries:
+                               {key_str: {shape, warmup_shape, blobs}}}
+        blobs/<role>-<ident>-<sig>.jexec
+                              MAGIC + sha256(payload) + payload, where
+                              payload pickles {fingerprint, role,
+                              ident, sig, blob, in_tree, out_tree}
+
+    Honesty rules: a fingerprint mismatch drops the whole index (miss,
+    recompile, overwrite); a corrupt/torn/missing blob is skipped with
+    `ia_excache_disk_errors_total` and degrades its entry to a miss; a
+    restored executable that rejects its arguments (pre-execution
+    shape/sharding check) falls back to the jit path with a counted
+    error.  Never a wrong answer.
+
+    Threading: the loaded-executable table and index are lock-guarded;
+    the per-dispatch blob-recording context is THREAD-LOCAL — the
+    daemon opens it INSIDE the supervised attempt closure (which runs
+    on the supervisor's worker thread, where the engine actually calls
+    the hook), so the parallel warmup pool and the pipelined
+    dispatcher each seal only their own dispatch's blobs."""
+
+    def __init__(self, root: str, registry=None):
+        import os
+
+        self.root = str(root)
+        self.blob_dir = os.path.join(self.root, "blobs")
+        os.makedirs(self.blob_dir, exist_ok=True)
+        self._registry = registry
+        self._fp = backend_fingerprint()
+        self._lock = threading.RLock()
+        # (role, ident_digest, sig_digest) -> loaded/compiled callable
+        self._loaded: Dict[tuple, Any] = {}
+        # key_str(exec_key) -> {"shape", "warmup_shape", "blobs"}
+        self._entries: Dict[str, Dict[str, Any]] = {}
+        self._ctx = threading.local()
+        self.errors = 0
+        self.stored = 0
+        self.restore_ms: Optional[float] = None
+        self._owns_jax_cache = False
+        self._saved_jax_knobs: Optional[tuple] = None
+        # serialize/deserialize availability probed once; a platform
+        # without the AOT API degrades to a no-op tier (all misses),
+        # never a crash.
+        try:
+            from jax.experimental.serialize_executable import (  # noqa: F401
+                deserialize_and_load,
+                serialize,
+            )
+
+            self.enabled = True
+        except Exception:  # noqa: BLE001 - optional capability
+            self.enabled = False
+        if self.enabled:
+            self._enable_jax_cache()
+        self._load_index()
+
+    def _enable_jax_cache(self) -> None:
+        """Point jax's own persistent compilation cache under the same
+        root.  The AOT tier above covers the hook-wrapped level/prologue
+        executables; this covers the long tail of tiny ops the engine
+        dispatches eagerly around them (colorspace einsum, rng seeding,
+        padding slices) — each only ~15-25 ms to compile, but there are
+        a dozen of them on a restart's first request and together they
+        dominate the residual cold start once the big executables come
+        from disk.  Thresholds drop to zero because that long tail is
+        exactly the sub-second population jax's defaults skip.  A jax
+        without the knobs, or one the user already pointed elsewhere,
+        is left alone.
+
+        Enabled ONLY when the per-compile bypass is available too
+        (`_jax_cache_bypass`): the hook's AOT compiles must never read
+        this cache, or the serialized blobs come out non-self-contained
+        (see the bypass docstring) — no bypass, no jax cache."""
+        import os
+
+        import jax
+
+        if not _jax_cache_bypass_available():
+            return
+        try:
+            if jax.config.jax_compilation_cache_dir is not None:
+                return
+            self._saved_jax_knobs = (
+                jax.config.jax_persistent_cache_min_compile_time_secs,
+                jax.config.jax_persistent_cache_min_entry_size_bytes,
+            )
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.join(self.root, "jaxcache"),
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1
+            )
+            self._owns_jax_cache = True
+        except Exception:  # noqa: BLE001 - optional capability
+            pass
+
+    def release_jax_cache(self) -> None:
+        """Undo `_enable_jax_cache` when the owning daemon stops.  The
+        knob is process-global and jax memoizes the cache object at
+        first compile, so without this the jax cache — and its
+        per-compile key-hash + serialize-and-write overhead — outlives
+        the daemon and taxes every later compile in the process
+        (long-lived test runners feel this as minutes).  Restores the
+        config to its pre-enable state and `reset_cache()`s jax's
+        memos; a successor daemon on the same state dir simply
+        re-enables and re-initializes against the same directory."""
+        global _BYPASS_SAVED
+        if not self._owns_jax_cache:
+            return
+        self._owns_jax_cache = False
+        try:
+            import jax
+            from jax._src import compilation_cache as jax_cc
+
+            jax.config.update("jax_compilation_cache_dir", None)
+            if self._saved_jax_knobs is not None:
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs",
+                    self._saved_jax_knobs[0],
+                )
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes",
+                    self._saved_jax_knobs[1],
+                )
+            with _BYPASS_LOCK:
+                jax_cc.reset_cache()
+                if _BYPASS_DEPTH > 0:
+                    # A hook compile is mid-bypass: make its exit
+                    # restore the reset state, not the pre-reset
+                    # cache object we just tore down.
+                    _BYPASS_SAVED = (None, False)
+        except Exception:  # noqa: BLE001 - optional capability
+            pass
+
+    # ---------------------------------------------------- metrics
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from ..telemetry.metrics import get_registry
+
+        return get_registry()
+
+    def _count(self, which: str, kind: Optional[str] = None) -> None:
+        c = self._reg().counter(
+            f"ia_excache_disk_{which}_total",
+            f"serving disk executable-cache {which}"
+            + (" by request kind" if kind is not None else
+               " (corrupt/torn blob files, serialize/store failures "
+               "— skipped journal-style, never raised)"),
+        )
+        c.inc(labels={"kind": kind} if kind is not None else None)
+
+    def _error(self, why: str) -> None:
+        self.errors += 1
+        self._count("errors")
+        import logging
+
+        logging.getLogger("image_analogies_tpu").warning(
+            "disk excache: %s (honest miss)", why
+        )
+
+    # ------------------------------------------------------ index
+    def _index_path(self) -> str:
+        import os
+
+        return os.path.join(self.root, _INDEX_FILE)
+
+    def _load_index(self) -> None:
+        import os
+
+        path = self._index_path()
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            self._error(f"unreadable index {path}")
+            return
+        if not isinstance(doc, dict) or doc.get(
+            "schema_version"
+        ) != DISK_SCHEMA_VERSION:
+            self._error(f"index {path}: wrong schema")
+            return
+        if doc.get("fingerprint") != self._fp:
+            # Not corruption: a different backend's executables are
+            # simply not ours to run.  The entries die; blob files are
+            # overwritten as this process re-seals.
+            import logging
+
+            logging.getLogger("image_analogies_tpu").warning(
+                "disk excache: backend fingerprint changed "
+                "(%s -> %s); persisted executables invalidated",
+                doc.get("fingerprint"), self._fp,
+            )
+            return
+        entries = doc.get("entries")
+        if isinstance(entries, dict):
+            for kstr, e in entries.items():
+                if (isinstance(e, dict)
+                        and isinstance(e.get("blobs"), list)):
+                    self._entries[str(kstr)] = {
+                        "shape": e.get("shape"),
+                        "warmup_shape": e.get("warmup_shape"),
+                        "blobs": [str(b) for b in e["blobs"]],
+                    }
+
+    def _write_index(self) -> None:
+        import os
+
+        doc = {
+            "schema_version": DISK_SCHEMA_VERSION,
+            "fingerprint": self._fp,
+            "entries": self._entries,
+        }
+        tmp = self._index_path() + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            os.replace(tmp, self._index_path())
+        except OSError as e:
+            self._error(f"index write failed: {e}")
+
+    # ------------------------------------------------------ blobs
+    def _blob_name(self, tkey: tuple) -> str:
+        role, ident_d, sig_d = tkey
+        return f"{role}-{ident_d}-{sig_d}.jexec"
+
+    def _blob_path(self, name: str) -> str:
+        import os
+
+        return os.path.join(self.blob_dir, os.path.basename(name))
+
+    def _write_blob(self, tkey: tuple, role: str, ident_r: str,
+                    sig: tuple, compiled) -> Optional[str]:
+        """Serialize + atomically write one executable; returns the
+        blob name, or None (counted) on failure."""
+        import os
+        import pickle
+
+        from jax.experimental.serialize_executable import serialize
+
+        try:
+            blob, in_tree, out_tree = serialize(compiled)
+            payload = pickle.dumps({
+                "fingerprint": self._fp,
+                "role": role,
+                "ident": ident_r,
+                "sig": sig,
+                "blob": blob,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+            })
+        except Exception as e:  # noqa: BLE001 - persistence best-effort
+            self._error(f"serialize failed for {role}: {e}")
+            return None
+        name = self._blob_name(tkey)
+        path = self._blob_path(name)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as fh:
+                fh.write(_BLOB_MAGIC)
+                fh.write(hashlib.sha256(payload).digest())
+                fh.write(payload)
+            os.replace(tmp, path)
+        except OSError as e:
+            self._error(f"blob write failed for {name}: {e}")
+            return None
+        self.stored += 1
+        return name
+
+    def _read_blob(self, name: str, expected: bool = True):
+        """Deserialize one blob file into a callable, or None with a
+        counted error on ANY corruption (bad magic, checksum mismatch,
+        truncation, unpicklable payload, fingerprint drift).  A
+        MISSING file is an error only when `expected` (the index or a
+        sealed entry named it); the hook's own cold-path peek passes
+        expected=False — an executable that was never persisted is
+        the normal compile path, not a store fault."""
+        import os
+        import pickle
+
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+        )
+
+        path = self._blob_path(name)
+        if not os.path.exists(path):
+            if expected:
+                self._error(f"blob {name} missing")
+            return None
+        try:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+            if not raw.startswith(_BLOB_MAGIC):
+                raise ValueError("bad magic")
+            digest = raw[len(_BLOB_MAGIC):len(_BLOB_MAGIC) + 32]
+            payload = raw[len(_BLOB_MAGIC) + 32:]
+            if hashlib.sha256(payload).digest() != digest:
+                raise ValueError("checksum mismatch (torn write?)")
+            doc = pickle.loads(payload)
+            if doc.get("fingerprint") != self._fp:
+                raise ValueError("backend fingerprint mismatch")
+            fn = deserialize_and_load(
+                doc["blob"], doc["in_tree"], doc["out_tree"]
+            )
+            tkey = (doc["role"], _digest(doc["ident"]),
+                    _digest(repr(tuple(doc["sig"]))))
+            return tkey, fn
+        except Exception as e:  # noqa: BLE001 - corrupt file, skip
+            self._error(f"blob {name} unreadable: {e}")
+            return None
+
+    # --------------------------------------------- the persist hook
+    def clear_loaded(self) -> None:
+        """Epoch eviction (parallel/batch.clear_persist_loaded): drop
+        every loaded/compiled executable, keep the disk files — the
+        next use of each key restores from disk."""
+        with self._lock:
+            self._loaded.clear()
+
+    def call(self, role: str, ident: tuple, jit_fn, args):
+        """The hook body (parallel/batch._PersistWrap): loaded table
+        -> disk blob -> AOT compile + store -> plain jit fallback.
+        Persistence failures degrade to the jit path; they never
+        change an answer."""
+        if not self.enabled:
+            return jit_fn(*args)
+        ident_r = repr(ident) + "|" + compression_mode()
+        sig = _arg_signature(args)
+        tkey = (role, _digest(ident_r), _digest(repr(sig)))
+        recording = getattr(self._ctx, "blobs", None)
+        with self._lock:
+            fn = self._loaded.get(tkey)
+        if fn is None:
+            hit = self._read_blob(self._blob_name(tkey),
+                                  expected=False)
+            if hit is not None:
+                _, fn = hit
+                with self._lock:
+                    self._loaded[tkey] = fn
+        if fn is not None:
+            if recording is not None:
+                recording.add(self._blob_name(tkey))
+            try:
+                return fn(*args)
+            except (TypeError, ValueError) as e:
+                # Pre-execution argument/sharding rejection on a
+                # restored executable — an honest miss, not a wrong
+                # answer (the check fires before any compute).
+                self._error(
+                    f"restored executable rejected args ({role}): {e}"
+                )
+                with self._lock:
+                    self._loaded.pop(tkey, None)
+        try:
+            with _jax_cache_bypass():
+                compiled = jit_fn.lower(*args).compile()
+        except Exception as e:  # noqa: BLE001 - AOT path best-effort
+            self._error(f"AOT compile failed for {role}: {e}")
+            return jit_fn(*args)
+        name = self._write_blob(tkey, role, ident_r, sig, compiled)
+        if name is not None and recording is not None:
+            recording.add(name)
+        with self._lock:
+            self._loaded[tkey] = compiled
+        return compiled(*args)
+
+    # ------------------------------------------- dispatch bracketing
+    def begin_recording(self) -> None:
+        """Open THIS THREAD's blob-recording window: every blob the
+        hook serves or seals from this thread until `end_recording`
+        belongs to the current dispatch.  The daemon calls it at the
+        top of the supervised attempt closure — the closure runs on
+        the supervisor's worker thread, which is where the engine's
+        jit factories actually invoke the hook."""
+        self._ctx.blobs = set()
+
+    def end_recording(self) -> set:
+        """Close this thread's recording window, returning the blob
+        names it captured (a retried attempt's captures are unioned by
+        the caller)."""
+        blobs = getattr(self._ctx, "blobs", None)
+        self._ctx.blobs = None
+        return blobs if blobs is not None else set()
+
+    def seal(self, key: ExecKey, warmup_shape, blobs) -> None:
+        """Seal one exec_key's entry (key -> the blob set its
+        dispatch touched) into the index.  The daemon calls this only
+        after a SUCCESSFUL dispatch — a half-compiled crashed dispatch
+        can never claim a warm restart it cannot deliver.  An empty
+        blob set (hook disabled, or every persist attempt failed)
+        seals nothing.  `warmup_shape` is the client-visible (H, W, C)
+        the restart warmup replays."""
+        if not blobs:
+            return
+        kstr = key_str(key)
+        entry = {
+            "shape": [int(d) for d in key[0]],
+            "warmup_shape": (
+                [int(d) for d in warmup_shape]
+                if warmup_shape is not None else None
+            ),
+            "blobs": sorted(blobs),
+        }
+        with self._lock:
+            if self._entries.get(kstr) == entry:
+                return
+            self._entries[kstr] = entry
+            self._write_index()
+
+    # -------------------------------------------------- verdict/restore
+    def probe(self, key: ExecKey, kind: str = "client") -> str:
+        """The admission-visible disk verdict for one exec_key the in-
+        memory cache just missed: "disk" when a sealed entry's blobs
+        are all loadable (loading them NOW, so the dispatch that
+        follows runs restored executables without tracing), else
+        "miss".  Books `ia_excache_disk_{hits,misses}_total{kind}` —
+        exactly one of the two per in-memory miss, which is the
+        sentinel reconciliation (disk hits + disk misses == in-memory
+        misses)."""
+        kstr = key_str(key)
+        with self._lock:
+            entry = self._entries.get(kstr)
+        if entry is not None and self.enabled:
+            ok = True
+            for name in entry["blobs"]:
+                with self._lock:
+                    # Already resident (restored at start, or a prior
+                    # probe): nothing to load.
+                    if any(self._blob_name(t) == name
+                           for t in self._loaded):
+                        continue
+                hit = self._read_blob(name)
+                if hit is None:
+                    ok = False
+                    break
+                tkey, fn = hit
+                with self._lock:
+                    self._loaded[tkey] = fn
+            if ok:
+                self._count("hits", kind)
+                return "disk"
+            # A sealed entry that cannot restore is dead weight —
+            # drop it so the NEXT probe is a clean miss, and let this
+            # dispatch recompile + re-seal.
+            with self._lock:
+                self._entries.pop(kstr, None)
+                self._write_index()
+        self._count("misses", kind)
+        return "miss"
+
+    def restore_warm_set(self) -> List[Dict[str, Any]]:
+        """Daemon-start restore (before the port is announced): load
+        every sealed entry's blobs into the table, dropping entries
+        that no longer restore (counted errors).  Returns per-entry
+        {key, blobs, wall_ms} and records the total wall on
+        `ia_excache_disk_restore_ms`."""
+        report = []
+        t_all = time.perf_counter()
+        with self._lock:
+            items = list(self._entries.items())
+        for kstr, entry in items:
+            if not self.enabled:
+                break
+            t0 = time.perf_counter()
+            ok = True
+            for name in entry["blobs"]:
+                hit = self._read_blob(name)
+                if hit is None:
+                    ok = False
+                    break
+                tkey, fn = hit
+                with self._lock:
+                    self._loaded[tkey] = fn
+            if not ok:
+                with self._lock:
+                    self._entries.pop(kstr, None)
+                    self._write_index()
+                continue
+            report.append({
+                "key": kstr,
+                "blobs": len(entry["blobs"]),
+                "wall_ms": round(
+                    (time.perf_counter() - t0) * 1000.0, 1
+                ),
+            })
+        self.restore_ms = round(
+            (time.perf_counter() - t_all) * 1000.0, 1
+        )
+        self._reg().gauge(
+            "ia_excache_disk_restore_ms",
+            "wall of the last daemon-start disk executable restore "
+            "(deserialize every sealed entry, before port announce)",
+        ).set(self.restore_ms)
+        return report
+
+    def warmup_shapes(self) -> List[Dict[str, Any]]:
+        """Sealed entries' client-visible shapes as warmup manifest
+        entries — the restart warmup replays the persisted working
+        set even when the operator's manifest is empty or stale."""
+        out = []
+        with self._lock:
+            for entry in self._entries.values():
+                ws = entry.get("warmup_shape")
+                if isinstance(ws, list) and len(ws) == 3:
+                    out.append({
+                        "height": int(ws[0]), "width": int(ws[1]),
+                        "channels": int(ws[2]),
+                    })
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "root": self.root,
+                "enabled": self.enabled,
+                "entries": len(self._entries),
+                "loaded": len(self._loaded),
+                "stored": self.stored,
+                "errors": self.errors,
+                "restore_ms": self.restore_ms,
+                "fingerprint": dict(self._fp),
+            }

@@ -709,7 +709,17 @@ def check_serving(metrics: Optional[dict]) -> Dict:
         traffic is labeled out) — more hits than requests is a
         fabricated cache claim, violated.
       - cache hits + misses == dispatches (every dispatch consulted
-        the cache exactly once) — violated otherwise."""
+        the cache exactly once) — violated otherwise.
+      - disk tier reconciliation (round 18, only when the
+        `ia_excache_disk_*` family is present — i.e. the daemon ran
+        with a persistent state dir): disk hits + disk misses == in-
+        memory misses, because the daemon probes the disk tier exactly
+        once per in-memory miss and the probe books exactly one of the
+        two — violated otherwise (a dispatch skipped the disk probe,
+        or a probe double-booked).  Disk ERRORS (corrupt/torn blobs,
+        serialize failures — skipped journal-style) grade degraded:
+        correctness held (honest miss), but persisted state is being
+        lost."""
     requests = sum(
         _counter_values(metrics, "ia_serve_requests_total").values()
     )
@@ -731,6 +741,19 @@ def check_serving(metrics: Optional[dict]) -> Dict:
     )
     hits = _counter_values(metrics, "ia_serve_excache_hits_total")
     misses = _counter_values(metrics, "ia_serve_excache_misses_total")
+    disk_hits = sum(_counter_values(
+        metrics, "ia_excache_disk_hits_total"
+    ).values())
+    disk_misses = sum(_counter_values(
+        metrics, "ia_excache_disk_misses_total"
+    ).values())
+    disk_errors = sum(_counter_values(
+        metrics, "ia_excache_disk_errors_total"
+    ).values())
+    has_disk = any(
+        f"ia_excache_disk_{w}_total" in (metrics or {})
+        for w in ("hits", "misses", "errors")
+    )
     if not requests and not admitted and not shed and not dispatches:
         return _check(
             "serving", "skipped",
@@ -765,6 +788,10 @@ def check_serving(metrics: Optional[dict]) -> Dict:
         "cache_hits": n_hits, "cache_hits_client": client_hits,
         "cache_misses": n_misses,
     }
+    if has_disk:
+        observed["disk_hits"] = disk_hits
+        observed["disk_misses"] = disk_misses
+        observed["disk_errors"] = disk_errors
     problems = []
     degraded = []
     if requests != admitted + shed:
@@ -796,6 +823,20 @@ def check_serving(metrics: Optional[dict]) -> Dict:
             f"dispatches ({dispatches}) — a dispatch skipped the "
             "cache, or a lookup never dispatched"
         )
+    if has_disk:
+        if disk_hits + disk_misses != n_misses:
+            problems.append(
+                f"disk hits ({disk_hits}) + disk misses "
+                f"({disk_misses}) != in-memory misses ({n_misses}) — "
+                "an in-memory miss skipped the disk probe, or a probe "
+                "double-booked"
+            )
+        if disk_errors > 0:
+            degraded.append(
+                f"{disk_errors} disk executable-cache error(s) "
+                "(corrupt/torn blob or serialize failure, degraded to "
+                "honest misses) — persisted executables are being lost"
+            )
     status = (
         "violated" if problems else ("degraded" if degraded else "ok")
     )
@@ -804,7 +845,8 @@ def check_serving(metrics: Optional[dict]) -> Dict:
         expected="requests == admitted + shed; admitted == completed "
         "+ failed + cancelled + backlog (backlog >= 0, matching the "
         "gauges); client cache hits <= requests; hits + misses == "
-        "dispatches",
+        "dispatches; with a disk tier, disk hits + disk misses == "
+        "misses and zero disk errors",
         observed=observed,
         detail="serving admission/cache ledger"
         + ("" if not (problems or degraded)

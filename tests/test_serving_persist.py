@@ -1,0 +1,566 @@
+"""Round-18 serving tests: the persistent on-disk executable cache
+(serving/excache.py `DiskExecCache` + the parallel/batch persist
+hook), pipelined dispatch (serving/daemon.py window > 1), the
+parallel warmup pool, the SERVE_r18.json validator
+(tools/check_serve_persist.py), and the committed artifact.
+
+The acceptance-critical arms share ONE state dir through a
+module-scoped scenario that plays four daemon generations over it —
+cold-compile-and-seal, restore-from-disk, corrupt-blob honesty, and
+epoch-eviction honesty — with `clear_compiled_level_caches()` between
+generations so only the DISK tier can carry executables across (the
+in-process jit lru caches would otherwise fake the restore).  The
+pipeline arm replays distinct frames through a solo window=1 daemon
+and a window=2 daemon under a concurrent burst and pins bit-identity
+plus the admission/dispatch ledger — the round-13 isolation contract
+must survive overlap.
+"""
+
+import base64
+import hashlib
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+
+from check_serve_persist import main as check_persist_main  # noqa: E402
+from check_serve_persist import validate_serve_persist  # noqa: E402
+
+from image_analogies_tpu.config import SynthConfig  # noqa: E402
+from image_analogies_tpu.kernels.patchmatch_tile import (  # noqa: E402
+    clear_compiled_level_caches,
+)
+from image_analogies_tpu.serving.accesslog import (  # noqa: E402
+    find_request,
+    phase_fields,
+)
+from image_analogies_tpu.serving.daemon import SynthDaemon  # noqa: E402
+from image_analogies_tpu.serving.excache import (  # noqa: E402
+    DiskExecCache,
+    ExecutableCache,
+    backend_fingerprint,
+    run_warmup,
+)
+from image_analogies_tpu.telemetry.metrics import (  # noqa: E402
+    MetricsRegistry,
+)
+from image_analogies_tpu.telemetry.sentinel import (  # noqa: E402
+    check_serving,
+)
+
+_SERVE_CFG = dict(
+    levels=2, matcher="patchmatch", pallas_mode="off",
+    em_iters=1, pm_iters=2,
+)
+_REPO_ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _body(frame: np.ndarray) -> bytes:
+    return json.dumps({
+        "image_b64": base64.b64encode(
+            np.ascontiguousarray(frame.astype(np.float32)).tobytes()
+        ).decode(),
+        "shape": list(frame.shape),
+        "dtype": "float32",
+    }).encode()
+
+
+def _post(url: str, body: bytes, timeout: float = 300.0,
+          headers=None) -> dict:
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        url + "/synthesize", data=body, method="POST", headers=hdrs,
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _sha(doc: dict) -> str:
+    return hashlib.sha256(
+        base64.b64decode(doc["image_b64"])
+    ).hexdigest()
+
+
+def _counter(reg: MetricsRegistry, name: str) -> float:
+    return float(sum(
+        v for v in reg.to_dict().get(name, {}).get(
+            "values", {}
+        ).values()
+        if isinstance(v, (int, float))
+    ))
+
+
+# ---------------------------------------------------------- scenarios
+@pytest.fixture(scope="module")
+def persist_scenario(tmp_path_factory):
+    """Four daemon generations over one state dir; see module
+    docstring.  Returns every observation the test functions assert
+    on, so the expensive compiles run once."""
+    state = str(tmp_path_factory.mktemp("persist-state"))
+    rng = np.random.default_rng(7)
+    a, ap, b = (rng.random((24, 24, 3)).astype(np.float32)
+                for _ in range(3))
+    cfg = SynthConfig(**_SERVE_CFG)
+    payload = _body(b)
+    s = {"state": state}
+
+    def daemon(reg, **kw):
+        kw.setdefault("observability", False)
+        return SynthDaemon(
+            a, ap, cfg, registry=reg, max_batch=1, max_wait_ms=1.0,
+            state_dir=state, **kw,
+        ).start()
+
+    # -- generation 1: cold compile seals the disk entry.
+    reg1 = MetricsRegistry()
+    d1 = daemon(reg1)
+    try:
+        doc1 = _post(d1.url, payload)
+        s["cold"] = doc1
+        s["cold_sha"] = _sha(doc1)
+        s["cold_disk"] = d1.disk.snapshot()
+        s["cold_sentinel"] = check_serving(reg1.to_dict())
+    finally:
+        d1.stop()
+    clear_compiled_level_caches()
+
+    # -- generation 2: fresh caches, restore from disk.  This one
+    # runs with observability so the access log carries the
+    # disk-restored phase attribution.
+    reg2 = MetricsRegistry()
+    d2 = daemon(reg2, observability=True)
+    try:
+        s["restore_ms"] = d2.disk.restore_ms
+        s["restored_loaded"] = d2.disk.snapshot()["loaded"]
+        rid = "persist-restore-probe"
+        doc2 = _post(d2.url, payload,
+                     headers={"X-Request-Id": rid})
+        s["restored"] = doc2
+        s["restored_sha"] = _sha(doc2)
+        s["restored_repeat"] = _post(d2.url, payload)
+        s["restore_access"] = find_request(d2.access.path, rid)
+        s["restore_sentinel"] = check_serving(reg2.to_dict())
+        s["restore_disk_hits"] = _counter(
+            reg2, "ia_excache_disk_hits_total"
+        )
+        s["restore_mem_misses"] = _counter(
+            reg2, "ia_serve_excache_misses_total"
+        )
+    finally:
+        d2.stop()
+    clear_compiled_level_caches()
+
+    # -- generation 3: one blob corrupted on disk -> honest miss.
+    blob_dir = os.path.join(state, "excache", "blobs")
+    victim = sorted(os.listdir(blob_dir))[0]
+    with open(os.path.join(blob_dir, victim), "r+b") as fh:
+        fh.seek(40)
+        fh.write(b"\x00" * 64)
+    reg3 = MetricsRegistry()
+    d3 = daemon(reg3)
+    try:
+        s["corrupt_restore_errors"] = d3.disk.errors
+        doc3 = _post(d3.url, payload)
+        s["corrupt"] = doc3
+        s["corrupt_sha"] = _sha(doc3)
+        s["corrupt_sentinel"] = check_serving(reg3.to_dict())
+        s["corrupt_error_counter"] = _counter(
+            reg3, "ia_excache_disk_errors_total"
+        )
+    finally:
+        d3.stop()
+    clear_compiled_level_caches()
+
+    # -- generation 4: the recompile re-sealed; epoch eviction drops
+    # the in-memory tiers but must leave the disk files intact.
+    reg4 = MetricsRegistry()
+    d4 = daemon(reg4)
+    try:
+        s["reseal"] = _post(d4.url, payload)
+        s["reseal_repeat"] = _post(d4.url, payload)
+        d4.cache.force_epoch_eviction()
+        s["evicted_loaded"] = d4.disk.snapshot()["loaded"]
+        s["evicted_entries"] = d4.disk.snapshot()["entries"]
+        s["post_evict"] = _post(d4.url, payload)
+        s["post_evict_sha"] = _sha(s["post_evict"])
+        s["evict_sentinel"] = check_serving(reg4.to_dict())
+    finally:
+        d4.stop()
+    clear_compiled_level_caches()
+    return s
+
+
+@pytest.fixture(scope="module")
+def pipeline_scenario():
+    """Solo window=1 baseline vs window=2 concurrent burst over the
+    same six distinct frames (no state dir: this arm isolates the
+    pipelined dispatcher, not the disk tier)."""
+    rng = np.random.default_rng(11)
+    a, ap = (rng.random((24, 24, 3)).astype(np.float32)
+             for _ in range(2))
+    frames = [rng.random((24, 24, 3)).astype(np.float32)
+              for _ in range(6)]
+    cfg = SynthConfig(**_SERVE_CFG)
+    bodies = [_body(f) for f in frames]
+    s = {}
+
+    reg0 = MetricsRegistry()
+    d0 = SynthDaemon(
+        a, ap, cfg, registry=reg0, max_batch=1, max_wait_ms=1.0,
+        observability=False, pipeline_window=1,
+    ).start()
+    try:
+        s["solo"] = [_sha(_post(d0.url, bd)) for bd in bodies]
+    finally:
+        d0.stop()
+
+    reg = MetricsRegistry()
+    d = SynthDaemon(
+        a, ap, cfg, registry=reg, max_batch=1, max_wait_ms=1.0,
+        max_queue_depth=32, observability=False, pipeline_window=2,
+    ).start()
+    try:
+        _post(d.url, bodies[0])  # compile the shape before the burst
+        results = [None] * len(bodies)
+        failures = []
+
+        def client(i):
+            try:
+                results[i] = _post(d.url, bodies[i])
+            except Exception as e:  # noqa: BLE001
+                failures.append((i, repr(e)))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(bodies))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        s["failures"] = failures
+        s["burst"] = results
+        with urllib.request.urlopen(d.url + "/serving",
+                                    timeout=30) as resp:
+            s["serving"] = json.loads(resp.read())
+        s["gauge_inflight_batches"] = _counter(
+            reg, "ia_serve_pipeline_inflight_batches"
+        )
+        s["gauge_inflight"] = _counter(reg, "ia_serve_inflight")
+        s["ledger"] = {
+            k: _counter(reg, f"ia_serve_{k}_total")
+            for k in ("requests", "admitted", "completed", "failed",
+                      "shed", "dispatches")
+        }
+        s["hits"] = _counter(reg, "ia_serve_excache_hits_total")
+        s["misses"] = _counter(reg, "ia_serve_excache_misses_total")
+        s["sentinel"] = check_serving(reg.to_dict())
+    finally:
+        d.stop()
+    clear_compiled_level_caches()
+    return s
+
+
+# ------------------------------------------------- disk tier honesty
+class TestDiskRoundtrip:
+    def test_cold_request_misses_and_seals(self, persist_scenario):
+        s = persist_scenario
+        assert s["cold"]["status"] == "ok"
+        assert s["cold"]["cache"] == "miss"
+        assert s["cold_disk"]["entries"] == 1
+        assert s["cold_disk"]["stored"] >= 1
+        assert s["cold_disk"]["errors"] == 0
+        assert s["cold_sentinel"]["status"] == "ok"
+
+    def test_restart_restores_before_first_request(
+        self, persist_scenario
+    ):
+        s = persist_scenario
+        # restore_warm_set ran at start(): positive wall, executables
+        # already resident before the first client request arrived.
+        assert s["restore_ms"] is not None and s["restore_ms"] > 0
+        assert s["restored_loaded"] >= 1
+
+    def test_restored_verdict_is_disk_and_bit_identical(
+        self, persist_scenario
+    ):
+        s = persist_scenario
+        doc = s["restored"]
+        assert doc["status"] == "ok"
+        assert doc["cache"] == "disk"
+        assert "disk-restored" in [ev["name"] for ev in doc["spans"]]
+        assert s["restored_sha"] == s["cold_sha"]
+        # in-memory repeat is a plain hit — the three verdicts stay
+        # distinct populations.
+        assert s["restored_repeat"]["cache"] == "hit"
+        assert s["restore_sentinel"]["status"] == "ok"
+
+    def test_disk_counters_reconcile_with_memory_misses(
+        self, persist_scenario
+    ):
+        s = persist_scenario
+        assert s["restore_disk_hits"] == s["restore_mem_misses"] == 1
+
+    def test_access_log_attributes_restore_not_compile(
+        self, persist_scenario
+    ):
+        rec = persist_scenario["restore_access"]
+        assert rec is not None
+        assert rec["cache"] == "disk"
+        phases = dict(phase_fields(rec))
+        # restore is attributed in its own phase column (its value is
+        # ~0 here — the warm set was restored at daemon start, so the
+        # request itself paid nothing) and must NOT blend into the
+        # compile histogram: a "disk" verdict with nonzero compile
+        # would mean the restore was booked as a recompile.
+        assert "restore" in phases
+        assert phases.get("compile", 0) == 0
+
+    def test_corrupt_blob_honest_miss(self, persist_scenario):
+        s = persist_scenario
+        # restore counted the corruption, the request fell back to an
+        # honest recompile with the RIGHT answer, and the sentinel
+        # grades the tier degraded (not broken, not silently fine).
+        assert s["corrupt_restore_errors"] >= 1
+        assert s["corrupt"]["status"] == "ok"
+        assert s["corrupt"]["cache"] == "miss"
+        assert s["corrupt_sha"] == s["cold_sha"]
+        assert s["corrupt_error_counter"] >= 1
+        assert s["corrupt_sentinel"]["status"] == "degraded"
+
+    def test_eviction_leaves_disk_tier_intact(self, persist_scenario):
+        s = persist_scenario
+        # generation 4 starts on the re-sealed store: disk verdict,
+        # then hit.
+        assert s["reseal"]["cache"] == "disk"
+        assert s["reseal_repeat"]["cache"] == "hit"
+        # epoch eviction drops loaded executables but zero disk files;
+        # the next dispatch restores lazily.
+        assert s["evicted_loaded"] == 0
+        assert s["evicted_entries"] == 1
+        assert s["post_evict"]["cache"] == "disk"
+        assert s["post_evict_sha"] == s["cold_sha"]
+        assert s["evict_sentinel"]["status"] == "ok"
+
+
+class TestDiskCacheUnit:
+    def test_fingerprint_mismatch_invalidates_index(self, tmp_path):
+        root = str(tmp_path / "excache")
+        c1 = DiskExecCache(root)
+        if not c1.enabled:
+            pytest.skip("AOT serialization unavailable")
+        index = os.path.join(root, "index.json")
+        with open(index, "w") as f:
+            json.dump({
+                "schema_version": 1,
+                "fingerprint": "not-this-backend",
+                "entries": {"k": {"shape": [1], "warmup_shape": [1],
+                                  "blobs": []}},
+            }, f)
+        c2 = DiskExecCache(root)
+        # a foreign fingerprint is an invalidation, not an error
+        assert c2.snapshot()["entries"] == 0
+        assert c2.errors == 0
+
+    def test_unreadable_index_is_counted_error(self, tmp_path):
+        root = str(tmp_path / "excache")
+        os.makedirs(root)
+        with open(os.path.join(root, "index.json"), "w") as f:
+            f.write("{ torn")
+        c = DiskExecCache(root)
+        assert c.snapshot()["entries"] == 0
+        assert c.errors == 1
+
+    def test_backend_fingerprint_tracks_flag_seams(self, monkeypatch):
+        base = backend_fingerprint()
+        monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+        assert backend_fingerprint() != base
+
+
+# ---------------------------------------------------- pipelined path
+class TestPipelinedDispatch:
+    def test_burst_bit_identical_to_solo(self, pipeline_scenario):
+        s = pipeline_scenario
+        assert not s["failures"]
+        for i, doc in enumerate(s["burst"]):
+            assert doc["status"] == "ok"
+            assert _sha(doc) == s["solo"][i], (
+                f"frame {i} diverged under pipelined dispatch"
+            )
+
+    def test_window_visible_and_quiescent(self, pipeline_scenario):
+        s = pipeline_scenario
+        assert s["serving"]["pipeline"]["window"] == 2
+        assert s["serving"]["pipeline"]["inflight_batches"] == 0
+        assert s["gauge_inflight_batches"] == 0
+        assert s["gauge_inflight"] == 0
+
+    def test_ledger_balances_with_window_open(self, pipeline_scenario):
+        s = pipeline_scenario
+        led = s["ledger"]
+        assert led["requests"] == led["admitted"] + led["shed"]
+        assert led["admitted"] == led["completed"] + led["failed"]
+        assert led["failed"] == 0 and led["shed"] == 0
+        assert s["hits"] + s["misses"] == led["dispatches"]
+        assert s["sentinel"]["status"] == "ok"
+
+    def test_window_must_be_positive(self):
+        rng = np.random.default_rng(0)
+        a, ap = (rng.random((16, 16, 3)).astype(np.float32)
+                 for _ in range(2))
+        with pytest.raises(ValueError):
+            SynthDaemon(a, ap, SynthConfig(**_SERVE_CFG),
+                        registry=MetricsRegistry(),
+                        pipeline_window=0)
+
+
+# ---------------------------------------------------- parallel warmup
+def _key_fn(shape):
+    return (shape, "fp", "patchmatch", "none")
+
+
+class TestParallelWarmup:
+    def _entries(self, n):
+        return [
+            {"height": 24, "width": 24 + 8 * i, "channels": 3}
+            for i in range(n)
+        ]
+
+    def test_pool_runs_all_shapes_and_records_walls(self):
+        cache = ExecutableCache(capacity=8)
+        seen_threads = set()
+        lock = threading.Lock()
+
+        def dispatch(shape):
+            with lock:
+                seen_threads.add(threading.current_thread().name)
+
+        done = run_warmup(
+            self._entries(4), dispatch, cache,
+            key_fn=_key_fn, max_workers=4,
+        )
+        assert len(done) == 4
+        assert all(d["wall_ms"] >= 0 for d in done)
+        # the pool actually fanned out (thread names come from the
+        # warmup pool prefix)
+        assert any("ia-serve-warmup" in t for t in seen_threads)
+
+    def test_single_entry_stays_sequential(self):
+        cache = ExecutableCache(capacity=8)
+        names = []
+
+        def dispatch(shape):
+            names.append(threading.current_thread().name)
+
+        done = run_warmup(
+            self._entries(1), dispatch, cache,
+            key_fn=_key_fn, max_workers=4,
+        )
+        assert len(done) == 1
+        assert all("ia-serve-warmup" not in n for n in names)
+
+    def test_dedupes_by_key(self):
+        cache = ExecutableCache(capacity=8)
+        calls = []
+        lock = threading.Lock()
+
+        def dispatch(shape):
+            with lock:
+                calls.append(shape)
+
+        entries = self._entries(2) + self._entries(2)
+        run_warmup(entries, dispatch, cache, key_fn=_key_fn,
+                   max_workers=2)
+        assert len(calls) == 2
+
+
+# ------------------------------------------- validator + artifact
+def _valid_record():
+    return {
+        "schema_version": 1, "kind": "serve_persist", "round": 18,
+        "proxy_size": 32,
+        "persist": {
+            "cold_ms": 5000.0, "cold_restart_ms": 300.0,
+            "restart_speedup": 16.7, "warm_ms": 15.0,
+            "restore_ms": 200.0, "first_restart_cache": "disk",
+            "bit_identical": True,
+            "disk": {"hits": 1.0, "misses": 0.0, "errors": 0.0,
+                     "entries": 1},
+            "cache_misses": 1.0, "serving_check": "ok",
+        },
+        "pipeline": {
+            "window": 2, "requests": 6, "bit_identical": True,
+            "p50_warm_ms": 40.0, "p99_warm_ms": 60.0,
+            "inflight_batches_after": 0,
+            "ledger": {"requests": 7.0, "admitted": 7.0,
+                       "completed": 7.0, "failed": 0.0, "shed": 0.0,
+                       "dispatches": 7.0, "hits": 6.0, "misses": 1.0},
+            "serving_check": "ok",
+        },
+    }
+
+
+class TestCheckServePersist:
+    def test_valid_record_passes(self):
+        assert validate_serve_persist(_valid_record()) == []
+
+    def test_slow_restart_fails_the_10x_gate(self):
+        rec = _valid_record()
+        rec["persist"]["cold_restart_ms"] = 501.0
+        assert any("10x" in e for e in validate_serve_persist(rec))
+
+    def test_recompiled_restart_rejected(self):
+        rec = _valid_record()
+        rec["persist"]["first_restart_cache"] = "miss"
+        assert any("disk" in e for e in validate_serve_persist(rec))
+
+    def test_bit_divergence_rejected_both_arms(self):
+        rec = _valid_record()
+        rec["persist"]["bit_identical"] = False
+        rec["pipeline"]["bit_identical"] = False
+        errs = validate_serve_persist(rec)
+        assert sum("bit_identical" in e for e in errs) == 2
+
+    def test_unreconciled_disk_counters_rejected(self):
+        rec = _valid_record()
+        rec["persist"]["disk"]["misses"] = 3.0
+        assert any("probed exactly once" in e
+                   for e in validate_serve_persist(rec))
+
+    def test_solo_window_rejected(self):
+        rec = _valid_record()
+        rec["pipeline"]["window"] = 1
+        assert any("window" in e for e in validate_serve_persist(rec))
+
+    def test_unbalanced_ledger_rejected(self):
+        rec = _valid_record()
+        rec["pipeline"]["ledger"]["completed"] = 5.0
+        assert any("admitted" in e for e in validate_serve_persist(rec))
+
+
+class TestCommittedArtifact:
+    def test_serve_r18_valid(self):
+        path = os.path.join(_REPO_ROOT, "SERVE_r18.json")
+        assert os.path.exists(path), (
+            "SERVE_r18.json missing — regenerate with "
+            "python tools/serve_load.py --persist-out SERVE_r18.json"
+        )
+        with open(path) as f:
+            record = json.load(f)
+        assert validate_serve_persist(record) == []
+        assert record["round"] >= 18
+        # the headline: the restart really did beat the cold compile
+        # by the gated factor
+        p = record["persist"]
+        assert p["cold_ms"] >= 10.0 * p["cold_restart_ms"]
+
+    def test_checker_cli_accepts_committed_artifact(self, capsys):
+        path = os.path.join(_REPO_ROOT, "SERVE_r18.json")
+        assert check_persist_main([path]) == 0
+        assert "OK" in capsys.readouterr().out

@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Validate a SERVE_r18.json persistent-cache / pipelined-dispatch
+artifact (round 18).
+
+The round-18 acceptance bar, enforced by a validator instead of
+trusted to prose:
+
+  - RESTART arm: a fresh process over a populated disk executable
+    cache must answer its FIRST request >= 10x faster than the
+    cold-compile path (`cold_ms >= 10 * cold_restart_ms`), the first
+    request's verdict must be `disk` (it ran deserialized executables,
+    not a recompile), its response must be BIT-IDENTICAL to the
+    fresh-compile response (`bit_identical` pins the sha256 pair),
+    zero disk errors, and the disk counters must reconcile with the
+    in-memory cache (disk hits + disk misses == in-memory misses).
+  - PIPELINE arm: the concurrent burst through a window > 1 must stay
+    bit-identical to solo dispatch (the round-13 isolation contract),
+    its ledger must balance (requests == admitted + shed; completed +
+    failed + shed == requests when nothing was cancelled), p50 <= p99,
+    and the occupancy gauge must have returned to zero.
+  - Both arms' final registries must grade `ok` under the sentinel's
+    own serving check — an artifact the daemon's invariants reject is
+    a bug report, not a benchmark.
+
+Usage:
+    python tools/check_serve_persist.py SERVE_r18.json
+
+Runs under pytest too (tests/test_serving_persist.py validates the
+COMMITTED artifact) so tier-1 fails if the record is missing,
+truncated, or structurally degraded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+SERVE_PERSIST_SCHEMA_VERSION = 1
+RESTART_SPEEDUP_MIN = 10.0
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_serve_persist(record: dict) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if record.get("schema_version") != SERVE_PERSIST_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {record.get('schema_version')!r} != "
+            f"{SERVE_PERSIST_SCHEMA_VERSION}"
+        )
+    if record.get("kind") != "serve_persist":
+        errs.append(f"kind {record.get('kind')!r} != 'serve_persist'")
+    size = record.get("proxy_size")
+    if not (_num(size) and size >= 16):
+        errs.append(f"proxy_size {size!r} is not a size >= 16")
+
+    # ------------------------------------------------- restart arm
+    p = record.get("persist")
+    if not isinstance(p, dict):
+        errs.append("persist: missing object")
+        p = {}
+    cold = p.get("cold_ms")
+    restart = p.get("cold_restart_ms")
+    warm = p.get("warm_ms")
+    if not (_num(cold) and cold > 0):
+        errs.append(f"persist.cold_ms {cold!r} is not > 0")
+    if not (_num(restart) and restart > 0):
+        errs.append(
+            f"persist.cold_restart_ms {restart!r} is not > 0"
+        )
+    if _num(cold) and _num(restart) and \
+            cold < RESTART_SPEEDUP_MIN * restart:
+        errs.append(
+            f"persist: cold_ms {cold} < {RESTART_SPEEDUP_MIN:.0f}x "
+            f"cold_restart_ms {restart} — the restart-with-populated-"
+            "disk-cache first request must be >= 10x faster than the "
+            "cold compile (the tentpole's acceptance gate)"
+        )
+    if not (_num(warm) and warm > 0):
+        errs.append(f"persist.warm_ms {warm!r} is not > 0")
+    elif _num(cold) and cold < RESTART_SPEEDUP_MIN * warm:
+        errs.append(
+            f"persist: cold_ms {cold} < {RESTART_SPEEDUP_MIN:.0f}x "
+            f"warm_ms {warm} — the in-memory hit after the restore "
+            "must beat the cold compile at least as hard as the "
+            "restore did"
+        )
+    if p.get("first_restart_cache") != "disk":
+        errs.append(
+            f"persist.first_restart_cache "
+            f"{p.get('first_restart_cache')!r} != 'disk' — the "
+            "restarted daemon's first request must run deserialized "
+            "executables, not recompile"
+        )
+    if p.get("bit_identical") is not True:
+        errs.append(
+            "persist.bit_identical is not true — the restored "
+            "executable's response must match the fresh-compile "
+            "response byte for byte"
+        )
+    if not (_num(p.get("restore_ms")) and p["restore_ms"] >= 0):
+        errs.append(
+            f"persist.restore_ms {p.get('restore_ms')!r} is not a "
+            "non-negative number"
+        )
+    disk = p.get("disk")
+    if not isinstance(disk, dict):
+        errs.append("persist.disk: missing object")
+        disk = {}
+    for k in ("hits", "misses", "errors"):
+        if not (_num(disk.get(k)) and disk.get(k) >= 0):
+            errs.append(
+                f"persist.disk.{k} {disk.get(k)!r} is not a "
+                "non-negative number"
+            )
+    if _num(disk.get("errors")) and disk["errors"] != 0:
+        errs.append(
+            f"persist.disk.errors {disk['errors']} != 0 — the restart "
+            "arm must restore cleanly (corrupt-blob handling is the "
+            "test suite's job, not the benchmark's)"
+        )
+    mem_misses = p.get("cache_misses")
+    if all(_num(v) for v in (disk.get("hits"), disk.get("misses"),
+                             mem_misses)):
+        if disk["hits"] + disk["misses"] != mem_misses:
+            errs.append(
+                f"persist: disk hits {disk['hits']} + disk misses "
+                f"{disk['misses']} != in-memory misses {mem_misses} — "
+                "the disk tier must be probed exactly once per "
+                "in-memory miss"
+            )
+    else:
+        errs.append(
+            "persist: disk.hits/disk.misses/cache_misses must all be "
+            "numbers (the reconciliation ledger)"
+        )
+    if p.get("serving_check") != "ok":
+        errs.append(
+            f"persist.serving_check {p.get('serving_check')!r} != "
+            "'ok'"
+        )
+
+    # ------------------------------------------------ pipeline arm
+    pl = record.get("pipeline")
+    if not isinstance(pl, dict):
+        errs.append("pipeline: missing object")
+        pl = {}
+    win = pl.get("window")
+    if not (_num(win) and win > 1):
+        errs.append(
+            f"pipeline.window {win!r} is not > 1 — the pipeline arm "
+            "must actually open the in-flight window"
+        )
+    if pl.get("bit_identical") is not True:
+        errs.append(
+            "pipeline.bit_identical is not true — pipelined responses "
+            "must match solo dispatch byte for byte (the round-13 "
+            "isolation contract is the pin)"
+        )
+    if not (_num(pl.get("requests")) and pl["requests"] >= 2):
+        errs.append(
+            f"pipeline.requests {pl.get('requests')!r} is not a "
+            "count >= 2"
+        )
+    p50, p99 = pl.get("p50_warm_ms"), pl.get("p99_warm_ms")
+    if not (_num(p50) and _num(p99)):
+        errs.append(
+            f"pipeline.p50_warm_ms/p99_warm_ms {p50!r}/{p99!r} must "
+            "be numbers"
+        )
+    elif p50 > p99:
+        errs.append(f"pipeline: p50_warm_ms {p50} > p99_warm_ms {p99}")
+    if pl.get("inflight_batches_after") != 0:
+        errs.append(
+            f"pipeline.inflight_batches_after "
+            f"{pl.get('inflight_batches_after')!r} != 0 — the "
+            "occupancy gauge must return to zero at quiescence"
+        )
+    ledger = pl.get("ledger")
+    if not isinstance(ledger, dict):
+        errs.append("pipeline.ledger: missing object")
+        ledger = {}
+    if all(_num(ledger.get(k)) for k in ("requests", "admitted",
+                                         "shed")):
+        if ledger["requests"] != ledger["admitted"] + ledger["shed"]:
+            errs.append(
+                f"pipeline.ledger: requests {ledger['requests']} != "
+                f"admitted {ledger['admitted']} + shed "
+                f"{ledger['shed']}"
+            )
+    else:
+        errs.append(
+            "pipeline.ledger: requests/admitted/shed must be numbers"
+        )
+    if all(_num(ledger.get(k)) for k in ("admitted", "completed",
+                                         "failed")):
+        if ledger["admitted"] != ledger["completed"] + \
+                ledger["failed"]:
+            errs.append(
+                f"pipeline.ledger: admitted {ledger['admitted']} != "
+                f"completed {ledger['completed']} + failed "
+                f"{ledger['failed']}"
+            )
+    else:
+        errs.append(
+            "pipeline.ledger: admitted/completed/failed must be "
+            "numbers"
+        )
+    if all(_num(ledger.get(k)) for k in ("hits", "misses",
+                                         "dispatches")):
+        if ledger["hits"] + ledger["misses"] != ledger["dispatches"]:
+            errs.append(
+                f"pipeline.ledger: hits {ledger['hits']} + misses "
+                f"{ledger['misses']} != dispatches "
+                f"{ledger['dispatches']} — every dispatch consults "
+                "the cache exactly once, window open or not"
+            )
+    else:
+        errs.append(
+            "pipeline.ledger: hits/misses/dispatches must be numbers"
+        )
+    if pl.get("serving_check") != "ok":
+        errs.append(
+            f"pipeline.serving_check {pl.get('serving_check')!r} != "
+            "'ok'"
+        )
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="SERVE_r18.json to validate")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"check_serve_persist: cannot read {args.path}: {e}")
+        return 1
+    errs = validate_serve_persist(record)
+    if errs:
+        print(f"check_serve_persist: {args.path} INVALID:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    p = record.get("persist", {})
+    pl = record.get("pipeline", {})
+    print(
+        f"check_serve_persist: {args.path} OK (cold "
+        f"{p.get('cold_ms')} ms -> restart {p.get('cold_restart_ms')} "
+        f"ms, {p.get('restart_speedup')}x; pipeline window "
+        f"{pl.get('window')} p99 warm {pl.get('p99_warm_ms')} ms, "
+        "bit-identical both arms)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

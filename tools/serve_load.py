@@ -31,20 +31,38 @@ and reconstructs the warm probe's critical path from the daemon's
 structured access log — validated with tools/check_slo.py (phase
 attribution must sum within 5% of measured latency) before the write.
 
+Round 18 adds `--persist-out SERVE_r18.json`: the persistent
+executable cache + pipelined dispatch artifact.  The RESTART arm runs
+two real subprocesses over one shared `--state-dir` (a subprocess per
+phase is not ceremony: any in-process "restart" would keep jax's lru
+caches warm and fake the number) — the first cold-compiles and seals
+the disk tier, the second restores the warm set at start and answers
+its FIRST client request from deserialized executables (`cache:
+"disk"`, no warmup call, so the wall IS the cold-restart latency).
+The PIPELINE arm replays the same frames through a solo window=1
+daemon and a window>1 daemon under a concurrent burst and pins
+bit-identity plus the admission/dispatch ledger.  Validated with
+tools/check_serve_persist.py (the 10x restart gate lives there)
+before the write.
+
 Usage:
     python tools/serve_load.py --out SERVE_r13.json [--size 32]
     python tools/serve_load.py --out /tmp/serve.json \\
         --slo-out SLO_r15.json
+    python tools/serve_load.py --persist-out SERVE_r18.json
 """
 
 from __future__ import annotations
 
 import argparse
 import base64
+import hashlib
 import json
 import os
 import statistics
+import subprocess
 import sys
+import tempfile
 import threading
 import time
 import urllib.error
@@ -54,6 +72,7 @@ from typing import List, Optional, Tuple
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from check_serve import validate_serve  # noqa: E402
+from check_serve_persist import validate_serve_persist  # noqa: E402
 from check_slo import validate_slo  # noqa: E402
 
 
@@ -87,6 +106,43 @@ def _counter_total(snap: dict, name: str) -> float:
         v for v in snap.get(name, {}).get("values", {}).values()
         if isinstance(v, (int, float))
     ))
+
+
+def _make_inputs(seed: int, size: int):
+    """Deterministic (a, a', b) triple — both restart-arm subprocesses
+    rebuild the exact same frames from (seed, size) alone, so the
+    sha256 comparison pins bit-identity across process boundaries."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return tuple(
+        rng.random((size, size, 3)).astype(np.float32) for _ in range(3)
+    )
+
+
+def _frame_body(frame) -> bytes:
+    import numpy as np
+
+    return json.dumps({
+        "image_b64": base64.b64encode(
+            np.ascontiguousarray(frame).tobytes()
+        ).decode(),
+        "shape": list(frame.shape),
+        "dtype": "float32",
+    }).encode()
+
+
+def _sha(doc: dict) -> str:
+    return hashlib.sha256(
+        base64.b64decode(doc["image_b64"])
+    ).hexdigest()
+
+
+def _serving_check(daemon) -> str:
+    health = daemon.health()
+    return next(
+        c["status"] for c in health["checks"] if c["name"] == "serving"
+    )
 
 
 def run_load(args) -> dict:
@@ -318,13 +374,328 @@ def run_load(args) -> dict:
         set_registry(prev)
 
 
+def run_persist_phase(args) -> int:
+    """Subprocess body for the restart arm (`--phase persist-cold` /
+    `persist-restart`).  Runs one daemon over the shared --state-dir,
+    posts the probe request(s), and writes measurements + registry
+    counters to --json-out for the driver to assemble.
+
+    The restart phase deliberately never calls `daemon.warmup()`: the
+    first client request must pay whatever the restore left unpaid, so
+    its wall clock IS the cold-restart latency the artifact claims.
+    """
+    from image_analogies_tpu.config import SynthConfig
+    from image_analogies_tpu.serving.daemon import SynthDaemon
+    from image_analogies_tpu.telemetry.metrics import (
+        MetricsRegistry,
+        set_registry,
+    )
+
+    a, ap_img, b = _make_inputs(args.seed, args.size)
+    cfg = SynthConfig(
+        levels=2, matcher="patchmatch", pallas_mode="off",
+        em_iters=1, pm_iters=2,
+    )
+    body = _frame_body(b)
+    registry = MetricsRegistry()
+    prev = set_registry(registry)
+    daemon = SynthDaemon(
+        a, ap_img, cfg, registry=registry, max_batch=1,
+        max_wait_ms=1.0, observability=False,
+        state_dir=args.state_dir,
+    ).start()
+    try:
+        expect = "miss" if args.phase == "persist-cold" else "disk"
+        t0 = time.perf_counter()
+        code, r = _post(daemon.url, body)
+        first_ms = (time.perf_counter() - t0) * 1000.0
+        if code != 200 or r.get("cache") != expect:
+            raise RuntimeError(
+                f"{args.phase}: expected 200/{expect}, got {code}/"
+                f"{r.get('cache')!r} ({r.get('error')})"
+            )
+        out = {
+            "phase": args.phase,
+            "first_ms": round(first_ms, 3),
+            "first_cache": r["cache"],
+            "sha256": _sha(r),
+        }
+        if args.phase == "persist-restart":
+            t0 = time.perf_counter()
+            code, r2 = _post(daemon.url, body)
+            warm_ms = (time.perf_counter() - t0) * 1000.0
+            if code != 200 or r2.get("cache") != "hit":
+                raise RuntimeError(
+                    f"{args.phase}: warm repeat expected 200/hit, got "
+                    f"{code}/{r2.get('cache')!r} ({r2.get('error')})"
+                )
+            if _sha(r2) != out["sha256"]:
+                raise RuntimeError(
+                    f"{args.phase}: warm repeat diverged from the "
+                    "restored response"
+                )
+            out["warm_ms"] = round(warm_ms, 3)
+            out["restore_ms"] = daemon.disk.restore_ms
+        snap = registry.to_dict()
+        disk_snap = daemon.disk.snapshot()
+        out.update({
+            "disk": {
+                "hits": _counter_total(
+                    snap, "ia_excache_disk_hits_total"
+                ),
+                "misses": _counter_total(
+                    snap, "ia_excache_disk_misses_total"
+                ),
+                "errors": _counter_total(
+                    snap, "ia_excache_disk_errors_total"
+                ),
+                "entries": disk_snap["entries"],
+                "stored": disk_snap["stored"],
+            },
+            "cache_misses": _counter_total(
+                snap, "ia_serve_excache_misses_total"
+            ),
+            "serving_check": _serving_check(daemon),
+        })
+    finally:
+        daemon.stop()
+        set_registry(prev)
+    _write_json(args.json_out, out)
+    print(f"serve_load[{args.phase}]: first request "
+          f"{out['first_cache']!r} in {out['first_ms']:.1f} ms",
+          flush=True)
+    return 0
+
+
+def _spawn_phase(phase: str, state_dir: str, json_out: str,
+                 args) -> dict:
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--phase", phase, "--state-dir", state_dir,
+        "--json-out", json_out,
+        "--size", str(args.size), "--seed", str(args.seed),
+    ]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(cmd, env=env, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"persist phase {phase!r} exited {proc.returncode}"
+        )
+    with open(json_out) as f:
+        return json.load(f)
+
+
+def _run_pipeline_arm(args) -> dict:
+    """Pipelined-dispatch arm: replay N distinct frames through a solo
+    window=1 daemon, then the same frames as a concurrent burst
+    through a window>1 daemon, and pin bit-identity + the ledger."""
+    import numpy as np
+
+    from image_analogies_tpu.config import SynthConfig
+    from image_analogies_tpu.serving.daemon import SynthDaemon
+    from image_analogies_tpu.telemetry.metrics import (
+        MetricsRegistry,
+        set_registry,
+    )
+
+    a, ap_img, _ = _make_inputs(args.seed, args.size)
+    rng = np.random.default_rng(args.seed + 1)
+    frames = [
+        rng.random((args.size, args.size, 3)).astype(np.float32)
+        for _ in range(6)
+    ]
+    bodies = [_frame_body(f) for f in frames]
+    cfg = SynthConfig(
+        levels=2, matcher="patchmatch", pallas_mode="off",
+        em_iters=1, pm_iters=2,
+    )
+
+    # -- solo baseline: window=1 serializes dispatch and settle.
+    reg0 = MetricsRegistry()
+    prev = set_registry(reg0)
+    d0 = SynthDaemon(
+        a, ap_img, cfg, registry=reg0, max_batch=1, max_wait_ms=1.0,
+        observability=False, pipeline_window=1,
+    ).start()
+    try:
+        solo = []
+        for bd in bodies:
+            code, r = _post(d0.url, bd)
+            if code != 200:
+                raise RuntimeError(
+                    f"pipeline solo baseline: {code} ({r.get('error')})"
+                )
+            solo.append(_sha(r))
+    finally:
+        d0.stop()
+        set_registry(prev)
+
+    # -- pipelined burst: window>1, all frames posted concurrently.
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    daemon = SynthDaemon(
+        a, ap_img, cfg, registry=reg, max_batch=1, max_wait_ms=1.0,
+        max_queue_depth=32, observability=False,
+        pipeline_window=args.pipeline_window,
+    ).start()
+    try:
+        code, r = _post(daemon.url, bodies[0])  # compile the shape
+        if code != 200:
+            raise RuntimeError(
+                f"pipeline warm request: {code} ({r.get('error')})"
+            )
+        results: List[Optional[dict]] = [None] * len(bodies)
+        lat_ms: List[float] = []
+        lock = threading.Lock()
+        failures: List[str] = []
+
+        def client(i: int) -> None:
+            t0 = time.perf_counter()
+            try:
+                code, r = _post(daemon.url, bodies[i])
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    failures.append(f"frame {i}: {e!r}")
+                return
+            wall = (time.perf_counter() - t0) * 1000.0
+            with lock:
+                if code != 200:
+                    failures.append(
+                        f"frame {i}: {code} ({r.get('error')})"
+                    )
+                else:
+                    results[i] = r
+                    lat_ms.append(wall)
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(len(bodies))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if failures:
+            raise RuntimeError(f"pipeline burst failed: {failures}")
+        bit_identical = all(
+            _sha(results[i]) == solo[i] for i in range(len(bodies))
+        )
+        p50, p99 = _quantiles(lat_ms)
+        snap = reg.to_dict()
+        ledger = {
+            k: _counter_total(snap, f"ia_serve_{k}_total")
+            for k in ("requests", "admitted", "completed", "failed",
+                      "shed", "dispatches")
+        }
+        ledger["hits"] = _counter_total(
+            snap, "ia_serve_excache_hits_total"
+        )
+        ledger["misses"] = _counter_total(
+            snap, "ia_serve_excache_misses_total"
+        )
+        inflight_after = int(sum(
+            v for v in snap.get(
+                "ia_serve_pipeline_inflight_batches", {}
+            ).get("values", {}).values()
+            if isinstance(v, (int, float))
+        ))
+        arm = {
+            "window": args.pipeline_window,
+            "requests": len(bodies),
+            "bit_identical": bit_identical,
+            "p50_warm_ms": p50,
+            "p99_warm_ms": p99,
+            "inflight_batches_after": inflight_after,
+            "ledger": ledger,
+            "serving_check": _serving_check(daemon),
+        }
+    finally:
+        daemon.stop()
+        set_registry(prev)
+    print(
+        f"serve_load: pipeline window={arm['window']} "
+        f"bit_identical={arm['bit_identical']} p50={p50} p99={p99} "
+        f"ledger={ledger}", flush=True,
+    )
+    return arm
+
+
+def run_persist(args) -> dict:
+    """Driver for the round-18 artifact: subprocess restart arm +
+    in-process pipeline arm, assembled into one serve_persist record.
+    """
+    state = tempfile.mkdtemp(prefix="serve-persist-")
+    cold = _spawn_phase(
+        "persist-cold", state, os.path.join(state, "cold.json"), args
+    )
+    restart = _spawn_phase(
+        "persist-restart", state,
+        os.path.join(state, "restart.json"), args,
+    )
+    if cold["serving_check"] != "ok":
+        raise RuntimeError(
+            f"persist-cold serving check {cold['serving_check']!r}"
+        )
+    pipeline = _run_pipeline_arm(args)
+    cold_ms = cold["first_ms"]
+    restart_ms = restart["first_ms"]
+    record = {
+        "schema_version": 1,
+        "kind": "serve_persist",
+        "round": 18,
+        "proxy_size": args.size,
+        "config": {
+            "levels": 2, "matcher": "patchmatch",
+            "em_iters": 1, "pm_iters": 2,
+            "pipeline_window": args.pipeline_window,
+        },
+        "persist": {
+            "cold_ms": cold_ms,
+            "cold_restart_ms": restart_ms,
+            "restart_speedup": round(cold_ms / restart_ms, 1),
+            "warm_ms": restart["warm_ms"],
+            "restore_ms": restart["restore_ms"],
+            "first_restart_cache": restart["first_cache"],
+            "bit_identical": restart["sha256"] == cold["sha256"],
+            "disk": {
+                "hits": restart["disk"]["hits"],
+                "misses": restart["disk"]["misses"],
+                "errors": restart["disk"]["errors"],
+                "entries": restart["disk"]["entries"],
+            },
+            "cache_misses": restart["cache_misses"],
+            "serving_check": restart["serving_check"],
+        },
+        "pipeline": pipeline,
+    }
+    return record
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--out", required=True,
+    ap.add_argument("--out", default=None,
                     help="where to write SERVE_r13.json")
     ap.add_argument("--slo-out", default=None, metavar="PATH",
                     help="also write an SLO_r15.json SLO/critical-path "
                     "artifact from the same run (round 15)")
+    ap.add_argument("--persist-out", default=None, metavar="PATH",
+                    help="write a SERVE_r18.json persistent-cache + "
+                    "pipelined-dispatch artifact (round 18; subprocess "
+                    "restart arm + in-process pipeline arm)")
+    ap.add_argument("--pipeline-window", type=int, default=2,
+                    help="in-flight batch window for the round-18 "
+                    "pipeline arm (must be > 1)")
+    # Internal flags: the restart arm re-invokes this script as a
+    # subprocess per phase (an in-process restart would keep jax's lru
+    # caches warm and fake the cold-restart number).
+    ap.add_argument("--phase", default=None,
+                    choices=["persist-cold", "persist-restart"],
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--state-dir", default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--json-out", default=None,
+                    help=argparse.SUPPRESS)
     ap.add_argument("--size", type=int, default=32,
                     help="proxy image edge (default 32)")
     ap.add_argument("--max-batch", type=int, default=2)
@@ -337,41 +708,72 @@ def main(argv=None) -> int:
     ap.add_argument("--requests-per-client", type=int, default=3)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
-    args.clients = [int(c) for c in str(args.clients).split(",")]
-    if max(args.clients) <= args.max_queue_depth:
-        print(
-            "serve_load: largest client count must exceed "
-            f"--max-queue-depth ({args.max_queue_depth}) or the "
-            "overload arm cannot shed"
-        )
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.phase:
+        if not (args.state_dir and args.json_out):
+            print("serve_load: --phase needs --state-dir + --json-out")
+            return 1
+        return run_persist_phase(args)
+
+    if not (args.out or args.persist_out):
+        print("serve_load: need at least one of --out / --persist-out")
         return 1
 
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    record, slo_record = run_load(args)
-    errs = validate_serve(record)
-    if errs:
-        print("serve_load: generated record INVALID:")
-        for e in errs:
-            print(f"  - {e}")
-        return 1
-    if args.slo_out:
-        slo_errs = validate_slo(slo_record)
-        if slo_errs:
-            print("serve_load: generated SLO record INVALID:")
-            for e in slo_errs:
+    if args.out:
+        args.clients = [int(c) for c in str(args.clients).split(",")]
+        if max(args.clients) <= args.max_queue_depth:
+            print(
+                "serve_load: largest client count must exceed "
+                f"--max-queue-depth ({args.max_queue_depth}) or the "
+                "overload arm cannot shed"
+            )
+            return 1
+        record, slo_record = run_load(args)
+        errs = validate_serve(record)
+        if errs:
+            print("serve_load: generated record INVALID:")
+            for e in errs:
                 print(f"  - {e}")
             return 1
-    _write_json(args.out, record)
-    print(
-        f"serve_load: wrote {args.out} (compile saved "
-        f"{record['cache']['latency_delta_ms']} ms; ledger "
-        f"{record['ledger']})"
-    )
-    if args.slo_out:
-        _write_json(args.slo_out, slo_record)
+        if args.slo_out:
+            slo_errs = validate_slo(slo_record)
+            if slo_errs:
+                print("serve_load: generated SLO record INVALID:")
+                for e in slo_errs:
+                    print(f"  - {e}")
+                return 1
+        _write_json(args.out, record)
         print(
-            f"serve_load: wrote {args.slo_out} (verdict "
-            f"{slo_record['slo']['verdict']!r})"
+            f"serve_load: wrote {args.out} (compile saved "
+            f"{record['cache']['latency_delta_ms']} ms; ledger "
+            f"{record['ledger']})"
+        )
+        if args.slo_out:
+            _write_json(args.slo_out, slo_record)
+            print(
+                f"serve_load: wrote {args.slo_out} (verdict "
+                f"{slo_record['slo']['verdict']!r})"
+            )
+
+    if args.persist_out:
+        if args.pipeline_window < 2:
+            print("serve_load: --pipeline-window must be > 1")
+            return 1
+        persist_record = run_persist(args)
+        perrs = validate_serve_persist(persist_record)
+        if perrs:
+            print("serve_load: generated persist record INVALID:")
+            for e in perrs:
+                print(f"  - {e}")
+            return 1
+        _write_json(args.persist_out, persist_record)
+        p = persist_record["persist"]
+        print(
+            f"serve_load: wrote {args.persist_out} (cold "
+            f"{p['cold_ms']} ms -> restart {p['cold_restart_ms']} ms, "
+            f"{p['restart_speedup']}x; pipeline p99 "
+            f"{persist_record['pipeline']['p99_warm_ms']} ms)"
         )
     return 0
 
