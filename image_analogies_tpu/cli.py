@@ -804,9 +804,24 @@ def cmd_serve(args) -> int:
     # trace over an unbounded lifetime), artifacts + flight recorder
     # into --trace-dir.  SIGTERM flushes flight.json then re-delivers
     # (telemetry/flight.py), so a killed daemon leaves a post-mortem.
+    anomaly_config = None
+    if args.baseline:
+        from .telemetry.anomaly import (
+            AnomalyConfig,
+            baseline_from_record,
+        )
+
+        baseline_p99 = baseline_from_record(args.baseline)
+        if baseline_p99 is None:
+            print(
+                f"serve: --baseline {args.baseline}: no "
+                "pipeline.p99_warm_ms — latency watch will report "
+                "no_data", file=sys.stderr,
+            )
+        anomaly_config = AnomalyConfig(baseline_p99_ms=baseline_p99)
     with telemetry_session(
         None, enabled=True, artifact_dir=args.trace_dir,
-        metrics_port=None,
+        metrics_port=None, flight_capacity=args.flight_ring,
     ) as tracer:
         daemon = SynthDaemon(
             a, ap, cfg,
@@ -834,6 +849,9 @@ def cmd_serve(args) -> int:
             dispatch_deadline_s=args.dispatch_deadline_s,
             pipeline_window=args.pipeline_window,
             warmup_workers=args.warmup_workers,
+            obs_interval_s=args.obs_interval_s,
+            obs_capacity=args.obs_capacity,
+            anomaly_config=anomaly_config,
         )
         try:
             daemon.start()
@@ -874,8 +892,8 @@ def cmd_serve(args) -> int:
                 daemon.live.announce(args.trace_dir)
             print(
                 f"serving on {daemon.url} (POST /synthesize /drain; "
-                "GET /serving /slo /journal /metrics /healthz "
-                "/progress)",
+                "GET /serving /slo /journal /obs/window /request "
+                "/metrics /metrics.json /healthz /progress)",
                 flush=True,
             )
             while not daemon.drained.wait(1.0):
@@ -977,35 +995,73 @@ def cmd_trace(args) -> int:
     (queue/compile/execute/demux millis the daemon booked at response
     time), joined — when the artifacts exist — with the request's
     `serve_request` span tree from flight.json for the span-side view.
+    Round 19: `--url` asks a LIVE daemon instead (GET /request?id=),
+    so tracing needs no filesystem access to the daemon's artifacts.
     Prints a phase-attributed waterfall; exits nonzero when the id is
-    not in the (possibly rotated) log."""
+    not in the (possibly rotated) log / not known to the daemon."""
     import json
 
-    from .serving.accesslog import find_request, phase_fields
+    from .serving.accesslog import phase_fields
 
-    log_path = args.access_log or os.path.join(
-        args.trace_dir, "access.jsonl"
-    )
-    rec = find_request(log_path, args.request_id)
-    if rec is None:
+    if bool(args.url) == bool(args.trace_dir):
         raise SystemExit(
-            f"trace: request {args.request_id!r} not found in "
-            f"{log_path} (or its .1 rotation)"
+            "trace: exactly one of --url (live daemon) or --trace-dir "
+            "(post-mortem artifacts) is required"
         )
-    # Optional flight-side join: the daemon replays each settled
-    # request's span tree through the flight recorder, so a request
-    # still inside the ring's window has events here too.
-    flight_evs = []
-    flight_path = os.path.join(args.trace_dir, "flight.json")
-    if os.path.exists(flight_path):
-        from .telemetry.flight import read_flight, request_events
+    if args.url:
+        import urllib.error
+        import urllib.parse
+        import urllib.request
 
+        base = args.url.rstrip("/")
+        if not base.startswith(("http://", "https://")):
+            base = "http://" + base
+        url = (base + "/request?id="
+               + urllib.parse.quote(args.request_id, safe=""))
         try:
-            flight_evs = request_events(
-                read_flight(flight_path), args.request_id
+            with urllib.request.urlopen(url, timeout=10.0) as resp:
+                doc = json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read().decode("utf-8")).get(
+                    "error", ""
+                )
+            except (ValueError, OSError):
+                detail = ""
+            raise SystemExit(
+                f"trace: request {args.request_id!r}: daemon answered "
+                f"{e.code}" + (f" ({detail})" if detail else "")
             )
-        except (OSError, ValueError):
-            flight_evs = []
+        except (urllib.error.URLError, OSError) as e:
+            raise SystemExit(f"trace: cannot reach {args.url}: {e}")
+        rec = doc.get("request") or {}
+        flight_evs = doc.get("flight_events") or []
+    else:
+        from .serving.accesslog import find_request
+
+        log_path = args.access_log or os.path.join(
+            args.trace_dir, "access.jsonl"
+        )
+        rec = find_request(log_path, args.request_id)
+        if rec is None:
+            raise SystemExit(
+                f"trace: request {args.request_id!r} not found in "
+                f"{log_path} (or its .1 rotation)"
+            )
+        # Optional flight-side join: the daemon replays each settled
+        # request's span tree through the flight recorder, so a request
+        # still inside the ring's window has events here too.
+        flight_evs = []
+        flight_path = os.path.join(args.trace_dir, "flight.json")
+        if os.path.exists(flight_path):
+            from .telemetry.flight import read_flight, request_events
+
+            try:
+                flight_evs = request_events(
+                    read_flight(flight_path), args.request_id
+                )
+            except (OSError, ValueError):
+                flight_evs = []
     if args.format == "json":
         print(json.dumps(
             {"access": rec, "flight_events": flight_evs}, indent=1
@@ -1046,6 +1102,44 @@ def cmd_trace(args) -> int:
             if root and root.get("wall_ms") is not None else ""
         )
         print(f"  flight: {len(flight_evs)} span events{extra}")
+    return 0
+
+
+def cmd_obs(args) -> int:
+    """Multi-replica serving observatory (round 19): scrape every
+    target daemon's /metrics.json + /slo + /obs/window, pool the
+    registries (sum counters, merge histogram buckets — fleet burn
+    rates are request-weighted, never replica-averaged), render the
+    terminal dashboard, and optionally write the OBS record
+    tools/check_obs.py validates.  Exits 1 when no target answered or
+    the pooled SLO verdict is violated."""
+    import json
+
+    from .serving.observatory import (
+        aggregate,
+        parse_targets,
+        render_dashboard,
+        write_obs,
+    )
+
+    try:
+        targets = parse_targets(args.targets)
+    except ValueError as e:
+        raise SystemExit(f"obs: {e}")
+    record = aggregate(targets, span_s=args.span, timeout=args.timeout)
+    if args.format == "json":
+        print(json.dumps(record, indent=1))
+    else:
+        print(render_dashboard(record), end="")
+    if args.out:
+        write_obs(record, args.out)
+        print(f"obs: wrote {args.out}", file=sys.stderr)
+    fleet = record.get("fleet") or {}
+    if not fleet.get("replicas_live"):
+        print("obs: no live replicas", file=sys.stderr)
+        return 1
+    if (fleet.get("slo") or {}).get("verdict") == "violated":
+        return 1
     return 0
 
 
@@ -1231,8 +1325,63 @@ def main(argv=None) -> int:
         "before the endpoint announces (round 18; default 4, 1 = "
         "sequential)",
     )
+    p.add_argument(
+        "--obs-interval-s", type=float, default=5.0, metavar="S",
+        help="time-series ring sampling interval (round 19): every S "
+        "seconds the registry snapshots into the windowed-rate ring "
+        "GET /obs/window serves, and the anomaly watches re-grade.  "
+        "<= 0 disables the observatory plane (default 5)",
+    )
+    p.add_argument(
+        "--obs-capacity", type=int, default=120, metavar="N",
+        help="time-series ring length in snapshots (default 120 = a "
+        "10-minute window at the default interval; memory is N "
+        "serialized registry snapshots)",
+    )
+    p.add_argument(
+        "--baseline", default=None, metavar="RECORD",
+        help="committed SERVE-record JSON (e.g. SERVE_r18.json) whose "
+        "pipeline.p99_warm_ms anchors the anomaly detector's latency "
+        "envelope; omitted = the latency watch reports no_data",
+    )
+    p.add_argument(
+        "--flight-ring", type=int, default=None, metavar="N",
+        help="flight-recorder event-ring capacity (default: "
+        "IA_FLIGHT_RING env or 512; memory scales linearly, "
+        "~200-500 bytes per event)",
+    )
     _add_synth_flags(p)
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "obs",
+        help="multi-replica serving observatory: scrape N daemons' "
+        "/metrics.json + /slo + /obs/window, pool registries into "
+        "fleet burn rates, render a dashboard, write OBS json "
+        "(round 19)",
+    )
+    _add_common_flags(p)
+    p.add_argument(
+        "--targets", required=True, metavar="HOST:PORT,HOST:PORT,...",
+        help="comma-separated daemon endpoints (host:port or full "
+        "http:// URLs)",
+    )
+    p.add_argument(
+        "--span", type=float, default=None, metavar="S",
+        help="window span (seconds) requested from each replica's "
+        "/obs/window (default: each replica's whole ring)",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=10.0, metavar="S",
+        help="per-scrape HTTP timeout (default 10)",
+    )
+    p.add_argument(
+        "--out", default=None, metavar="JSON",
+        help="write the OBS record here (the artifact "
+        "tools/check_obs.py validates)",
+    )
+    p.add_argument("--format", default="table", choices=["table", "json"])
+    p.set_defaults(fn=cmd_obs)
 
     p = sub.add_parser("examples", help="generate procedural example assets")
     _add_common_flags(p)
@@ -1295,9 +1444,15 @@ def main(argv=None) -> int:
         "/synthesize response and error body)",
     )
     p.add_argument(
-        "--trace-dir", required=True, metavar="DIR",
+        "--trace-dir", default=None, metavar="DIR",
         help="the serve daemon's --trace-dir (access.jsonl + "
-        "flight.json live here)",
+        "flight.json live here); exactly one of --trace-dir/--url",
+    )
+    p.add_argument(
+        "--url", default=None, metavar="URL",
+        help="ask a LIVE daemon over HTTP instead of reading "
+        "artifacts (GET /request?id=; round 19); exactly one of "
+        "--trace-dir/--url",
     )
     p.add_argument(
         "--access-log", default=None, metavar="JSONL",

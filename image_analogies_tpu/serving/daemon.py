@@ -127,7 +127,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .accesslog import AccessLog
+from .accesslog import AccessLog, find_request
 from .excache import (
     OBSERVED_WARMUP_FILE,
     ExecutableCache,
@@ -275,9 +275,14 @@ class SynthDaemon:
         dispatch_deadline_s: Optional[float] = None,
         pipeline_window: int = 2,
         warmup_workers: int = 4,
+        obs_interval_s: float = 5.0,
+        obs_capacity: int = 120,
+        anomaly_config=None,
     ):
         from ..parallel.batch import make_mesh
+        from ..telemetry.anomaly import AnomalyDetector
         from ..telemetry.slo import SloEngine
+        from ..telemetry.timeseries import TimeSeriesRing
 
         self.a = np.asarray(a, np.float32)
         self.ap = np.asarray(ap, np.float32)
@@ -370,6 +375,21 @@ class SynthDaemon:
         # warmup.observed.json and merged into the successor's warmup.
         self._observed_shapes: "OrderedDict[Tuple[int, ...], None]" = \
             OrderedDict()
+        # Round 19 observatory: windowed time-series ring + live
+        # anomaly watches, sampled on one daemon thread.  Interval <= 0
+        # disables the whole plane (the overhead-pin harness's bare
+        # arm); evaluation never runs on the request hot path.
+        self.obs: Optional[TimeSeriesRing] = None
+        self.anomaly: Optional[AnomalyDetector] = None
+        if obs_interval_s > 0:
+            self.obs = TimeSeriesRing(
+                registry, interval_s=obs_interval_s,
+                capacity=obs_capacity,
+            )
+            self.anomaly = AnomalyDetector(
+                self.obs, registry, config=anomaly_config,
+                max_queue_depth=max_queue_depth,
+            )
         self._dispatch_seq = 0  # client-dispatch ordinal (fault keys)
         # request_id -> {"sha256", "shape"} for replayed requests; the
         # chaos harness reads it from GET /journal to assert replay
@@ -447,9 +467,15 @@ class SynthDaemon:
             "the raw family the SLO objectives are evaluated from",
             buckets=REQUEST_DURATION_BUCKETS,
         )
+        self._g_shape_card = r.gauge(
+            "ia_serve_shape_cardinality",
+            "distinct client frame shapes observed (LRU-bounded at "
+            "32) — the anomaly detector's shape-growth watch input",
+        )
         self._g_depth.set(0)
         self._g_inflight.set(0)
         self._g_pipeline.set(0)
+        self._g_shape_card.set(0)
 
     # ------------------------------------------------------ lifecycle
     def start(self) -> "SynthDaemon":
@@ -509,9 +535,20 @@ class SynthDaemon:
                 ("GET", "/serving"): self._route_serving,
                 ("GET", "/slo"): self._route_slo,
                 ("GET", "/journal"): self._route_journal,
+                ("GET", "/obs/window"): self._route_obs_window,
+                ("GET", "/request"): self._route_request,
                 ("POST", "/drain"): self._route_drain,
             },
         ).start()
+        if self.obs is not None:
+            # Anomaly evaluation rides the sampler tick (never the
+            # request path): each tick snapshots the registry, then
+            # grades the watches so /healthz and the status gauges are
+            # at most one interval stale.
+            self.obs.start_sampler(
+                on_tick=self.anomaly.evaluate
+                if self.anomaly is not None else None
+            )
         self._completer = threading.Thread(
             target=self._completer_loop, name="ia-serve-complete",
             daemon=True,
@@ -526,6 +563,8 @@ class SynthDaemon:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.obs is not None:
+            self.obs.stop_sampler()
         for req in self.queue.drain():
             req.status = "failed"
             if self._draining.is_set() and self.journal is not None \
@@ -843,9 +882,12 @@ class SynthDaemon:
         ):
             self.journal.mark(rid, "done")
         cache = req.cache if req is not None and req.cache else "none"
+        # The request id rides as the bucket's exemplar (round 19):
+        # a latency-spike bucket in the exposition names the exact
+        # request to `ia-synth trace`.
         self._h_duration.observe(total_ms, labels={
             "route": "/synthesize", "outcome": outcome, "cache": cache,
-        })
+        }, exemplar=rid)
         if self.access is None:
             return
         entry: Dict[str, Any] = {
@@ -870,8 +912,67 @@ class SynthDaemon:
     def _route_slo(self, _body):
         """GET /slo: grade the declarative objectives over the sliding
         window and publish the burn-rate gauges — evaluation happens
-        HERE (pull), never on the request hot path."""
-        return 200, _json_bytes(self.slo.evaluate()), "application/json"
+        HERE (pull), never on the request hot path.  With the round-19
+        observatory on, the live anomaly report rides along under
+        `anomalies` so one scrape answers both "is the budget burning"
+        and "is something anomalous right now"."""
+        report = self.slo.evaluate()
+        if self.anomaly is not None:
+            report["anomalies"] = self.anomaly.evaluate()
+        return 200, _json_bytes(report), "application/json"
+
+    def _route_obs_window(self, _body, _headers, ctx):
+        """GET /obs/window?span=S: the time-series ring's windowed
+        view (rates + windowed quantiles) over the last S seconds
+        (omitted = the whole ring)."""
+        if self.obs is None:
+            return 404, _json_bytes({
+                "error": "observatory disabled (obs_interval_s <= 0)",
+            }), "application/json"
+        raw = (ctx.get("query") or {}).get("span")
+        span = None
+        if raw not in (None, ""):
+            try:
+                span = float(raw)
+                if span <= 0:
+                    raise ValueError
+            except ValueError:
+                return 400, _json_bytes({
+                    "error": f"span must be a positive number "
+                             f"of seconds, got {raw!r}",
+                }), "application/json"
+        return 200, _json_bytes(self.obs.window(span)), \
+            "application/json"
+
+    def _route_request(self, _body, _headers, ctx):
+        """GET /request?id=<request_id>: one request's access-log
+        record + its flight-recorder events, live over HTTP — the
+        `ia-synth trace <id> --url` backend (post-mortem trace reads
+        artifacts; this answers while the daemon still runs).  404
+        with a JSON error on an unknown id."""
+        rid = (ctx.get("query") or {}).get("id")
+        if not rid:
+            return 400, _json_bytes({
+                "error": "missing required query parameter: id",
+            }), "application/json"
+        entry = None
+        if self.access is not None:
+            entry = find_request(self.access.path, rid)
+        if entry is None:
+            return 404, _json_bytes({
+                "error": f"request id {rid!r} not found"
+                + ("" if self.access is not None
+                   else " (access log disabled)"),
+                "request_id": rid,
+            }), "application/json"
+        events = []
+        if self.flight is not None:
+            from ..telemetry.flight import request_events
+
+            events = request_events(self.flight.to_dict(), rid)
+        return 200, _json_bytes({
+            "request": entry, "flight_events": events,
+        }), "application/json"
 
     def _route_serving(self, _body):
         """GET /serving: the operator's one-look snapshot — queue /
@@ -1048,6 +1149,10 @@ class SynthDaemon:
         self._observed_shapes.move_to_end(key)
         while len(self._observed_shapes) > 32:
             self._observed_shapes.popitem(last=False)
+        # Cardinality gauge for the anomaly shape-growth watch: every
+        # distinct shape is a compile, so a climbing gauge is compile
+        # budget walking out the door.
+        self._g_shape_card.set(len(self._observed_shapes))
         if fresh and self.state_dir is not None:
             try:
                 self._save_observed_shapes()

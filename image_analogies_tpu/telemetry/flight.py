@@ -63,6 +63,32 @@ from ..utils.progress import _iso_now
 FLIGHT_FILE = "flight.json"
 FLIGHT_SCHEMA_VERSION = 1
 
+# Default event-ring capacity.  Memory bound: one event record is a
+# span name + small attrs dict (~200-500 bytes serialized), so 512
+# events plus 8 registry snapshots holds the recorder's resident set
+# in the low hundreds of KB; `--flight-ring` / IA_FLIGHT_RING scale
+# the window linearly with that bound.
+DEFAULT_RING_CAPACITY = 512
+RING_CAPACITY_ENV = "IA_FLIGHT_RING"
+
+
+def resolve_ring_capacity(cli_value: Optional[int] = None) -> int:
+    """Event-ring capacity, by precedence: explicit CLI value >
+    IA_FLIGHT_RING env var > the 512 default.  A malformed or
+    non-positive env value falls back to the default (an observability
+    knob must never be able to kill the run it observes)."""
+    if cli_value is not None and int(cli_value) > 0:
+        return int(cli_value)
+    raw = os.environ.get(RING_CAPACITY_ENV)
+    if raw:
+        try:
+            v = int(raw)
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    return DEFAULT_RING_CAPACITY
+
 FLUSH_REASONS = (
     "sigterm", "sigint", "atexit", "violation", "watchdog",
     "session-end", "manual",
@@ -85,7 +111,8 @@ class FlightRecorder:
     """
 
     def __init__(self, tracer, registry=None, path: str = FLIGHT_FILE,
-                 capacity: int = 512, snapshot_interval_s: float = 5.0,
+                 capacity: int = DEFAULT_RING_CAPACITY,
+                 snapshot_interval_s: float = 5.0,
                  max_snapshots: int = 8):
         self.tracer = tracer
         self.registry = (
@@ -300,8 +327,12 @@ def read_flight(path: str) -> Dict[str, Any]:
 def install_for_session(tracer, registry, artifact_dir: str,
                         **kw) -> FlightRecorder:
     """The telemetry_session wiring: a recorder dumping into
-    `<artifact_dir>/flight.json`, installed and returned."""
+    `<artifact_dir>/flight.json`, installed and returned.  Callers
+    that do not pass `capacity` get the env-aware resolution
+    (`--flight-ring` reaches here as an explicit kwarg; IA_FLIGHT_RING
+    covers daemons configured by environment)."""
     os.makedirs(artifact_dir, exist_ok=True)
+    kw.setdefault("capacity", resolve_ring_capacity())
     rec = FlightRecorder(
         tracer, registry, os.path.join(artifact_dir, FLIGHT_FILE), **kw
     )

@@ -14,7 +14,10 @@ Endpoints:
 
   /metrics   the session registry's Prometheus text exposition
              (format 0.0.4, now including the derived `_quantile`
-             families) — point any scraper at it mid-run.
+             families and comment-style histogram exemplars) — point
+             any scraper at it mid-run.
+  /metrics.json  the registry's JSON exposition (registry.to_dict()),
+             the form the round-19 observatory aggregator merges.
   /healthz   the run sentinel's registry-joinable checks evaluated
              incrementally against the LIVE registry (candidate-DMA /
              polish-DMA / comms ledgers, energy gauge, overhead,
@@ -50,6 +53,19 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 LIVE_FILE = "live.json"
+
+
+def _split_path(raw: str):
+    """(normalized path, {query key: last value}) from a request
+    target.  Route matching stays on the bare path — the query reaches
+    arity-3 handlers through ctx['query'] instead of widening every
+    historical route signature."""
+    from urllib.parse import parse_qsl, urlsplit
+
+    parts = urlsplit(raw)
+    path = parts.path.rstrip("/") or "/"
+    query = dict(parse_qsl(parts.query, keep_blank_values=True))
+    return path, query
 
 
 def _handler_arity(handler) -> int:
@@ -179,12 +195,16 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _dispatch_route(self, method: str, path: str,
-                        body: Optional[bytes]) -> bool:
+                        body: Optional[bytes],
+                        query: Optional[Dict[str, str]] = None) -> bool:
         """Injected-route dispatch (round 13: the serving daemon mounts
         its endpoints on this same server).  A route handler returns
         (code, body_bytes, ctype[, headers]); True = handled.
         Handlers declaring a second positional parameter additionally
-        receive the request headers as a dict (round 15)."""
+        receive the request headers as a dict (round 15); arity-3
+        handlers get a ctx dict whose `query` entry carries the parsed
+        query string, last value wins per key (round 19 — /obs/window
+        and /request are parameterized GETs)."""
         live = self.server.live  # type: ignore[attr-defined]
         handler = live.routes.get((method, path))
         if handler is None:
@@ -195,6 +215,7 @@ class _Handler(BaseHTTPRequestHandler):
             ctx = {
                 "alive": lambda: _socket_alive(conn),
                 "client": self.client_address,
+                "query": dict(query or {}),
             }
             out = handler(body, dict(self.headers.items()), ctx)
         elif arity >= 2:
@@ -209,8 +230,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         live = self.server.live  # type: ignore[attr-defined]
         try:
-            path = self.path.split("?", 1)[0].rstrip("/") or "/"
-            if self._dispatch_route("GET", path, None):
+            path, query = _split_path(self.path)
+            if self._dispatch_route("GET", path, None, query):
                 pass
             elif path == "/metrics":
                 self._send(
@@ -218,6 +239,15 @@ class _Handler(BaseHTTPRequestHandler):
                     live.registry.to_prometheus().encode(),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
+            elif path == "/metrics.json":
+                # The registry's JSON exposition — what the round-19
+                # observatory aggregator merges (same shape as the
+                # end-of-run metrics.json artifact), so fleet merge
+                # arithmetic never round-trips through text parsing.
+                body = json.dumps(
+                    live.registry.to_dict(), indent=1
+                ) + "\n"
+                self._send(200, body.encode(), "application/json")
             elif path == "/healthz":
                 health = live.evaluate_live_health()
                 code = 503 if health["verdict"] == "violated" else 200
@@ -244,10 +274,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:  # noqa: N802 (http.server API)
         try:
-            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            path, query = _split_path(self.path)
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
-            if not self._dispatch_route("POST", path, body):
+            if not self._dispatch_route("POST", path, body, query):
                 self._send(404, b"not found\n", "text/plain")
         except Exception as e:  # noqa: BLE001 - never kill the server
             try:
@@ -332,7 +362,8 @@ class LiveTelemetryServer:
         import logging
 
         logging.getLogger("image_analogies_tpu").info(
-            "live telemetry: http://%s:%d (/metrics /healthz /progress)",
+            "live telemetry: http://%s:%d "
+            "(/metrics /metrics.json /healthz /progress)",
             self.host, self.port,
         )
         return self
@@ -354,7 +385,8 @@ class LiveTelemetryServer:
                 "host": self.host,
                 "port": self.port,
                 "pid": os.getpid(),
-                "endpoints": ["/metrics", "/healthz", "/progress"]
+                "endpoints": ["/metrics", "/metrics.json", "/healthz",
+                              "/progress"]
                 + sorted({p for _m, p in self.routes}),
             },
         )

@@ -196,18 +196,31 @@ class Histogram:
         self._counts: Dict[_LabelKey, List[int]] = {}
         self._sums: Dict[_LabelKey, float] = {}
         self._totals: Dict[_LabelKey, int] = {}
+        # (label key, bucket index) -> most recent exemplar id; index
+        # len(buckets) is the +Inf bucket.  Bounded: one slot per
+        # existing (label set, bucket) pair, last-write-wins.
+        self._exemplars: Dict[Tuple[_LabelKey, int], str] = {}
         self._lock = threading.Lock()
 
     def observe(self, value: float,
-                labels: Optional[Dict[str, str]] = None) -> None:
+                labels: Optional[Dict[str, str]] = None,
+                exemplar: Optional[str] = None) -> None:
         key = _label_key(labels)
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            lowest = len(self.buckets)  # +Inf unless a bound catches it
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     counts[i] += 1
+                    lowest = min(lowest, i)
             self._sums[key] = self._sums.get(key, 0.0) + float(value)
             self._totals[key] = self._totals.get(key, 0) + 1
+            if exemplar is not None:
+                # One exemplar per (label set, NARROWEST bucket the
+                # observation landed in) — that is the bucket a
+                # dashboard spike points at, and the id links straight
+                # to `ia-synth trace <id>`.
+                self._exemplars[(key, lowest)] = str(exemplar)
 
     # Quantiles derived for the Prometheus exposition (round 10): the
     # mid-run scrape story needs tail latencies (a straggling shard
@@ -305,6 +318,41 @@ class Histogram:
             )
         return lines
 
+    def exemplars(self) -> Dict[str, Dict[str, str]]:
+        """{label_str or "total": {le-bound: exemplar id}} — the JSON
+        accessor (kept OUT of to_dict(): its cell schema is a wire
+        contract for the sentinel/SLO/report consumers)."""
+        out: Dict[str, Dict[str, str]] = {}
+        with self._lock:
+            items = sorted(self._exemplars.items())
+        for (key, idx), ex in items:
+            le = "+Inf" if idx >= len(self.buckets) \
+                else _fmt(self.buckets[idx])
+            out.setdefault(_label_str(key) or "total", {})[le] = ex
+        return out
+
+    def expose_exemplars(self) -> List[str]:
+        """Comment-style exemplar lines: the exposition format 0.0.4
+        has no exemplar syntax (that is OpenMetrics), so each rides as
+        a `#`-prefixed comment — ignored by any compliant parser, one
+        line per (label set, bucket) naming the most recent request id
+        that landed there:
+
+            # exemplar ia_request_duration_ms_bucket{le="100",...} request_id="r-42"
+        """
+        lines = []
+        with self._lock:
+            items = sorted(self._exemplars.items())
+        for (key, idx), ex in items:
+            le = "+Inf" if idx >= len(self.buckets) \
+                else _fmt(self.buckets[idx])
+            series = _label_str(_label_key({**dict(key), "le": le}))
+            lines.append(
+                f"# exemplar {self.name}_bucket{series} "
+                f'request_id="{escape_label_value(ex)}"'
+            )
+        return lines
+
 
 def _fmt(v: float) -> str:
     """Prometheus-friendly number: integral values without the '.0'."""
@@ -368,6 +416,11 @@ class MetricsRegistry:
             lines.append(f"# TYPE {name} {m.kind}")
             lines.extend(m.expose())
             if isinstance(m, Histogram):
+                # Exemplar comment lines (round 19): most recent
+                # request id per (label set, bucket), format-safe
+                # because a format-0.0.4 parser skips every non-HELP/
+                # TYPE `#` line.
+                lines.extend(m.expose_exemplars())
                 # Derived p50/p99 children as a SEPARATE gauge family
                 # (round 10): the histogram family's TYPE line stays
                 # alone over _bucket/_sum/_count, and the derived

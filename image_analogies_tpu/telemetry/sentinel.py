@@ -134,6 +134,10 @@ _OVERHEAD_GAUGES = (
     # ledger bookkeeping on the request path), measured by
     # tests/test_resilience.py's paired daemon arms.
     "ia_serving_resilience_overhead_frac",
+    # Round 19: the observatory layer (time-series ring sampler +
+    # anomaly watches on the live daemon), measured by
+    # tests/test_observatory.py's paired daemon arms.
+    "ia_observatory_overhead_frac",
 )
 
 # Straggler watch (round 10): a level whose slowest shard finishes
@@ -1148,6 +1152,46 @@ def check_slo(metrics: Optional[dict]) -> Dict:
     )
 
 
+def check_anomaly(metrics: Optional[dict]) -> Dict:
+    """Live anomaly watches (round 19, telemetry/anomaly.py): the
+    detector publishes one `ia_anomaly_status{watch=...}` gauge per
+    watch (1 firing, 0 ok, -1 no_data) on every sampler tick, so the
+    sentinel reads the verdict instead of re-deriving windowed math it
+    has no ring for.  Any firing watch degrades (windowed symptoms are
+    early warnings; the SLO check owns violation), no_data watches
+    never fire, and a session without a detector skips."""
+    fam = (metrics or {}).get("ia_anomaly_status")
+    values = (fam or {}).get("values") or {}
+    if not values:
+        return _check(
+            "anomaly", "skipped",
+            detail="no ia_anomaly_status gauges "
+                   "(no anomaly detector in this session)",
+        )
+    statuses = {}
+    for label_str, v in values.items():
+        try:
+            watch = parse_label_str(label_str).get("watch", label_str)
+        except ValueError:
+            watch = label_str
+        statuses[watch] = (
+            "firing" if v >= 1.0 else ("no_data" if v < 0.0 else "ok")
+        )
+    firing = sorted(w for w, s in statuses.items() if s == "firing")
+    return _check(
+        "anomaly", "degraded" if firing else "ok",
+        expected="no anomaly watch firing",
+        observed=statuses,
+        detail=(
+            "firing: " + ", ".join(firing) if firing
+            else "no watch firing "
+                 f"({sum(1 for s in statuses.values() if s == 'ok')} ok, "
+                 f"{sum(1 for s in statuses.values() if s == 'no_data')} "
+                 "no_data)"
+        ),
+    )
+
+
 # ------------------------------------------------------------ evaluation
 def evaluate_health(
     spans: Optional[dict] = None,
@@ -1177,6 +1221,7 @@ def evaluate_health(
         check_serving_recovery(metrics),
         check_warm_start(metrics),
         check_slo(metrics),
+        check_anomaly(metrics),
     ]
     if bench_record is not None:
         checks.append(check_instrument_drift(bench_record))

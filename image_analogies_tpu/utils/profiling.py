@@ -40,7 +40,8 @@ _SAME_AS_TRACE_DIR = object()
 def telemetry_session(trace_dir: Optional[str], sink=None,
                       enabled: bool = True,
                       artifact_dir=_SAME_AS_TRACE_DIR,
-                      metrics_port: Optional[int] = None):
+                      metrics_port: Optional[int] = None,
+                      flight_capacity: Optional[int] = None):
     """Device trace + span tracer + telemetry artifact writes.
 
     Yields a `telemetry.Tracer` (disabled when `enabled` is False, so
@@ -54,7 +55,10 @@ def telemetry_session(trace_dir: Optional[str], sink=None,
     Round-10 live layer: an enabled session with an `artifact_dir`
     installs a flight recorder (telemetry/flight.py — span-event ring
     buffer flushed to `<artifact_dir>/flight.json` on SIGTERM/SIGINT/
-    atexit/sentinel violation and at teardown), and `metrics_port`
+    atexit/sentinel violation and at teardown; `flight_capacity`
+    overrides the event-ring size — None resolves --flight-ring/
+    IA_FLIGHT_RING/512 via flight.resolve_ring_capacity), and
+    `metrics_port`
     (the CLI's `--metrics-port`; 0 = ephemeral) additionally serves
     /metrics, /healthz and /progress from an in-process HTTP exporter
     (telemetry/live.py), announcing the bound endpoint in
@@ -98,9 +102,15 @@ def telemetry_session(trace_dir: Optional[str], sink=None,
     try:
         if enabled:
             if artifact_dir:
-                from ..telemetry.flight import install_for_session
+                from ..telemetry.flight import (
+                    install_for_session,
+                    resolve_ring_capacity,
+                )
 
-                flight = install_for_session(tracer, reg, artifact_dir)
+                flight = install_for_session(
+                    tracer, reg, artifact_dir,
+                    capacity=resolve_ring_capacity(flight_capacity),
+                )
                 # Handle for epilogues that run AFTER session teardown
                 # (the CLI health epilogue flushes on a violated
                 # verdict).

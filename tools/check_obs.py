@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Validate an OBS_r19.json serving-observatory artifact (round 19).
+
+The fleet-aggregation acceptance bar, enforced by arithmetic instead
+of trusted to prose: the committed record must carry >= 2 scraped
+replicas, each with its request-duration histogram family, and a
+fleet section whose SLO report is BIT-EQUAL to re-deriving it here —
+re-merge the per-replica families (sum counters, pool histogram cells
+bucket-by-bucket) and re-run the round-15 objective grading over the
+pooled cells.  Any divergence means the aggregator averaged where it
+should have pooled, dropped a label set, or mangled a bucket — the
+exact failure modes fleet dashboards silently absorb.
+
+Also pinned: the observatory's measured request-path overhead
+(`observatory_overhead_frac`, the paired obs-on/obs-off arms in
+tools/serve_load.py --obs-out) must sit under the telemetry budget
+the sentinel watches (2%), and each replica's windowed view must be a
+structurally valid obs_window (status ok / single_snapshot / no_data,
+never an invented rate).
+
+Usage:
+    python tools/check_obs.py OBS_r19.json
+
+Runs under pytest too (tests/test_observatory.py validates the
+COMMITTED artifact) so tier-1 fails if the record is missing,
+truncated, or its fleet arithmetic stops reproducing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+OBS_SCHEMA_VERSION = 1
+OVERHEAD_BUDGET_FRAC = 0.02
+DURATION_METRIC = "ia_request_duration_ms"
+_WINDOW_STATUSES = ("ok", "single_snapshot", "no_data")
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _validate_window(window, where: str, errs: List[str]) -> None:
+    if window is None:
+        return  # stated absence (replica predates /obs/window)
+    if not isinstance(window, dict):
+        errs.append(f"{where}: window is not an object")
+        return
+    if window.get("kind") != "obs_window":
+        errs.append(f"{where}: window.kind {window.get('kind')!r}")
+    status = window.get("status")
+    if status not in _WINDOW_STATUSES:
+        errs.append(f"{where}: window.status {status!r}")
+        return
+    if status == "no_data":
+        for section in ("counters", "gauges", "histograms"):
+            if window.get(section):
+                errs.append(
+                    f"{where}: no_data window has non-empty {section} "
+                    "(absence must be stated, never imputed)"
+                )
+    if status != "ok":
+        # Rates must be null, not invented, without a delta base.
+        for fam in (window.get("counters") or {}).values():
+            for cell in fam.values():
+                if cell.get("rate_per_s") is not None:
+                    errs.append(
+                        f"{where}: {status} window carries a counter "
+                        "rate (imputed rate without a base)"
+                    )
+                    return
+
+
+def validate_obs(record: dict) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    from image_analogies_tpu.serving.observatory import (
+        fleet_slo,
+        merge_registries,
+    )
+
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if record.get("schema_version") != OBS_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {record.get('schema_version')!r} != "
+            f"{OBS_SCHEMA_VERSION}"
+        )
+    if record.get("kind") != "obs":
+        errs.append(f"kind {record.get('kind')!r} != 'obs'")
+    rnd = record.get("round")
+    if not (_num(rnd) and rnd >= 19):
+        errs.append(f"round {rnd!r} is not a round >= 19")
+
+    replicas = record.get("replicas")
+    if not isinstance(replicas, list) or len(replicas) < 2:
+        errs.append(
+            f"replicas: need >= 2 scraped replicas, got "
+            f"{len(replicas) if isinstance(replicas, list) else replicas!r}"
+        )
+        return errs
+    live = []
+    for i, rep in enumerate(replicas):
+        where = f"replicas[{i}]"
+        if not isinstance(rep, dict) or not rep.get("url"):
+            errs.append(f"{where}: missing url")
+            continue
+        if rep.get("error"):
+            continue
+        live.append(rep)
+        metrics = rep.get("metrics")
+        if not isinstance(metrics, dict):
+            errs.append(f"{where}: missing metrics")
+            continue
+        fam = metrics.get(DURATION_METRIC) or {}
+        if not (fam.get("values") or {}):
+            errs.append(
+                f"{where}: no {DURATION_METRIC} observations (replica "
+                "saw no traffic — the artifact must be cut under load)"
+            )
+        slo = rep.get("slo")
+        if not isinstance(slo, dict) or slo.get("kind") != "slo":
+            errs.append(f"{where}: missing /slo report")
+        _validate_window(rep.get("window"), where, errs)
+    if len(live) < 2:
+        errs.append(f"fewer than 2 live replicas ({len(live)})")
+        return errs
+
+    fleet = record.get("fleet")
+    if not isinstance(fleet, dict):
+        errs.append("missing fleet section")
+        return errs
+    if fleet.get("replicas_live") != len(live):
+        errs.append(
+            f"fleet.replicas_live {fleet.get('replicas_live')!r} != "
+            f"{len(live)} live replicas present"
+        )
+
+    # -- the pooling contract: recompute and require bit-equality ----
+    recomputed = fleet_slo(
+        merge_registries([r["metrics"] for r in live])
+    )
+    committed = fleet.get("slo")
+    if committed != recomputed:
+        errs.append(
+            "fleet.slo is NOT bit-equal to re-merging the per-replica "
+            "histograms and re-grading (pooled-not-averaged contract "
+            "broken); diverging keys: "
+            + _diff_keys(committed, recomputed)
+        )
+    else:
+        for obj in (committed or {}).get("objectives", []):
+            if obj.get("status") in ("exhausted",):
+                errs.append(
+                    f"fleet objective {obj.get('name')}: error budget "
+                    f"exhausted in the committed artifact "
+                    f"(burn {obj.get('burn_rate')})"
+                )
+
+    overhead = record.get("observatory_overhead_frac")
+    if not _num(overhead):
+        errs.append(
+            f"observatory_overhead_frac {overhead!r} is not a number "
+            "(the < 2% pin needs a measurement)"
+        )
+    elif not 0.0 <= overhead < OVERHEAD_BUDGET_FRAC:
+        errs.append(
+            f"observatory_overhead_frac {overhead} outside "
+            f"[0, {OVERHEAD_BUDGET_FRAC})"
+        )
+    return errs
+
+
+def _diff_keys(a, b) -> str:
+    if not isinstance(a, dict) or not isinstance(b, dict):
+        return f"{type(a).__name__} vs {type(b).__name__}"
+    out = []
+    for k in sorted(set(a) | set(b)):
+        if a.get(k) != b.get(k):
+            out.append(k)
+    return ", ".join(out) or "(none — container mismatch)"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record", help="path to OBS_r19.json")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.record, "r", encoding="utf-8") as fh:
+            record = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"check_obs: cannot read {args.record}: {e}",
+              file=sys.stderr)
+        return 2
+    errs = validate_obs(record)
+    if errs:
+        print(f"check_obs: {args.record}: {len(errs)} violation(s):")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    fleet_verdict = ((record.get("fleet") or {}).get("slo") or {}) \
+        .get("verdict")
+    print(
+        f"check_obs: {args.record} OK — "
+        f"{(record.get('fleet') or {}).get('replicas_live')} replicas, "
+        f"fleet verdict {fleet_verdict}, overhead "
+        f"{record.get('observatory_overhead_frac')}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,6 +65,7 @@ _MESH2D_RE = re.compile(r"^MESH2D_r(\d+)\.json$")
 # (kind "serve_persist").  load_history disambiguates on the kind
 # field — the filename round number alone is not the discriminator.
 _SERVE_PERSIST_RE = re.compile(r"^SERVE_r(\d+)\.json$")
+_OBS_RE = re.compile(r"^OBS_r(\d+)\.json$")
 
 PROVENANCES = ("measured", "carried", "modeled")
 
@@ -188,6 +189,24 @@ SERVE_PERSIST_SERIES: Tuple[Dict, ...] = (
      "label": "pipelined-dispatch warm p99 (ms; CPU proxy)"},
 )
 
+# OBS artifacts (round 19: tools/serve_load.py --obs-out) carry the
+# serving observatory's measured request-path overhead at top level.
+# The ceiling is the HARD telemetry budget the sentinel watches
+# (`ia_observatory_overhead_frac` vs OVERHEAD_BUDGET_FRAC): a
+# committed record at or past 2% means the observation plane itself
+# became a serving regression.  The trend is held loosely (rel_tol
+# 1.0 + abs_tol 0.01: min-paired-delta clamps to 0.0 when the paired
+# arms tie, and a literal-zero best would otherwise make ANY later
+# positive measurement a "regression"); the absolute ceiling is the
+# real gate (check_obs enforces it per record too; this table watches
+# the trend AND re-states the bound so a future checker edit cannot
+# silently drop it from history).
+OBS_SERIES: Tuple[Dict, ...] = (
+    {"field": "observatory_overhead_frac", "direction": "lower",
+     "rel_tol": 1.0, "abs_tol": 0.01, "ceiling": 0.02, "since": 19,
+     "label": "observatory request-path overhead fraction"},
+)
+
 # SCALE rows are keyed by size; each series is tracked per size.
 SCALE_SERIES: Tuple[Dict, ...] = (
     {"field": "wall_s", "direction": "lower", "rel_tol": 0.10,
@@ -304,8 +323,8 @@ def _flatten_serve_persist(rec):
 
 
 def load_history(root: str):
-    """(bench, scale, video, slo, chaos_serve, mesh2d, serve_persist)
-    lists of
+    """(bench, scale, video, slo, chaos_serve, mesh2d, serve_persist,
+    obs) lists of
     (round, filename, payload), round-sorted.  BENCH payloads unwrap the driver's capture wrapper
     to the parsed record.  Builder probe files (BENCH_r*_builder*.json)
     do not match the round pattern and are deliberately out of scope —
@@ -318,6 +337,7 @@ def load_history(root: str):
         [], [], [], [], [], []
     )
     serve_persist = []
+    obs = []
     for name in sorted(os.listdir(root)):
         m = _BENCH_RE.match(name)
         if m:
@@ -362,6 +382,10 @@ def load_history(root: str):
             if isinstance(data, dict) and \
                     data.get("kind") == "serve_persist":
                 serve_persist.append((int(m.group(1)), name, data))
+        m = _OBS_RE.match(name)
+        if m:
+            with open(os.path.join(root, name)) as f:
+                obs.append((int(m.group(1)), name, json.load(f)))
     bench.sort(key=lambda t: t[0])
     scale.sort(key=lambda t: t[0])
     video.sort(key=lambda t: t[0])
@@ -369,7 +393,9 @@ def load_history(root: str):
     chaos_serve.sort(key=lambda t: t[0])
     mesh2d.sort(key=lambda t: t[0])
     serve_persist.sort(key=lambda t: t[0])
-    return bench, scale, video, slo, chaos_serve, mesh2d, serve_persist
+    obs.sort(key=lambda t: t[0])
+    return (bench, scale, video, slo, chaos_serve, mesh2d,
+            serve_persist, obs)
 
 
 # ------------------------------------------------------ schema (by era)
@@ -601,7 +627,7 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
     """All schema + trajectory checks over the committed history.
     Returns (violations, machine-readable report rows)."""
     (bench, scale, video, slo, chaos_serve, mesh2d,
-     serve_persist) = load_history(root)
+     serve_persist, obs) = load_history(root)
     errs: List[str] = []
     report: List[Dict] = []
 
@@ -642,6 +668,12 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
         errs.extend(
             f"{name}: {e}" for e in validate_serve_persist(rec)
         )
+    for rnd, name, rec in obs:
+        # Observatory artifacts carry their full contract — including
+        # the fleet-SLO bit-equality re-derivation — in check_obs.
+        from check_obs import validate_obs
+
+        errs.extend(f"{name}: {e}" for e in validate_obs(rec))
 
     for decl in BENCH_SERIES:
         check_series(
@@ -671,6 +703,12 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
             [(r, n, _flatten_serve_persist(rec))
              for r, n, rec in serve_persist],
             f"serve_persist.{decl['field']}", errs, report,
+        )
+    for decl in OBS_SERIES:
+        # The overhead headline is top-level in the OBS record.
+        check_series(
+            decl, [(r, n, rec) for r, n, rec in obs],
+            f"obs.{decl['field']}", errs, report,
         )
     def _rows(data):
         rows = data.get("rows") if isinstance(data, dict) else None

@@ -45,11 +45,22 @@ bit-identity plus the admission/dispatch ledger.  Validated with
 tools/check_serve_persist.py (the 10x restart gate lives there)
 before the write.
 
+Round 19 adds `--obs-out OBS_r19.json`: the serving-observatory
+artifact.  Two live in-process replicas (disjoint registries) serve a
+concurrent burst, then `serving/observatory.aggregate` scrapes both
+over real HTTP and pools their histograms into the fleet SLO; a
+separate paired obs-on/obs-off arm measures the observatory's
+request-path overhead (min-paired-delta) and publishes it as the
+`ia_observatory_overhead_frac` gauge the sentinel watches.  Validated
+with tools/check_obs.py (fleet burn rates must be BIT-EQUAL to
+re-merging the committed per-replica histograms) before the write.
+
 Usage:
     python tools/serve_load.py --out SERVE_r13.json [--size 32]
     python tools/serve_load.py --out /tmp/serve.json \\
         --slo-out SLO_r15.json
     python tools/serve_load.py --persist-out SERVE_r18.json
+    python tools/serve_load.py --obs-out OBS_r19.json
 """
 
 from __future__ import annotations
@@ -71,6 +82,7 @@ from typing import List, Optional, Tuple
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from check_obs import validate_obs  # noqa: E402
 from check_serve import validate_serve  # noqa: E402
 from check_serve_persist import validate_serve_persist  # noqa: E402
 from check_slo import validate_slo  # noqa: E402
@@ -672,6 +684,218 @@ def run_persist(args) -> dict:
     return record
 
 
+def run_obs(args) -> dict:
+    """Round-19 observatory arm (`--obs-out`): two live in-process
+    daemon replicas (disjoint registries, same style pair) under a
+    concurrent load burst, aggregated OVER REAL HTTP by
+    serving/observatory.aggregate — the acceptance path for the
+    pooled-not-averaged fleet burn-rate contract (check_obs re-derives
+    the fleet SLO from the committed per-replica histograms and
+    requires bit-equality).
+
+    The overhead pin runs as a separate paired arm: one daemon with an
+    aggressively-ticking observatory (20 Hz sampler — far hotter than
+    the 0.2 Hz production default) against one with the plane disabled,
+    alternated warm requests, min-paired-delta over median base (the
+    round-12/15/16 overhead-measurement discipline: the minimum is the
+    run where scheduler noise was stillest).  The measured fraction is
+    published as `ia_observatory_overhead_frac` on both replicas —
+    the gauge the sentinel's telemetry-overhead check watches — and
+    recorded in the artifact."""
+    from image_analogies_tpu.config import SynthConfig
+    from image_analogies_tpu.serving.daemon import SynthDaemon
+    from image_analogies_tpu.serving.observatory import aggregate
+    from image_analogies_tpu.telemetry.anomaly import (
+        AnomalyConfig,
+        baseline_from_record,
+    )
+
+    from image_analogies_tpu.telemetry.metrics import MetricsRegistry
+
+    a, ap_img, b = _make_inputs(args.seed, args.size)
+    cfg = SynthConfig(
+        levels=2, matcher="patchmatch", pallas_mode="off",
+        em_iters=1, pm_iters=2,
+    )
+    body = _frame_body(b)
+    baseline = baseline_from_record(
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "SERVE_r18.json")
+    )
+    anomaly_cfg = AnomalyConfig(baseline_p99_ms=baseline)
+
+    def make_daemon(reg, interval):
+        return SynthDaemon(
+            a, ap_img, cfg, registry=reg, max_batch=1,
+            max_wait_ms=1.0, max_queue_depth=16, cache_capacity=4,
+            obs_interval_s=interval, obs_capacity=64,
+            anomaly_config=anomaly_cfg,
+        ).start()
+
+    # -- paired overhead arm FIRST: the replica pair's rings hold
+    # capacity x interval (~16 s) of history, so the burst must be
+    # scraped promptly — anything slow between burst and scrape would
+    # rotate the burst out of every window.  Measuring first also
+    # lets the gauge be live in both registries before any scrape.
+    overhead = _measure_obs_overhead(a, ap_img, cfg, body, anomaly_cfg)
+
+    # -- replica pair under load ------------------------------------
+    regs = [MetricsRegistry(), MetricsRegistry()]
+    for reg in regs:
+        reg.gauge(
+            "ia_observatory_overhead_frac",
+            "measured observatory (ring sampler + anomaly "
+            "watches) request-path overhead fraction",
+        ).set(round(overhead, 4))
+    daemons = [make_daemon(reg, 0.25) for reg in regs]
+    try:
+        # One request per replica first: the process-global jit cache
+        # makes the second replica's compile nearly free, and both
+        # replicas then serve the burst warm.
+        for d in daemons:
+            code, r = _post(d.url, body)
+            if code != 200:
+                raise RuntimeError(
+                    f"obs warm request: {code} ({r.get('error')})"
+                )
+            # Window-epoch boundary: the cold compile above is warmup,
+            # not traffic — reset so every served window (and the
+            # anomaly detector's latency watch) deltifies against
+            # post-warmup state.
+            d.obs.reset()
+        # Burst each replica with concurrent clients, ONE REPLICA AT A
+        # TIME: two co-located in-process daemons share the host's
+        # device set, and concurrent executions of two different
+        # collective-bearing executables can starve XLA's shared
+        # participant pool into a rendezvous deadlock.  A real fleet
+        # is separate processes; in-process co-location is this
+        # harness's artifact, so the harness serializes across
+        # daemons while keeping per-daemon client concurrency.
+        lock = threading.Lock()
+        lat_ms: List[float] = []
+        failures: List[str] = []
+
+        def client(d, i: int) -> None:
+            for _ in range(args.requests_per_client):
+                t0 = time.perf_counter()
+                try:
+                    code, r = _post(d.url, body)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        failures.append(f"client {i}: {e!r}")
+                    return
+                wall = (time.perf_counter() - t0) * 1000.0
+                with lock:
+                    if code == 200:
+                        lat_ms.append(wall)
+                    else:
+                        failures.append(
+                            f"client {i}: {code} ({r.get('error')})"
+                        )
+
+        for d in daemons:
+            threads = [
+                threading.Thread(target=client, args=(d, i))
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if failures:
+            raise RuntimeError(f"obs burst failed: {failures}")
+
+        # The newest ring snapshot lags traffic by up to one tick
+        # interval — wait until every burst request is inside each
+        # replica's window before scraping, so the committed windows
+        # carry real post-warmup rates (status "ok").
+        def in_window(d) -> int:
+            cells = (d.obs.window(None).get("histograms") or {}).get(
+                "ia_request_duration_ms") or {}
+            return sum(int(c["count"] or 0) for c in cells.values())
+
+        want = 3 * args.requests_per_client
+        deadline = time.monotonic() + 15.0
+        while any(in_window(d) < want for d in daemons):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "obs windows never captured the burst: "
+                    f"{[in_window(d) for d in daemons]} < {want}"
+                )
+            time.sleep(0.05)
+
+        record = aggregate([d.url for d in daemons], span_s=None)
+        p50, p99 = _quantiles(lat_ms)
+        record.update({
+            "proxy_size": args.size,
+            "config": {
+                "levels": cfg.levels, "matcher": cfg.matcher,
+                "em_iters": cfg.em_iters, "pm_iters": cfg.pm_iters,
+                "obs_interval_s": 0.25,
+                "baseline_p99_ms": baseline,
+            },
+            "load": {
+                "requests": 6 * args.requests_per_client + 2,
+                "completed": len(lat_ms) + 2,
+                "p50_ms": p50,
+                "p99_ms": p99,
+            },
+            "observatory_overhead_frac": round(overhead, 4),
+        })
+        for d in daemons:
+            anomaly_check = next(
+                c for c in d.health()["checks"] if c["name"] == "anomaly"
+            )
+            if anomaly_check["status"] not in ("ok", "degraded"):
+                raise RuntimeError(
+                    f"anomaly sentinel check {anomaly_check['status']!r}"
+                    " — detector never graded"
+                )
+        return record
+    finally:
+        for d in daemons:
+            d.stop()
+
+
+def _measure_obs_overhead(a, ap_img, cfg, body, anomaly_cfg) -> float:
+    """Min-paired-delta overhead of the observatory plane: alternated
+    warm requests between an obs-on (20 Hz sampler) and an obs-off
+    daemon."""
+    from image_analogies_tpu.serving.daemon import SynthDaemon
+    from image_analogies_tpu.telemetry.metrics import MetricsRegistry
+
+    def spawn(interval):
+        return SynthDaemon(
+            a, ap_img, cfg, registry=MetricsRegistry(), max_batch=1,
+            max_wait_ms=1.0, obs_interval_s=interval,
+            anomaly_config=anomaly_cfg,
+        ).start()
+
+    d_obs = spawn(0.05)
+    d_base = spawn(0.0)
+    try:
+        for d in (d_obs, d_base):
+            code, r = _post(d.url, body)
+            if code != 200:
+                raise RuntimeError(
+                    f"overhead warm request: {code} ({r.get('error')})"
+                )
+        bases, deltas = [], []
+        for _ in range(8):
+            t0 = time.perf_counter()
+            _post(d_base.url, body)
+            base = (time.perf_counter() - t0) * 1000.0
+            t0 = time.perf_counter()
+            _post(d_obs.url, body)
+            obs = (time.perf_counter() - t0) * 1000.0
+            bases.append(base)
+            deltas.append(obs - base)
+        return max(0.0, min(deltas) / statistics.median(bases))
+    finally:
+        d_obs.stop()
+        d_base.stop()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
@@ -683,6 +907,11 @@ def main(argv=None) -> int:
                     help="write a SERVE_r18.json persistent-cache + "
                     "pipelined-dispatch artifact (round 18; subprocess "
                     "restart arm + in-process pipeline arm)")
+    ap.add_argument("--obs-out", default=None, metavar="PATH",
+                    help="write an OBS_r19.json serving-observatory "
+                    "artifact (round 19; two live replicas under a "
+                    "burst, scraped + pooled over HTTP, with the "
+                    "paired observatory-overhead measurement)")
     ap.add_argument("--pipeline-window", type=int, default=2,
                     help="in-flight batch window for the round-18 "
                     "pipeline arm (must be > 1)")
@@ -716,8 +945,9 @@ def main(argv=None) -> int:
             return 1
         return run_persist_phase(args)
 
-    if not (args.out or args.persist_out):
-        print("serve_load: need at least one of --out / --persist-out")
+    if not (args.out or args.persist_out or args.obs_out):
+        print("serve_load: need at least one of --out / --persist-out "
+              "/ --obs-out")
         return 1
 
     if args.out:
@@ -774,6 +1004,23 @@ def main(argv=None) -> int:
             f"{p['cold_ms']} ms -> restart {p['cold_restart_ms']} ms, "
             f"{p['restart_speedup']}x; pipeline p99 "
             f"{persist_record['pipeline']['p99_warm_ms']} ms)"
+        )
+
+    if args.obs_out:
+        obs_record = run_obs(args)
+        oerrs = validate_obs(obs_record)
+        if oerrs:
+            print("serve_load: generated obs record INVALID:")
+            for e in oerrs:
+                print(f"  - {e}")
+            return 1
+        _write_json(args.obs_out, obs_record)
+        fleet = obs_record["fleet"]
+        print(
+            f"serve_load: wrote {args.obs_out} "
+            f"({fleet['replicas_live']}/{fleet['replicas_total']} "
+            f"replicas, fleet verdict {fleet['slo']['verdict']!r}, "
+            f"overhead {obs_record['observatory_overhead_frac']})"
         )
     return 0
 
