@@ -819,6 +819,25 @@ def cmd_serve(args) -> int:
                 "no_data", file=sys.stderr,
             )
         anomaly_config = AnomalyConfig(baseline_p99_ms=baseline_p99)
+    lattice_plan = None
+    if getattr(args, "lattice", None):
+        from .serving.lattice import parse_lattice_spec, plan_lattice
+
+        try:
+            lattice_cfg = parse_lattice_spec(args.lattice)
+        except ValueError as e:
+            raise SystemExit(f"serve: {e}")
+        if lattice_cfg is not None:
+            lattice_plan = plan_lattice(lattice_cfg)
+            lat = lattice_plan.lattice
+            print(
+                f"lattice[{lattice_plan.source}]: rungs "
+                f"{list(lat.rungs)} x channels "
+                f"{list(lat.config.channels)} = {lat.size} buckets "
+                f"(growth {lat.growth:g}, "
+                f"{len(lattice_plan.rejected)} candidate(s) rejected)",
+                flush=True,
+            )
     with telemetry_session(
         None, enabled=True, artifact_dir=args.trace_dir,
         metrics_port=None, flight_capacity=args.flight_ring,
@@ -852,6 +871,7 @@ def cmd_serve(args) -> int:
             obs_interval_s=args.obs_interval_s,
             obs_capacity=args.obs_capacity,
             anomaly_config=anomaly_config,
+            lattice=lattice_plan,
         )
         try:
             daemon.start()
@@ -1349,6 +1369,19 @@ def main(argv=None) -> int:
         help="flight-recorder event-ring capacity (default: "
         "IA_FLIGHT_RING env or 512; memory scales linearly, "
         "~200-500 bytes per event)",
+    )
+    p.add_argument(
+        "--lattice", default=None, metavar="SPEC",
+        help="shape-lattice admission (round 20): canonicalize "
+        "sessionless frames onto a geometric grid of bucket shapes "
+        "(edge-pad at ingest, crop at demux) so exec-key cardinality "
+        "is bounded by the lattice, not by traffic, and warmup "
+        "precompiles EVERY bucket before the endpoint announces.  "
+        "SPEC: off (default) | on (32:512, planner-chosen growth) | "
+        "MIN:MAX (planner-chosen growth) | MIN:MAX:GROWTH (explicit "
+        "override).  Frames over the top rung bypass to the exact-key "
+        "path; a takeover successor must run the SAME spec for "
+        "bit-identical journal replay",
     )
     _add_synth_flags(p)
     p.set_defaults(fn=cmd_serve)

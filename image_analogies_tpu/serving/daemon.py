@@ -108,6 +108,23 @@ and the gauges all read the lock-guarded in-flight count, so every
 round-16 ledger claim holds with the window open; responses stay
 bit-identical to solo dispatch because the split moves WHERE settle
 runs, never what the engine computes.
+
+Shape-lattice admission (round 20, serving/lattice.py): with
+`lattice=` set, sessionless frames are edge-padded up to the smallest
+lattice bucket containing them at `_make_request` — BEFORE the
+executable key and the luminance bucket are computed, so the key, the
+compat identity, the dispatch, and the disk-tier seal all see the
+bucket shape — and demux crops each request's output row back to its
+true (H, W).  exec_key cardinality is thereby bounded by the lattice
+(`lattice.size` executables, all precompiled by warmup before the
+port announce) instead of by traffic; frames larger than the top rung
+bypass to the round-13 exact-key path with an honest miss, and
+session traffic never buckets (a stream's NNF state is sized to its
+real frames).  The `ia_lattice_admissions_total{path=...}` counter
+and `ia_lattice_bucket_waste_frac` gauge price the trade live, and
+`ia_serve_shape_cardinality` splits into `view="raw"` /
+`view="bucketed"` cells (the unlabeled cell follows the bucketed
+series — what the anomaly watch grades).
 """
 
 from __future__ import annotations
@@ -278,6 +295,7 @@ class SynthDaemon:
         obs_interval_s: float = 5.0,
         obs_capacity: int = 120,
         anomaly_config=None,
+        lattice=None,
     ):
         from ..parallel.batch import make_mesh
         from ..telemetry.anomaly import AnomalyDetector
@@ -299,6 +317,28 @@ class SynthDaemon:
         self.admission = AdmissionController(
             max_depth=max_queue_depth, registry=registry
         )
+        # Round 20 shape lattice: `lattice` may be a LatticePlan (the
+        # CLI's planner output), a LatticeConfig (planned here), or
+        # None (off).  Resolved before the executable cache and
+        # _init_metrics: the LRU capacity must hold the WHOLE bucket
+        # grid — a capacity under the grid makes warmup evict its own
+        # work (thrash), silently voiding the warm-before-announce
+        # contract — and the lattice metric family registers exactly
+        # when the lattice exists.
+        self.lattice = None
+        self.lattice_plan = None
+        if lattice is not None:
+            from .lattice import LatticeConfig, plan_lattice
+
+            if isinstance(lattice, LatticeConfig):
+                lattice = plan_lattice(lattice)
+            self.lattice_plan = lattice
+            self.lattice = lattice.lattice
+            # Grid + headroom so a trickle of bypass (over-top) keys
+            # cannot evict the warm lattice either.
+            cache_capacity = max(
+                cache_capacity, self.lattice.size + 2
+            )
         self.cache = ExecutableCache(
             capacity=cache_capacity, registry=registry
         )
@@ -370,11 +410,22 @@ class SynthDaemon:
         # round-12 SIGTERM handler used to cut them mid-write).
         self._outstanding = 0
         self._outstanding_lock = threading.Lock()
+        # Running mean of per-request pad waste over lattice-admitted
+        # (non-bypass) traffic — handler threads book it, so guarded.
+        self._lattice_lock = threading.Lock()
+        self._lattice_waste_sum = 0.0
+        self._lattice_waste_n = 0
         # Runtime-observed frame shapes, LRU order — the drift fix for
         # hand-authored warmup manifests: snapshotted to
         # warmup.observed.json and merged into the successor's warmup.
+        # With the lattice on this set holds BUCKET shapes (what the
+        # successor must actually precompile — the raw-shape long tail
+        # would re-fragment its warmup); the raw client shapes are
+        # tracked separately for the `view="raw"` cardinality cell.
         self._observed_shapes: "OrderedDict[Tuple[int, ...], None]" = \
             OrderedDict()
+        self._observed_raw_shapes: \
+            "OrderedDict[Tuple[int, ...], None]" = OrderedDict()
         # Round 19 observatory: windowed time-series ring + live
         # anomaly watches, sampled on one daemon thread.  Interval <= 0
         # disables the whole plane (the overhead-pin harness's bare
@@ -470,12 +521,39 @@ class SynthDaemon:
         self._g_shape_card = r.gauge(
             "ia_serve_shape_cardinality",
             "distinct client frame shapes observed (LRU-bounded at "
-            "32) — the anomaly detector's shape-growth watch input",
+            "32), split into view=raw (as sent) and view=bucketed "
+            "(post-lattice) cells; the unlabeled cell follows the "
+            "bucketed series — the anomaly detector's shape-growth "
+            "watch input (raw == bucketed when the lattice is off)",
         )
         self._g_depth.set(0)
         self._g_inflight.set(0)
         self._g_pipeline.set(0)
         self._g_shape_card.set(0)
+        self._g_shape_card.set(0, labels={"view": "raw"})
+        self._g_shape_card.set(0, labels={"view": "bucketed"})
+        if self.lattice is not None:
+            self._c_lattice = r.counter(
+                "ia_lattice_admissions_total",
+                "sessionless admissions through the shape lattice by "
+                "path: bucketed (padded up to a bucket), exact "
+                "(already on a bucket shape), bypass (over the top "
+                "rung — exact-key path, honest miss)",
+            )
+            self._g_lattice_waste = r.gauge(
+                "ia_lattice_bucket_waste_frac",
+                "running mean fraction of the bucket canvas that is "
+                "pad, over lattice-admitted requests (the per-request "
+                "compute price of bounded exec-key cardinality)",
+            )
+            self._g_lattice_buckets = r.gauge(
+                "ia_lattice_buckets",
+                "exec-key cardinality bound the lattice guarantees "
+                "for in-bounds sessionless traffic (rungs^2 x "
+                "channels)",
+            )
+            self._g_lattice_waste.set(0.0)
+            self._g_lattice_buckets.set(self.lattice.size)
 
     # ------------------------------------------------------ lifecycle
     def start(self) -> "SynthDaemon":
@@ -632,18 +710,27 @@ class SynthDaemon:
         (cheap: those dispatches restore, they don't compile).  Round
         18: distinct shapes warm concurrently on `warmup_workers`
         threads, with per-shape compile walls on the warmup span tree
-        (run_warmup's docstring)."""
+        (run_warmup's docstring).  Round 20: with the lattice on, the
+        FULL bucket grid joins the entry list — warm-before-announce
+        now covers every shape in-bounds traffic can possibly key —
+        and both the dedup key and the dispatch run through the same
+        bucketing `_make_request` applies, so an off-bucket manifest
+        entry warms its bucket exactly once instead of compiling a
+        raw shape no client dispatch will ever key."""
+        from .excache import merge_warmup_entries
+
         if self.state_dir is not None:
-            from .excache import (
-                load_observed_warmup,
-                merge_warmup_entries,
-            )
+            from .excache import load_observed_warmup
 
             entries = merge_warmup_entries(
                 entries,
                 load_observed_warmup(self.observed_warmup_path),
                 self.disk.warmup_shapes() if self.disk is not None
                 else [],
+            )
+        if self.lattice is not None:
+            entries = merge_warmup_entries(
+                entries, self.lattice.shapes()
             )
 
         def dispatch(shape):
@@ -656,14 +743,32 @@ class SynthDaemon:
                     f"{req.error}"
                 )
 
+        def key_fn(shape):
+            return exec_key(
+                self._lattice_shape(shape), self.cfg,
+                self.policy.max_batch,
+            )
+
         return run_warmup(
-            entries, dispatch, self.cache,
-            lambda shape: exec_key(shape, self.cfg, self.policy.max_batch),
+            entries, dispatch, self.cache, key_fn,
             max_workers=self.warmup_workers,
             tracer=self.tracer if self.observability else None,
         )
 
     # ------------------------------------------------------- serving
+    def _lattice_shape(self, shape) -> tuple:
+        """A shape tuple as the lattice would admit it: (H, W[, C])
+        with the leading two axes rounded up to their bucket, raw when
+        the lattice is off or the shape bypasses (over the top rung).
+        The warmup dedup key and the dispatch path must agree on
+        exactly this mapping."""
+        if self.lattice is None:
+            return tuple(shape)
+        b = self.lattice.bucket_for(int(shape[0]), int(shape[1]))
+        if b is None:
+            return tuple(shape)
+        return b + tuple(shape[2:])
+
     def _make_request(self, frame: np.ndarray,
                       session: Optional[str] = None,
                       req_id: Optional[str] = None) -> ServeRequest:
@@ -671,6 +776,40 @@ class SynthDaemon:
         # stream's own solo-mesh executables, so their cache identity
         # is the batch-1 grain, not the daemon's padding grain.
         grain = 1 if session is not None else self.policy.max_batch
+        crop = None
+        # Lattice admission (round 20), sessionless only: pad BEFORE
+        # the executable key and the luma bucket are computed, so the
+        # whole downstream pipeline — compat identity, dispatch stack,
+        # disk-tier seal — sees the bucket shape and nothing else.
+        # (A video session's NNF state is sized to its true frames;
+        # bucketing it would warm-start from misaligned state.)
+        if self.lattice is not None and session is None:
+            h, w = int(frame.shape[0]), int(frame.shape[1])
+            b = self.lattice.bucket_for(h, w)
+            if b is None:
+                path = "bypass"
+            elif b == (h, w):
+                path = "exact"
+            else:
+                pad = [(0, b[0] - h), (0, b[1] - w)]
+                if frame.ndim == 3:
+                    pad.append((0, 0))
+                frame = np.pad(frame, pad, mode="edge")
+                crop = (h, w)
+                path = "bucketed"
+            # Client + replay traffic only (warmup's synthetic
+            # dispatches carry no req_id and are not admissions).
+            if req_id:
+                self._c_lattice.inc(labels={"path": path})
+                if b is not None:
+                    waste = self.lattice.waste_frac(h, w, b[0], b[1])
+                    with self._lattice_lock:
+                        self._lattice_waste_sum += waste
+                        self._lattice_waste_n += 1
+                        self._g_lattice_waste.set(round(
+                            self._lattice_waste_sum
+                            / self._lattice_waste_n, 6,
+                        ))
         key = exec_key(frame.shape, self.cfg, grain)
         bucket = None
         if self.cfg.color_mode == "luminance" and \
@@ -679,7 +818,7 @@ class SynthDaemon:
         kwargs = {"req_id": req_id} if req_id else {}
         return ServeRequest(
             frame=frame, key=key, compat=key + (bucket, session),
-            b_stats=bucket, session=session, **kwargs,
+            b_stats=bucket, session=session, crop=crop, **kwargs,
         )
 
     def _route_synthesize(self, body: Optional[bytes], headers=None,
@@ -993,6 +1132,7 @@ class SynthDaemon:
             "cache": self.cache.snapshot(),
             "disk_cache": (self.disk.snapshot()
                            if self.disk is not None else None),
+            "lattice": self._lattice_snapshot(),
             "sessions": {
                 "active": len(self._sessions),
                 "max": self.max_sessions,
@@ -1014,6 +1154,30 @@ class SynthDaemon:
             },
         }
         return 200, _json_bytes(snap), "application/json"
+
+    def _lattice_snapshot(self) -> Optional[Dict[str, Any]]:
+        """The /serving lattice section: grid geometry + the decision
+        provenance + the live waste/cardinality numbers (None with the
+        lattice off)."""
+        if self.lattice is None:
+            return None
+        with self._lattice_lock:
+            waste_n = self._lattice_waste_n
+            mean_waste = (self._lattice_waste_sum / waste_n
+                          if waste_n else 0.0)
+        snap = dict(self.lattice.snapshot())
+        snap.update({
+            "source": (self.lattice_plan.source
+                       if self.lattice_plan is not None
+                       else "direct"),
+            "mean_bucket_waste_frac": round(mean_waste, 6),
+            "admissions": waste_n,
+            "shape_cardinality": {
+                "raw": len(self._observed_raw_shapes),
+                "bucketed": len(self._observed_shapes),
+            },
+        })
+        return snap
 
     def _route_journal(self, _body):
         """GET /journal: the durability ledger — journal counts, the
@@ -1135,24 +1299,50 @@ class SynthDaemon:
             return None
         return os.path.join(self.state_dir, OBSERVED_WARMUP_FILE)
 
+    @staticmethod
+    def _lru_note(lru: "OrderedDict", key, cap: int = 32) -> bool:
+        """Insert/refresh `key` in an LRU set bounded at `cap`;
+        True when the key was fresh."""
+        fresh = key not in lru
+        lru[key] = None
+        lru.move_to_end(key)
+        while len(lru) > cap:
+            lru.popitem(last=False)
+        return fresh
+
     def _note_observed_shape(self, manifest: Dict[str, Any]) -> None:
         """LRU-track the (H, W, C) shapes real clients send; persisted
         on first sighting and at drain so the successor's warmup
         compiles what traffic actually needs, not what the manifest
-        author guessed."""
+        author guessed.  With the lattice on, the PERSISTED set holds
+        bucket shapes (what a successor must actually precompile —
+        persisting the raw long tail would re-fragment its warmup into
+        exactly the cardinality the lattice exists to bound) while the
+        raw client shapes feed the `view="raw"` cardinality cell."""
         shape = manifest.get("shape")
         if not (isinstance(shape, list) and len(shape) == 3):
             return
-        key = tuple(int(d) for d in shape)
-        fresh = key not in self._observed_shapes
-        self._observed_shapes[key] = None
-        self._observed_shapes.move_to_end(key)
-        while len(self._observed_shapes) > 32:
-            self._observed_shapes.popitem(last=False)
-        # Cardinality gauge for the anomaly shape-growth watch: every
+        raw = tuple(int(d) for d in shape)
+        key = raw
+        if self.lattice is not None:
+            self._lru_note(self._observed_raw_shapes, raw)
+            b = self.lattice.bucket_for(raw[0], raw[1])
+            if b is not None:
+                key = b + raw[2:]
+        fresh = self._lru_note(self._observed_shapes, key)
+        # Cardinality gauges for the anomaly shape-growth watch: every
         # distinct shape is a compile, so a climbing gauge is compile
-        # budget walking out the door.
-        self._g_shape_card.set(len(self._observed_shapes))
+        # budget walking out the door.  The unlabeled cell follows the
+        # bucketed series (== raw when the lattice is off) — the
+        # series the watch grades, so the lattice's collapse doesn't
+        # mask genuine raw-traffic drift (which keeps its own cell).
+        bucketed = len(self._observed_shapes)
+        raw_card = (len(self._observed_raw_shapes)
+                    if self.lattice is not None else bucketed)
+        self._g_shape_card.set(bucketed)
+        self._g_shape_card.set(raw_card, labels={"view": "raw"})
+        self._g_shape_card.set(bucketed,
+                               labels={"view": "bucketed"})
         if fresh and self.state_dir is not None:
             try:
                 self._save_observed_shapes()

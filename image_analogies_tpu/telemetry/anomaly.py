@@ -205,13 +205,31 @@ class AnomalyDetector:
             return _watch("shape_cardinality", "no_data", None,
                           cfg.shape_card_max,
                           "shape-cardinality gauge not yet published")
-        cell = next(iter(cells.values()))
+        # Round 20: the gauge splits into view=raw / view=bucketed
+        # cells once the daemon publishes them.  The watch grades the
+        # BUCKETED series — post-lattice cardinality is what actually
+        # spends compile budget, and with the lattice off the daemon
+        # keeps bucketed == raw, so the watch's round-19 meaning is
+        # unchanged.  Older unlabeled-only registries fall back to the
+        # first cell, exactly as before.
+        cell = None
+        for label_str, c in cells.items():
+            try:
+                labels = parse_label_str(label_str)
+            except ValueError:
+                continue
+            if labels.get("view") == "bucketed":
+                cell = c
+                break
+        view = "bucketed" if cell is not None else "observed"
+        if cell is None:
+            cell = next(iter(cells.values()))
         card = float(cell.get("value", 0.0))
         grew = cell.get("delta")
         status = "firing" if card >= cfg.shape_card_max else "ok"
         return _watch(
             "shape_cardinality", status, card, cfg.shape_card_max,
-            f"{card:g} distinct observed shapes"
+            f"{card:g} distinct {view} shapes"
             + (f" (+{grew:g} in window)" if grew else ""),
         )
 

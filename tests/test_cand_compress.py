@@ -182,6 +182,10 @@ class TestQuantization:
         q, s = quantize_rows(jnp.zeros((3, 8), jnp.bfloat16))
         assert np.asarray(q).sum() == 0 and np.isfinite(np.asarray(s)).all()
 
+    @pytest.mark.slow  # r20 tier-1 budget: the int8 stage-1 contract
+    # stays pinned in tier-1 by test_dist_ratio_gate_128's full
+    # compressed arm plus TestPolishInt8's distance/counter checks;
+    # this 128^2 ulp-level dequant-parity sweep rides the slow set.
     def test_int8_sweep_equals_f32_on_dequantized_planes(self, rng):
         """The stage-1 kernel contract: the int8 sweep computes on the
         dequantized grid in f32, so it must match the f32 kernel run

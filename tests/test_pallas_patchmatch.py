@@ -215,7 +215,13 @@ class TestPackedLayout:
                     unp[:, b : unp.shape[1] - 1 + b, c, :],
                 )
 
-    @pytest.mark.parametrize("n_bands", [1, 2])
+    # r20 tier-1 budget: n_bands=1 pins the packed layout in tier-1;
+    # the n_bands=2 band-ownership re-pin rides the slow set — the
+    # banded contract itself stays tier-1-covered by
+    # test_sharded_a_band_search_matches_sequential.
+    @pytest.mark.parametrize(
+        "n_bands", [1, pytest.param(2, marks=pytest.mark.slow)]
+    )
     def test_sweep_bit_identical_across_layouts(self, rng, n_bands):
         """One full sweep over random candidate tables (including
         offsets far outside A, so the sy/sx clamps and the packed

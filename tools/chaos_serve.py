@@ -32,6 +32,13 @@ the serving TIER and grades what the round-16 resilience machinery
   serve_evict              a forced cache-epoch eviction yields an
                            honest recompile (miss), never a wrong
                            answer
+  lattice_shape_burst      (round 20) kill_midburst with RANDOM-shaped
+                           frames under --lattice: the journal stores
+                           raw frames, the same-spec takeover
+                           re-buckets each replay at admission, and
+                           zero-acked-loss + bit-identity must hold
+                           across bucket boundaries and the bypass
+                           path
 
 Usage:
     JAX_PLATFORMS=cpu python tools/chaos_serve.py
@@ -190,10 +197,16 @@ def _burst(url, bodies):
 
 
 def _takeover_and_verify(a_path, ap_path, state_dir, frames_by_rid,
-                         min_pending: int):
+                         min_pending: int, extra=()):
     """Spawn a --takeover successor, wait for the replay backlog to
     hit zero, then re-post each replayed request's frame fresh and
     compare hashes.  Returns the arm's measurement dict.
+
+    ``extra`` rides through to the successor's CLI flags — the lattice
+    arm needs the SAME `--lattice` spec on both sides of the takeover,
+    because the journal stores RAW frames and replay re-buckets them
+    at admission (a successor on a different spec would key replays
+    onto different executables and break bit-identity honestly).
 
     ``pending_at_takeover`` is measured from the dead predecessor's
     journal ON DISK (the daemon's own torn-tolerant scanner), not from
@@ -207,7 +220,7 @@ def _takeover_and_verify(a_path, ap_path, state_dir, frames_by_rid,
     trace_b = tempfile.mkdtemp(prefix="ia_chaos_takeover_")
     t0 = time.monotonic()
     proc, url = _spawn_serve(
-        a_path, ap_path, trace_b, takeover=state_dir
+        a_path, ap_path, trace_b, takeover=state_dir, extra=extra
     )
     try:
         deadline = time.monotonic() + 300
@@ -286,6 +299,66 @@ def _arm_kill_midburst(a_path, ap_path, size):
     arm.update({
         "name": "kill_midburst_takeover",
         "burst_size": len(frames),
+        "acked_before_kill": appended,
+    })
+    shutil.rmtree(state_dir, ignore_errors=True)
+    shutil.rmtree(trace_a, ignore_errors=True)
+    return arm
+
+
+def _arm_lattice_shape_burst(a_path, ap_path, size):
+    """Round 20: kill mid-burst with RANDOM-SHAPED frames under
+    `--lattice` — every frame a different (H, W), straddling bucket
+    boundaries, one below the bottom rung and one over the top (the
+    bypass path).  The journal stores RAW frames, so the `--takeover`
+    successor (same spec) re-buckets each replay at admission; zero
+    acked loss and bit-identical replay must hold exactly as they do
+    for fixed-shape traffic."""
+    import numpy as np
+
+    spec = f"8:{size}:2"
+    rng = np.random.default_rng(2016)
+    shapes = []
+    while len(shapes) < 5:
+        hw = (int(rng.integers(5, size + 1)),
+              int(rng.integers(5, size + 1)))
+        if hw not in shapes:
+            shapes.append(hw)
+    shapes.append((size + 1, size))  # over the top rung: bypass path
+    frames = [
+        rng.random((h, w, 3)).astype(np.float32) for h, w in shapes
+    ]
+    state_dir = tempfile.mkdtemp(prefix="ia_chaos_lat_state_")
+    trace_a = tempfile.mkdtemp(prefix="ia_chaos_lat_victim_")
+    proc, url = _spawn_serve(
+        a_path, ap_path, trace_a, state_dir=state_dir,
+        extra=("--lattice", spec),
+    )
+    bodies = [(f"lat-{i}", _body(f)) for i, f in enumerate(frames)]
+    frames_by_rid = {f"lat-{i}": f for i, f in enumerate(frames)}
+    try:
+        threads, _ = _burst(url, bodies)
+        deadline = time.monotonic() + 120
+        appended = 0
+        while time.monotonic() < deadline:
+            appended = _get_json(url + "/journal")["ledger"]["appended"]
+            if appended >= len(frames):
+                break
+            time.sleep(0.05)
+    finally:
+        proc.kill()
+        _reap(proc)
+    for t in threads:
+        t.join(timeout=30)
+    arm = _takeover_and_verify(
+        a_path, ap_path, state_dir, frames_by_rid, min_pending=4,
+        extra=("--lattice", spec),
+    )
+    arm.update({
+        "name": "lattice_shape_burst",
+        "lattice_spec": spec,
+        "burst_size": len(frames),
+        "burst_shapes": [list(s) for s in shapes],
         "acked_before_kill": appended,
     })
     shutil.rmtree(state_dir, ignore_errors=True)
@@ -516,12 +589,19 @@ def run_chaos_serve(size: int = 24):
         arms.append(_arm_drain_handoff(a_path, ap_path, size))
         arms.append(_arm_kill_midburst(a_path, ap_path, size))
         arms.append(_arm_serve_crash_torn(a_path, ap_path, size))
+        arms.append(_arm_lattice_shape_burst(a_path, ap_path, size))
     finally:
         shutil.rmtree(asset_dir, ignore_errors=True)
 
     by_name = {arm["name"]: arm for arm in arms}
     kill = by_name["kill_midburst_takeover"]
     torn = by_name["serve_crash_torn"]
+    # Round 20 randomized-shape arm: folded into the headline cells so
+    # the resilience claims cover bucket-boundary replay too.  (The
+    # committed CHAOS_SERVE_r16.json predates the arm; its validator
+    # checks it only when present.)
+    lat = by_name.get("lattice_shape_burst")
+    recovery_arms = [a for a in (kill, torn, lat) if a is not None]
     return {
         "schema_version": CHAOS_SERVE_SCHEMA_VERSION,
         "kind": "chaos_serve",
@@ -536,13 +616,12 @@ def run_chaos_serve(size: int = 24):
         # rounds (replay_bit_identical as 1.0/0.0 so the numeric
         # series machinery can hold its floor at 1.0).
         "acked_loss": max(
-            kill["acked_loss"], torn["acked_loss"]
+            a["acked_loss"] for a in recovery_arms
         ),
         "recovery_warm_ms": kill["recovery_warm_ms"],
-        "replay_bit_identical": float(
-            kill["replay_bit_identical"]
-            and torn["replay_bit_identical"]
-        ),
+        "replay_bit_identical": float(all(
+            a["replay_bit_identical"] for a in recovery_arms
+        )),
         "arms": arms,
     }
 

@@ -148,6 +148,31 @@ def validate_chaos_serve(record: dict) -> List[str]:
             "arm must prove a torn tail is skipped, not absent"
         )
 
+    # Round 20 randomized-shape arm (lattice_shape_burst): checked
+    # only when PRESENT — the committed CHAOS_SERVE_r16.json predates
+    # the shape lattice and stays valid — but a record that carries it
+    # is held to the full recovery contract plus shape diversity (a
+    # burst of identical shapes would not cross a bucket boundary and
+    # proves nothing the kill arm did not already prove).
+    lat = by_name.get("lattice_shape_burst")
+    if lat is not None:
+        _check_recovery_arm("lattice_shape_burst", lat, errs)
+        if not lat.get("lattice_spec"):
+            errs.append(
+                "lattice_shape_burst: lattice_spec missing — the "
+                "replay contract depends on the successor running "
+                "the same spec"
+            )
+        shapes = lat.get("burst_shapes")
+        if not (isinstance(shapes, list)
+                and len({tuple(s) for s in shapes
+                         if isinstance(s, list)}) >= 4):
+            errs.append(
+                f"lattice_shape_burst: burst_shapes {shapes!r} has "
+                "fewer than 4 distinct shapes — no bucket boundary "
+                "was crossed"
+            )
+
     drain = by_name["drain_handoff"]
     if drain.get("exit_code") != 0:
         errs.append(

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Validate a LATTICE_r20.json shape-lattice artifact (round 20).
+
+The shape-lattice acceptance bar, enforced by a validator instead of
+trusted to prose:
+
+  - bounded keys: the never-seen-shape burst must add ZERO executable
+    cache entries beyond the warmed grid — exec-key cardinality is
+    the lattice's, not the traffic's — and the grid itself must be
+    fully resident after warmup (warm-before-announce covers every
+    in-bounds shape);
+  - hit-everything: every burst request (arbitrary never-seen shapes,
+    a 1x1 degenerate, an exact bucket bound) is a cache HIT, with
+    cold-shape p99 within 2x the warm p99 — the collapse from the
+    ~24x compile-priced cold shapes SERVE_r18 measured;
+  - bit-identity: the lattice's cropped output equals the unbucketed
+    daemon's answer for the same frame edge-padded client-side
+    (crop(serve(pad(F))) == lattice(F)), with zero mismatches, and an
+    exactly-on-bucket frame byte-identical outright;
+  - honest bypass: a frame over the top rung is a real MISS on the
+    exact-key path, booked under path="bypass" — never a silent crop
+    or an inflated hit rate;
+  - recorded decision: the bucket geometry carries its planner
+    provenance (chosen candidate + rejected field, or an explicit
+    override) so the waste-vs-amortization trade is auditable.
+
+Usage:
+    python tools/check_lattice.py LATTICE_r20.json
+
+Runs under pytest too (tests/test_lattice.py validates the COMMITTED
+artifact) so tier-1 fails if the record is missing, truncated, or
+claims a collapse it cannot show.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+LATTICE_SCHEMA_VERSION = 1
+
+# The acceptance criterion's latency bound: never-seen-shape p99 must
+# sit within this multiple of the warm p99.
+P99_COLD_OVER_WARM_MAX = 2.0
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_lattice(record: dict) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if record.get("schema_version") != LATTICE_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {record.get('schema_version')!r} != "
+            f"{LATTICE_SCHEMA_VERSION}"
+        )
+    if record.get("kind") != "lattice":
+        errs.append(f"kind {record.get('kind')!r} != 'lattice'")
+
+    # -- recorded decision ------------------------------------------
+    plan = record.get("plan") or {}
+    lat = plan.get("lattice") or {}
+    rungs = lat.get("rungs")
+    buckets = lat.get("buckets")
+    if not (isinstance(rungs, list) and rungs
+            and all(_num(r) for r in rungs)
+            and rungs == sorted(rungs)
+            and len(set(rungs)) == len(rungs)):
+        errs.append(f"plan.lattice.rungs {rungs!r} is not a strictly "
+                    "ascending rung ladder")
+    if not (_num(buckets) and buckets >= 1):
+        errs.append(f"plan.lattice.buckets {buckets!r} invalid")
+    source = plan.get("source")
+    if source not in ("planner", "override"):
+        errs.append(f"plan.source {source!r} not in "
+                    "('planner', 'override')")
+    if source == "planner" and not plan.get("rejected"):
+        errs.append(
+            "plan.source is 'planner' but no rejected candidates are "
+            "recorded — a decision with no alternatives is not a "
+            "decision"
+        )
+    if not isinstance(plan.get("chosen"), dict):
+        errs.append("plan.chosen missing — the priced winning "
+                    "candidate must be recorded")
+
+    # -- bounded keys ------------------------------------------------
+    ek = record.get("exec_keys") or {}
+    bound = ek.get("bound")
+    warm_res = ek.get("resident_after_warmup")
+    burst_res = ek.get("resident_after_burst")
+    if not (_num(bound) and bound == buckets):
+        errs.append(
+            f"exec_keys.bound {bound!r} != plan.lattice.buckets "
+            f"{buckets!r} — the bound must BE the lattice size"
+        )
+    if not (_num(warm_res) and warm_res == bound):
+        errs.append(
+            f"exec_keys.resident_after_warmup {warm_res!r} != bound "
+            f"{bound!r} — warmup must precompile the WHOLE grid"
+        )
+    if not (_num(burst_res) and _num(warm_res)
+            and burst_res == warm_res):
+        errs.append(
+            f"exec_keys.resident_after_burst {burst_res!r} != "
+            f"resident_after_warmup {warm_res!r} — the never-seen "
+            "burst grew the executable set: cardinality is not "
+            "bounded by the lattice"
+        )
+
+    # -- hit-everything + the p99 bound -----------------------------
+    burst = record.get("burst") or {}
+    if burst.get("all_hits") is not True:
+        errs.append("burst.all_hits is not true — a never-seen "
+                    "in-bounds shape missed the warm grid")
+    if not (_num(burst.get("requests")) and burst["requests"] >= 8):
+        errs.append(
+            f"burst.requests {burst.get('requests')!r} < 8 — the "
+            "burst is too small to claim a p99"
+        )
+    shapes = burst.get("shapes")
+    if not (isinstance(shapes, list)
+            and any(s == [1, 1] for s in shapes)):
+        errs.append("burst.shapes carries no 1x1 degenerate frame — "
+                    "the lattice floor was never exercised")
+    warm = record.get("warm") or {}
+    p99_warm = warm.get("p99_ms")
+    p99_cold = burst.get("p99_cold_ms")
+    ratio = record.get("p99_cold_over_warm")
+    if not (_num(p99_warm) and p99_warm > 0
+            and _num(p99_cold) and p99_cold > 0):
+        errs.append(
+            f"warm.p99_ms {p99_warm!r} / burst.p99_cold_ms "
+            f"{p99_cold!r} are not positive walls"
+        )
+    elif not (_num(ratio)
+              and abs(ratio - p99_cold / p99_warm) < 0.01):
+        errs.append(
+            f"p99_cold_over_warm {ratio!r} does not match "
+            f"p99_cold_ms/p99_warm_ms = {p99_cold / p99_warm:.4f}"
+        )
+    elif ratio > P99_COLD_OVER_WARM_MAX:
+        errs.append(
+            f"p99_cold_over_warm {ratio} > {P99_COLD_OVER_WARM_MAX} "
+            "— never-seen shapes did not collapse to the warm "
+            "envelope"
+        )
+
+    # -- bit-identity ------------------------------------------------
+    ident = record.get("bit_identity") or {}
+    if not (_num(ident.get("verified")) and ident["verified"] >= 3):
+        errs.append(
+            f"bit_identity.verified {ident.get('verified')!r} < 3 — "
+            "the crop contract was never meaningfully compared"
+        )
+    if _num(ident.get("mismatched")) and ident["mismatched"]:
+        errs.append(
+            f"bit_identity.mismatched {ident['mismatched']} — a "
+            "cropped output differs from the unbucketed path's "
+            "answer for the padded frame"
+        )
+    if ident.get("mismatched") is None:
+        errs.append("bit_identity.mismatched missing")
+    if ident.get("on_bucket_identical") is not True:
+        errs.append(
+            "bit_identity.on_bucket_identical is not true — a frame "
+            "already on a bucket shape must ride the lattice "
+            "byte-identically to the lattice-off path"
+        )
+
+    # -- honest bypass ----------------------------------------------
+    bypass = record.get("bypass") or {}
+    if bypass.get("cache") != "miss":
+        errs.append(
+            f"bypass.cache {bypass.get('cache')!r} != 'miss' — an "
+            "over-the-top-rung frame must pay an honest exact-key "
+            "compile, not fake a hit"
+        )
+    if not (_num(bypass.get("admissions"))
+            and bypass["admissions"] >= 1):
+        errs.append(
+            f"bypass.admissions {bypass.get('admissions')!r} — the "
+            "bypass was never counted"
+        )
+    bypass_keys = ek.get("bypass_keys")
+    if not (_num(bypass_keys) and bypass_keys >= 1):
+        errs.append(
+            f"exec_keys.bypass_keys {bypass_keys!r} — the bypass "
+            "request left no exact-key cache entry"
+        )
+
+    # -- cardinality + sentinel -------------------------------------
+    card = record.get("cardinality") or {}
+    raw_c, buck_c = card.get("raw"), card.get("bucketed")
+    if not (_num(raw_c) and _num(buck_c) and buck_c <= raw_c):
+        errs.append(
+            f"cardinality raw={raw_c!r} bucketed={buck_c!r} — "
+            "bucketed cardinality must not exceed raw"
+        )
+    elif _num(bound) and _num(bypass_keys) \
+            and buck_c > bound + bypass_keys:
+        errs.append(
+            f"cardinality.bucketed {buck_c} > lattice bound {bound} "
+            f"+ bypass keys {bypass_keys}"
+        )
+    if record.get("serving_check") != "ok":
+        errs.append(
+            f"serving_check {record.get('serving_check')!r} != 'ok' "
+            "— the admission/cache ledgers did not balance under the "
+            "lattice"
+        )
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="LATTICE_r20.json to validate")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"check_lattice: cannot read {args.path}: {e}")
+        return 1
+    errs = validate_lattice(record)
+    if errs:
+        print(f"check_lattice: {args.path} INVALID:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    ek = record.get("exec_keys", {})
+    print(
+        f"check_lattice: {args.path} OK "
+        f"({ek.get('bound')} buckets, burst added "
+        f"{ek.get('resident_after_burst', 0) - ek.get('resident_after_warmup', 0)} keys, "
+        f"p99 cold/warm {record.get('p99_cold_over_warm')}x)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

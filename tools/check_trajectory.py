@@ -66,6 +66,7 @@ _MESH2D_RE = re.compile(r"^MESH2D_r(\d+)\.json$")
 # field — the filename round number alone is not the discriminator.
 _SERVE_PERSIST_RE = re.compile(r"^SERVE_r(\d+)\.json$")
 _OBS_RE = re.compile(r"^OBS_r(\d+)\.json$")
+_LATTICE_RE = re.compile(r"^LATTICE_r(\d+)\.json$")
 
 PROVENANCES = ("measured", "carried", "modeled")
 
@@ -207,6 +208,22 @@ OBS_SERIES: Tuple[Dict, ...] = (
      "label": "observatory request-path overhead fraction"},
 )
 
+# LATTICE artifacts (round 20: tools/serve_load.py --lattice-out)
+# carry the shape-lattice admission headline at top level: the
+# never-seen-shape-burst p99 over the warm p99.  The 2.0 ceiling IS
+# the acceptance criterion (cold shapes collapse into the warm
+# envelope because every in-bounds shape keys onto a precompiled
+# bucket); the trend is held loosely (rel_tol 1.0 + abs_tol 0.25) like
+# the other CPU-proxy serving walls — a ratio of two shared-machine
+# p99s is noisy, and the hard bound is the real gate (check_lattice
+# enforces it per record; this table re-states it so a future edit
+# cannot silently drop it from history).
+LATTICE_SERIES: Tuple[Dict, ...] = (
+    {"field": "p99_cold_over_warm", "direction": "lower",
+     "rel_tol": 1.0, "abs_tol": 0.25, "ceiling": 2.0, "since": 20,
+     "label": "never-seen-shape p99 over warm p99 (lattice admission)"},
+)
+
 # SCALE rows are keyed by size; each series is tracked per size.
 SCALE_SERIES: Tuple[Dict, ...] = (
     {"field": "wall_s", "direction": "lower", "rel_tol": 0.10,
@@ -324,7 +341,7 @@ def _flatten_serve_persist(rec):
 
 def load_history(root: str):
     """(bench, scale, video, slo, chaos_serve, mesh2d, serve_persist,
-    obs) lists of
+    obs, lattice) lists of
     (round, filename, payload), round-sorted.  BENCH payloads unwrap the driver's capture wrapper
     to the parsed record.  Builder probe files (BENCH_r*_builder*.json)
     do not match the round pattern and are deliberately out of scope —
@@ -338,6 +355,7 @@ def load_history(root: str):
     )
     serve_persist = []
     obs = []
+    lattice = []
     for name in sorted(os.listdir(root)):
         m = _BENCH_RE.match(name)
         if m:
@@ -386,6 +404,10 @@ def load_history(root: str):
         if m:
             with open(os.path.join(root, name)) as f:
                 obs.append((int(m.group(1)), name, json.load(f)))
+        m = _LATTICE_RE.match(name)
+        if m:
+            with open(os.path.join(root, name)) as f:
+                lattice.append((int(m.group(1)), name, json.load(f)))
     bench.sort(key=lambda t: t[0])
     scale.sort(key=lambda t: t[0])
     video.sort(key=lambda t: t[0])
@@ -394,8 +416,9 @@ def load_history(root: str):
     mesh2d.sort(key=lambda t: t[0])
     serve_persist.sort(key=lambda t: t[0])
     obs.sort(key=lambda t: t[0])
+    lattice.sort(key=lambda t: t[0])
     return (bench, scale, video, slo, chaos_serve, mesh2d,
-            serve_persist, obs)
+            serve_persist, obs, lattice)
 
 
 # ------------------------------------------------------ schema (by era)
@@ -627,7 +650,7 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
     """All schema + trajectory checks over the committed history.
     Returns (violations, machine-readable report rows)."""
     (bench, scale, video, slo, chaos_serve, mesh2d,
-     serve_persist, obs) = load_history(root)
+     serve_persist, obs, lattice) = load_history(root)
     errs: List[str] = []
     report: List[Dict] = []
 
@@ -674,6 +697,13 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
         from check_obs import validate_obs
 
         errs.extend(f"{name}: {e}" for e in validate_obs(rec))
+    for rnd, name, rec in lattice:
+        # Shape-lattice artifacts carry their full contract — bounded
+        # keys, all-hit burst, crop bit-identity, honest bypass — in
+        # check_lattice.
+        from check_lattice import validate_lattice
+
+        errs.extend(f"{name}: {e}" for e in validate_lattice(rec))
 
     for decl in BENCH_SERIES:
         check_series(
@@ -709,6 +739,12 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
         check_series(
             decl, [(r, n, rec) for r, n, rec in obs],
             f"obs.{decl['field']}", errs, report,
+        )
+    for decl in LATTICE_SERIES:
+        # The cold/warm p99 ratio is top-level in the LATTICE record.
+        check_series(
+            decl, [(r, n, rec) for r, n, rec in lattice],
+            f"lattice.{decl['field']}", errs, report,
         )
     def _rows(data):
         rows = data.get("rows") if isinstance(data, dict) else None

@@ -67,6 +67,7 @@ from __future__ import annotations
 
 import argparse
 import base64
+import gc
 import hashlib
 import json
 import os
@@ -82,6 +83,7 @@ from typing import List, Optional, Tuple
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from check_lattice import validate_lattice  # noqa: E402
 from check_obs import validate_obs  # noqa: E402
 from check_serve import validate_serve  # noqa: E402
 from check_serve_persist import validate_serve_persist  # noqa: E402
@@ -896,6 +898,253 @@ def _measure_obs_overhead(a, ap_img, cfg, body, anomaly_cfg) -> float:
         d_base.stop()
 
 
+def run_lattice(args) -> dict:
+    """Round 20 shape-lattice arm: one lattice-on daemon (the full
+    bucket grid precompiled by warmup) vs one lattice-off reference,
+    driven through a NEVER-SEEN-SHAPE burst.
+
+    The claims the artifact commits, all enforced by
+    tools/check_lattice.py before the write:
+
+      - bounded keys: after warming the grid, the burst's arbitrary
+        shapes add ZERO executable-cache entries (every in-bounds
+        request keys onto a lattice bucket);
+      - hit-everything: every burst request — shapes the daemon has
+        never seen, including a 1x1 degenerate and exact bucket
+        bounds — is a cache HIT, and its p99 sits within 2x the warm
+        p99 of repeats on the top bucket shape (vs the ~24x
+        compile-priced cold shapes cost per SERVE_r18);
+      - bit-identity: the lattice's cropped output equals the
+        lattice-off daemon's answer for the same frame edge-padded
+        client-side (the crop(serve(pad(F))) contract), and an
+        exactly-on-bucket frame is byte-identical with no padding at
+        all;
+      - honest bypass: a frame over the top rung takes the exact-key
+        path as a real miss, booked under path="bypass".
+    """
+    import numpy as np
+
+    from image_analogies_tpu.config import SynthConfig
+    from image_analogies_tpu.serving.daemon import SynthDaemon
+    from image_analogies_tpu.serving.lattice import (
+        parse_lattice_spec,
+        plan_lattice,
+    )
+    from image_analogies_tpu.telemetry.metrics import (
+        MetricsRegistry,
+        set_registry,
+    )
+
+    a, ap_img, _ = _make_inputs(args.seed, args.size)
+    cfg = SynthConfig(
+        levels=2, matcher="patchmatch", pallas_mode="off",
+        em_iters=1, pm_iters=2,
+    )
+    lat_cfg = parse_lattice_spec(args.lattice_spec)
+    if lat_cfg is None:
+        raise RuntimeError(
+            f"--lattice-spec {args.lattice_spec!r} parses to OFF"
+        )
+    plan = plan_lattice(lat_cfg)
+    lat = plan.lattice
+    print(
+        f"serve_load: lattice[{plan.source}] rungs {list(lat.rungs)} "
+        f"= {lat.size} buckets (growth {lat.growth:g})", flush=True,
+    )
+
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    daemon = SynthDaemon(
+        a, ap_img, cfg, registry=reg, max_batch=1, max_wait_ms=1.0,
+        cache_capacity=lat.size + 4, max_retries=1, lattice=plan,
+        obs_interval_s=0,
+    ).start()
+    ref = SynthDaemon(
+        a, ap_img, cfg, registry=MetricsRegistry(), max_batch=1,
+        max_wait_ms=1.0, cache_capacity=lat.size + 4, max_retries=1,
+        obs_interval_s=0,
+    ).start()
+    rng = np.random.default_rng(args.seed + 20)
+    try:
+        # -- warmup: the whole grid, before any client traffic.
+        t0 = time.perf_counter()
+        warm_report = daemon.warmup([])
+        warmup_ms = (time.perf_counter() - t0) * 1000.0
+        resident_warm = daemon.cache.snapshot()["resident"]
+        if resident_warm != lat.size:
+            raise RuntimeError(
+                f"warmup left {resident_warm} executables resident, "
+                f"expected the full grid ({lat.size})"
+            )
+
+        def post_expect(url, frame, want_cache=None):
+            t0 = time.perf_counter()
+            code, r = _post(url, _frame_body(frame))
+            wall = (time.perf_counter() - t0) * 1000.0
+            if code != 200:
+                raise RuntimeError(
+                    f"request {frame.shape}: {code} ({r.get('error')})"
+                )
+            if want_cache is not None and r.get("cache") != want_cache:
+                raise RuntimeError(
+                    f"request {frame.shape}: cache "
+                    f"{r.get('cache')!r}, expected {want_cache!r}"
+                )
+            return wall, r
+
+        def decode(r):
+            return np.frombuffer(
+                base64.b64decode(r["image_b64"]), np.float32
+            ).reshape(r["shape"])
+
+        # -- warm baseline: repeats on the TOP bucket shape (the
+        # largest canvas any in-bounds request can run on, so the
+        # burst's per-request compute is bounded by the baseline's).
+        # GC is parked across both measured sections: the daemon runs
+        # in-process, and a collection pause landing inside one
+        # ~15 ms request reads as a fake multiple-of-warm cold wall.
+        top = lat.top
+        warm_frame = rng.random((top, top, 3)).astype(np.float32)
+        gc.collect()
+        gc.disable()
+        warm_walls = []
+        for _ in range(args.requests_per_client * 4):
+            wall, _r = post_expect(daemon.url, warm_frame, "hit")
+            warm_walls.append(wall)
+        p50_warm, p99_warm = _quantiles(warm_walls)
+
+        # -- never-seen-shape burst: random in-bounds shapes the
+        # daemon has never dispatched, plus the adversarial corners —
+        # a 1x1 degenerate frame and an exactly-on-bucket-bound
+        # shape.  Every one must be a cache hit.
+        shapes = set()
+        while len(shapes) < 12:
+            h = int(rng.integers(max(1, lat.rungs[0] - 7), top + 1))
+            w = int(rng.integers(max(1, lat.rungs[0] - 7), top + 1))
+            if (h, w) != (top, top):
+                shapes.add((h, w))
+        burst_shapes = sorted(shapes) + [(1, 1), (lat.rungs[0], top)]
+        burst_walls = []
+        identity = {"verified": 0, "mismatched": 0}
+        for i, (h, w) in enumerate(burst_shapes):
+            frame = rng.random((h, w, 3)).astype(np.float32)
+            wall, r = post_expect(daemon.url, frame, "hit")
+            burst_walls.append(wall)
+            if list(r["shape"]) != [h, w, 3]:
+                raise RuntimeError(
+                    f"burst {h}x{w}: response shape {r['shape']}"
+                )
+            if i < 4 or (h, w) in ((1, 1), (lat.rungs[0], top)):
+                # Bit-identity probe: the unbucketed daemon's answer
+                # for the same frame edge-padded client-side, cropped
+                # back, must match byte for byte.
+                bh, bw = lat.bucket_for(h, w)
+                padded = np.pad(
+                    frame, [(0, bh - h), (0, bw - w), (0, 0)],
+                    mode="edge",
+                )
+                _w, rr = post_expect(ref.url, padded)
+                same = np.array_equal(
+                    decode(r), decode(rr)[:h, :w]
+                )
+                identity["verified" if same else "mismatched"] += 1
+        p50_cold, p99_cold = _quantiles(burst_walls)
+        gc.enable()
+        resident_burst = daemon.cache.snapshot()["resident"]
+
+        # -- on-bucket identity: a frame already on a bucket shape
+        # rides untouched — byte-identical to the lattice-off path.
+        on_frame = rng.random(
+            (lat.rungs[0], lat.rungs[0], 3)
+        ).astype(np.float32)
+        _w1, r1 = post_expect(daemon.url, on_frame, "hit")
+        _w2, r2 = post_expect(ref.url, on_frame)
+        on_bucket_identical = r1["image_b64"] == r2["image_b64"]
+
+        # -- bypass: over the top rung -> exact-key path, honest miss.
+        by_frame = rng.random((top + 1, top, 3)).astype(np.float32)
+        _w, r_by = post_expect(daemon.url, by_frame, "miss")
+        resident_final = daemon.cache.snapshot()["resident"]
+
+        snap = reg.to_dict()
+        admissions = {
+            path: float(snap.get(
+                "ia_lattice_admissions_total", {}
+            ).get("values", {}).get(f'{{path="{path}"}}', 0.0))
+            for path in ("bucketed", "exact", "bypass")
+        }
+        card_vals = snap.get(
+            "ia_serve_shape_cardinality", {}
+        ).get("values", {})
+        lattice_serving = daemon._lattice_snapshot()
+        record = {
+            "schema_version": 1,
+            "kind": "lattice",
+            "round": 20,
+            "generated_by": "tools/serve_load.py --lattice-out",
+            "proxy_size": args.size,
+            "config": {
+                "levels": cfg.levels, "matcher": cfg.matcher,
+                "em_iters": cfg.em_iters, "pm_iters": cfg.pm_iters,
+                "lattice_spec": args.lattice_spec,
+            },
+            "plan": plan.as_dict(),
+            "warmup": {
+                "buckets": lat.size,
+                "resident_after_warmup": resident_warm,
+                "wall_ms": round(warmup_ms, 1),
+                "shapes_compiled": len(warm_report),
+            },
+            "warm": {
+                "shape": [top, top, 3],
+                "requests": len(warm_walls),
+                "p50_ms": p50_warm,
+                "p99_ms": p99_warm,
+            },
+            "burst": {
+                "shapes": [list(s) for s in burst_shapes],
+                "requests": len(burst_walls),
+                "all_hits": True,
+                "p50_cold_ms": p50_cold,
+                "p99_cold_ms": p99_cold,
+            },
+            "p99_cold_over_warm": round(p99_cold / p99_warm, 4),
+            "bit_identity": dict(
+                identity, on_bucket_identical=on_bucket_identical,
+            ),
+            "bypass": {
+                "shape": [top + 1, top, 3],
+                "cache": r_by.get("cache"),
+                "admissions": admissions["bypass"],
+            },
+            "exec_keys": {
+                "bound": lat.size,
+                "resident_after_warmup": resident_warm,
+                "resident_after_burst": resident_burst,
+                "resident_final": resident_final,
+                "bypass_keys": resident_final - resident_burst,
+            },
+            "cardinality": {
+                "raw": card_vals.get('{view="raw"}'),
+                "bucketed": card_vals.get('{view="bucketed"}'),
+            },
+            "waste": {
+                "mean_bucket_waste_frac":
+                    lattice_serving["mean_bucket_waste_frac"],
+                "worst_waste_frac_bound":
+                    plan.chosen.worst_waste_frac,
+            },
+            "admissions": admissions,
+            "serving_check": _serving_check(daemon),
+        }
+        return record
+    finally:
+        gc.enable()  # idempotent; covers a mid-measurement raise
+        daemon.stop()
+        ref.stop()
+        set_registry(prev)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
@@ -912,6 +1161,18 @@ def main(argv=None) -> int:
                     "artifact (round 19; two live replicas under a "
                     "burst, scraped + pooled over HTTP, with the "
                     "paired observatory-overhead measurement)")
+    ap.add_argument("--lattice-out", default=None, metavar="PATH",
+                    help="write a LATTICE_r20.json shape-lattice "
+                    "artifact (round 20; lattice-on daemon vs "
+                    "unbucketed reference under a never-seen-shape "
+                    "burst: bounded exec keys, all-hit cold shapes, "
+                    "crop bit-identity, honest bypass)")
+    ap.add_argument("--lattice-spec", default="16:36",
+                    metavar="SPEC",
+                    help="lattice spec for the round-20 arm "
+                    "(default 16:36 — planner-chosen growth, so the "
+                    "artifact records a real chosen-vs-rejected "
+                    "decision)")
     ap.add_argument("--pipeline-window", type=int, default=2,
                     help="in-flight batch window for the round-18 "
                     "pipeline arm (must be > 1)")
@@ -945,9 +1206,10 @@ def main(argv=None) -> int:
             return 1
         return run_persist_phase(args)
 
-    if not (args.out or args.persist_out or args.obs_out):
+    if not (args.out or args.persist_out or args.obs_out
+            or args.lattice_out):
         print("serve_load: need at least one of --out / --persist-out "
-              "/ --obs-out")
+              "/ --obs-out / --lattice-out")
         return 1
 
     if args.out:
@@ -1004,6 +1266,24 @@ def main(argv=None) -> int:
             f"{p['cold_ms']} ms -> restart {p['cold_restart_ms']} ms, "
             f"{p['restart_speedup']}x; pipeline p99 "
             f"{persist_record['pipeline']['p99_warm_ms']} ms)"
+        )
+
+    if args.lattice_out:
+        lattice_record = run_lattice(args)
+        lerrs = validate_lattice(lattice_record)
+        if lerrs:
+            print("serve_load: generated lattice record INVALID:")
+            for e in lerrs:
+                print(f"  - {e}")
+            return 1
+        _write_json(args.lattice_out, lattice_record)
+        ek = lattice_record["exec_keys"]
+        print(
+            f"serve_load: wrote {args.lattice_out} "
+            f"({ek['bound']} buckets warm, burst added "
+            f"{ek['resident_after_burst'] - ek['resident_after_warmup']}"
+            f" keys, p99 cold/warm "
+            f"{lattice_record['p99_cold_over_warm']}x)"
         )
 
     if args.obs_out:
