@@ -864,6 +864,7 @@ def cmd_serve(args) -> int:
                 if args.trace_dir else None
             ),
             state_dir=state_dir,
+            warm_dir=args.warm_dir,
             drain_deadline_s=args.drain_deadline_s,
             dispatch_deadline_s=args.dispatch_deadline_s,
             pipeline_window=args.pipeline_window,
@@ -1163,6 +1164,64 @@ def cmd_obs(args) -> int:
     return 0
 
 
+def cmd_route(args) -> int:
+    """Fleet router (round 21, serving/router.py): spread POST
+    /synthesize across N daemon replicas — least outstanding work with
+    queue-depth awareness from each replica's /serving snapshot,
+    session affinity for video streams, drain-time session migration —
+    and keep a replica-discovery file current for `ia-synth obs`.
+    Imports no JAX; this process is pure coordination."""
+    import signal as _signal
+    import threading
+
+    from .serving.router import FleetRouter
+    from .telemetry.metrics import MetricsRegistry
+
+    try:
+        from .serving.observatory import parse_targets
+
+        targets = parse_targets(args.targets)
+    except ValueError as e:
+        raise SystemExit(f"route: {e}")
+    registry = MetricsRegistry()
+    router = FleetRouter(
+        registry,
+        host=args.host,
+        port=args.port,
+        poll_interval_s=args.poll_interval_s,
+        discovery_path=args.discovery_out,
+        proxy_timeout_s=args.proxy_timeout_s,
+    ).start()
+    stop = threading.Event()
+    _signal.signal(_signal.SIGTERM, lambda *_: stop.set())
+    try:
+        for url in targets:
+            handle = router.add_replica(url)
+            state = "up" if handle.alive else "DOWN"
+            print(f"route: replica {handle.name} {handle.url} "
+                  f"[{state}]")
+        if args.trace_dir:
+            os.makedirs(args.trace_dir, exist_ok=True)
+            router.live.announce(args.trace_dir)
+        print(
+            f"routing on {router.url} (POST /synthesize "
+            "/replicas/add /replicas/remove /drain_replica; GET "
+            "/fleet /replicas /slo /metrics /metrics.json /healthz)",
+            flush=True,
+        )
+        if args.discovery_out:
+            print(f"route: discovery file at {args.discovery_out} "
+                  "(pass to `ia-synth obs --targets`)")
+        while not stop.wait(1.0):
+            pass
+        print("route: exiting", flush=True)
+    except KeyboardInterrupt:
+        print("route: interrupted")
+    finally:
+        router.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="image_analogies_tpu",
@@ -1311,6 +1370,16 @@ def main(argv=None) -> int:
         "contract).  DIR/daemon.lock refuses a second live daemon",
     )
     p.add_argument(
+        "--warm-dir", default=None, metavar="DIR",
+        help="fleet-shared warm tier (round 21): root the disk "
+        "executable cache and warmup.observed.json here instead of "
+        "the per-replica --state-dir, so N replicas share one sealed-"
+        "executable set and one merged observed-shape union — a "
+        "freshly spawned replica precompiles the fleet's working set "
+        "before its port announce.  Journal, lock, and session "
+        "snapshots stay in --state-dir (per-replica)",
+    )
+    p.add_argument(
         "--takeover", default=None, metavar="DIR",
         help="take over a dead/drained daemon's state dir: restore "
         "its snapshotted sessions, merge its runtime-observed warmup "
@@ -1385,6 +1454,48 @@ def main(argv=None) -> int:
     )
     _add_synth_flags(p)
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "route",
+        help="fleet router: spread POST /synthesize across N serve "
+        "replicas with session affinity, queue-aware least-"
+        "outstanding routing, drain-time session migration, and a "
+        "discovery file for `ia-synth obs` (round 21)",
+    )
+    _add_common_flags(p)
+    p.add_argument(
+        "--targets", required=True, metavar="HOST:PORT,... | FILE",
+        help="initial replica endpoints: comma-separated host:port / "
+        "http:// URLs, or an existing discovery file (replicas can "
+        "also join later via POST /replicas/add)",
+    )
+    p.add_argument(
+        "--port", type=int, default=0,
+        help="router bind port (0 = ephemeral; announces in "
+        "<trace-dir>/live.json when --trace-dir is set)",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument(
+        "--poll-interval-s", type=float, default=0.5, metavar="S",
+        help="replica /serving scrape cadence feeding the queue-"
+        "depth-aware routing scores (default 0.5)",
+    )
+    p.add_argument(
+        "--proxy-timeout-s", type=float, default=600.0, metavar="S",
+        help="per-proxy HTTP timeout (default 600 — must outlast a "
+        "cold compile on the slowest replica)",
+    )
+    p.add_argument(
+        "--discovery-out", default=None, metavar="JSON",
+        help="replica-discovery file, rewritten atomically on every "
+        "membership/drain change; `ia-synth obs --targets FILE` "
+        "scrapes exactly this fleet",
+    )
+    p.add_argument(
+        "--trace-dir", default=None, metavar="DIR",
+        help="announce the router endpoint in DIR/live.json",
+    )
+    p.set_defaults(fn=cmd_route)
 
     p = sub.add_parser(
         "obs",

@@ -288,6 +288,7 @@ class SynthDaemon:
         access_log_path: Optional[str] = None,
         slo_window_s: float = 300.0,
         state_dir: Optional[str] = None,
+        warm_dir: Optional[str] = None,
         drain_deadline_s: float = 30.0,
         dispatch_deadline_s: Optional[float] = None,
         pipeline_window: int = 2,
@@ -399,6 +400,12 @@ class SynthDaemon:
         # Round 16 resilience state (all inert when state_dir is None
         # except drain, which still quiesces and exits cleanly).
         self.state_dir = state_dir
+        # Round 21 shared warm tier: the fleet-shared directory N
+        # replicas root their disk executable cache and observed-
+        # warmup file under (journal/lock/sessions stay per-replica in
+        # state_dir).  None = warm state lives in state_dir, the
+        # single-daemon rounds 16-20 layout unchanged.
+        self.warm_dir = warm_dir
         self.journal: Optional[RequestJournal] = None
         self.drain_deadline_s = float(drain_deadline_s)
         self.dispatch_deadline_s = dispatch_deadline_s
@@ -573,18 +580,24 @@ class SynthDaemon:
             self.journal = RequestJournal(
                 journal_path(self.state_dir), registry=self.registry
             )
+        if self._warm_root is not None:
             # Disk executable tier: restore the persisted warm set
             # BEFORE the dispatcher exists (and hence before cmd_serve
             # can announce the endpoint) — rendezvous implies the
             # sealed executables are already resident — then install
             # the tier as the engine's process-wide persist hook so
-            # this daemon's dispatches read/write it.
+            # this daemon's dispatches read/write it.  With --warm-dir
+            # the root is the FLEET-shared dir: every replica restores
+            # the union of what any replica sealed (index writes
+            # merge, never clobber), which is what makes a freshly
+            # spawned replica's first request land near the fleet's
+            # warm p99 instead of the cold-compile wall.
             from ..parallel import batch as _pbatch
 
             from .excache import DiskExecCache
 
             self.disk = DiskExecCache(
-                os.path.join(self.state_dir, "excache"),
+                os.path.join(self._warm_root, "excache"),
                 registry=self.registry,
             )
             restored = self.disk.restore_warm_set()
@@ -616,6 +629,7 @@ class SynthDaemon:
                 ("GET", "/obs/window"): self._route_obs_window,
                 ("GET", "/request"): self._route_request,
                 ("POST", "/drain"): self._route_drain,
+                ("POST", "/sessions/adopt"): self._route_sessions_adopt,
             },
         ).start()
         if self.obs is not None:
@@ -701,13 +715,17 @@ class SynthDaemon:
     def warmup(self, entries: List[Dict[str, Any]]) -> List[Dict]:
         """Compile the manifest's shapes through the real dispatch
         path BEFORE announcing the endpoint (cli.cmd_serve orders it
-        so): rendezvous implies warm.  With a state dir, the hand-
-        authored manifest is merged with the predecessor's RUNTIME-
-        OBSERVED shapes (warmup.observed.json) — the fix for manifest
-        drift, where the shapes clients actually send stopped matching
-        the shapes the manifest author guessed — plus the disk tier's
-        sealed shapes, so a restart re-warms its persisted working set
-        (cheap: those dispatches restore, they don't compile).  Round
+        so): rendezvous implies warm.  With a warm root (state dir, or
+        the fleet-shared --warm-dir), the hand-authored manifest is
+        merged with the RUNTIME-OBSERVED shapes (warmup.observed.json
+        — under a shared warm dir that file is the UNION every replica
+        merged in, so a fresh replica precompiles the whole fleet's
+        observed buckets before its port announce) — the fix for
+        manifest drift, where the shapes clients actually send stopped
+        matching the shapes the manifest author guessed — plus the
+        disk tier's sealed shapes, so a restart re-warms its persisted
+        working set (cheap: those dispatches restore, they don't
+        compile).  Round
         18: distinct shapes warm concurrently on `warmup_workers`
         threads, with per-shape compile walls on the warmup span tree
         (run_warmup's docstring).  Round 20: with the lattice on, the
@@ -719,7 +737,7 @@ class SynthDaemon:
         raw shape no client dispatch will ever key."""
         from .excache import merge_warmup_entries
 
-        if self.state_dir is not None:
+        if self._warm_root is not None:
             from .excache import load_observed_warmup
 
             entries = merge_warmup_entries(
@@ -1119,6 +1137,12 @@ class SynthDaemon:
         snap = {
             "queue_depth": len(self.queue),
             "inflight": self._inflight,
+            # Round 21: the router's poller routes on queue_depth +
+            # inflight and needs the drain state + state_dir (the
+            # migration source) without a second scrape.
+            "draining": self._draining.is_set(),
+            "state_dir": self.state_dir,
+            "warm_dir": self.warm_dir,
             "policy": {
                 "max_batch": self.policy.max_batch,
                 "max_wait_ms": self.policy.max_wait_ms,
@@ -1266,12 +1290,23 @@ class SynthDaemon:
 
     def _drain_snapshot(self) -> None:
         """Persist the hand-off state a takeover successor restores:
-        the runtime-observed warm shapes and every resident session's
-        carried NNF/B' state (session ids are hashed into dir names —
-        they are client-chosen strings, not safe path components)."""
+        every resident session's carried NNF/B' state (session ids are
+        hashed into dir names — they are client-chosen strings, not
+        safe path components), the runtime-observed warm shapes, and
+        finally the journal's pending-only compaction.  ORDER IS THE
+        ROUND-21 DRAIN CONTRACT: the router is told "drained" only
+        after this whole function, but a SIGKILL can land anywhere
+        inside it — sessions.json must hit disk BEFORE the journal
+        compaction runs, because the compaction is the one destructive
+        step (it discards retired history); sessions-first means a
+        mid-drain kill leaves either the old journal intact (replay
+        works, snapshot maybe stale) or the full snapshot plus a
+        compacted journal — never a compacted journal with the session
+        snapshot the router was promised still unwritten."""
         if self.state_dir is None:
+            if self.warm_dir is not None:
+                self._save_observed_shapes()
             return
-        self._save_observed_shapes()
         import hashlib
 
         index: Dict[str, str] = {}
@@ -1291,13 +1326,25 @@ class SynthDaemon:
         with open(tmp, "w", encoding="utf-8") as fh:
             json.dump({"schema_version": 1, "sessions": index}, fh)
         os.replace(tmp, os.path.join(self.state_dir, "sessions.json"))
+        self._save_observed_shapes()
+        if self.journal is not None:
+            self.journal.compact()
 
     # --------------------------------------------- takeover machinery
     @property
+    def _warm_root(self) -> Optional[str]:
+        """Directory the warm tier (disk excache + observed warmup)
+        lives under: the fleet-shared --warm-dir when set, else the
+        replica's own state dir."""
+        return self.warm_dir if self.warm_dir is not None \
+            else self.state_dir
+
+    @property
     def observed_warmup_path(self) -> Optional[str]:
-        if self.state_dir is None:
+        root = self._warm_root
+        if root is None:
             return None
-        return os.path.join(self.state_dir, OBSERVED_WARMUP_FILE)
+        return os.path.join(root, OBSERVED_WARMUP_FILE)
 
     @staticmethod
     def _lru_note(lru: "OrderedDict", key, cap: int = 32) -> bool:
@@ -1343,17 +1390,21 @@ class SynthDaemon:
         self._g_shape_card.set(raw_card, labels={"view": "raw"})
         self._g_shape_card.set(bucketed,
                                labels={"view": "bucketed"})
-        if fresh and self.state_dir is not None:
+        if fresh and self._warm_root is not None:
             try:
                 self._save_observed_shapes()
             except OSError:
                 pass
 
     def _save_observed_shapes(self) -> None:
-        if self.state_dir is None or not self._observed_shapes:
+        if self._warm_root is None or not self._observed_shapes:
             return
+        # Under a fleet-shared warm dir each replica UNIONS its shapes
+        # into the file (overwrite would shrink the fleet's observed
+        # set to the last drainer's traffic slice — round 21 satellite).
         save_observed_warmup(
-            self.observed_warmup_path, list(self._observed_shapes)
+            self.observed_warmup_path, list(self._observed_shapes),
+            merge=self.warm_dir is not None,
         )
 
     def restore_sessions(self) -> int:
@@ -1389,6 +1440,97 @@ class SynthDaemon:
                 self._sessions[sid] = stream
                 n += 1
         return n
+
+    def adopt_sessions(self, source_state_dir: str,
+                       only: Optional[List[str]] = None) -> List[str]:
+        """Round 21 cross-replica session migration: restore session
+        streams from ANOTHER replica's drain snapshot (the router
+        calls POST /sessions/adopt when it drains a replica, handing
+        that replica's pinned sessions to survivors over the shared
+        filesystem).  `only` limits adoption to the named session ids;
+        None adopts the whole snapshot.  Best-effort per session —
+        one that fails to restore simply runs its next frame cold on
+        whichever replica it lands.  Returns the adopted ids.
+
+        Runs on an HTTP handler thread while the dispatcher owns
+        `_sessions`: plain dict insertion is safe under the GIL, and
+        the router's migration protocol routes an adopted session's
+        next frame here only AFTER this call returns, so the
+        dispatcher never races the restore of a stream it is using."""
+        import dataclasses
+
+        from ..video.sequence import VideoStream
+
+        idx_path = os.path.join(source_state_dir, "sessions.json")
+        try:
+            with open(idx_path, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return []
+        sessions = doc.get("sessions")
+        if not isinstance(sessions, dict):
+            return []
+        wanted = None if only is None else {str(s) for s in only}
+        cfg = dataclasses.replace(self.cfg, save_level_artifacts=None)
+        adopted: List[str] = []
+        for sid, dirname in sessions.items():
+            if not (isinstance(sid, str) and isinstance(dirname, str)):
+                continue
+            if wanted is not None and sid not in wanted:
+                continue
+            sdir = os.path.join(source_state_dir, "sessions",
+                                os.path.basename(dirname))
+            stream = VideoStream(
+                self.a, self.ap, cfg=cfg, registry=self.registry
+            )
+            if stream.restore_state(sdir):
+                self._sessions[sid] = stream
+                self._sessions.move_to_end(sid)
+                adopted.append(sid)
+        while len(self._sessions) > self.max_sessions:
+            self._sessions.popitem(last=False)
+        if adopted:
+            self.registry.counter(
+                "ia_serve_sessions_adopted_total",
+                "session streams adopted from another replica's drain "
+                "snapshot (round 21 fleet migration)",
+            ).inc(len(adopted))
+        return adopted
+
+    def _route_sessions_adopt(self, body: Optional[bytes]):
+        """POST /sessions/adopt {"state_dir": DIR, "sessions": [...]}:
+        the router-facing migration endpoint (adopt_sessions above).
+        Refused while draining — a draining replica is shedding
+        sessions, not collecting them."""
+        try:
+            doc = json.loads((body or b"{}").decode("utf-8"))
+            if not isinstance(doc, dict):
+                raise ValueError("body must be a JSON object")
+            src = doc.get("state_dir")
+            if not isinstance(src, str) or not src:
+                raise ValueError("state_dir (source replica's state "
+                                 "dir) is required")
+            only = doc.get("sessions")
+            if only is not None and not (
+                isinstance(only, list)
+                and all(isinstance(s, str) for s in only)
+            ):
+                raise ValueError("sessions must be a list of strings")
+        except (ValueError, UnicodeDecodeError) as e:
+            return 400, _json_bytes(
+                {"status": "rejected", "error": str(e)}
+            ), "application/json"
+        if self._draining.is_set():
+            return 503, _json_bytes({
+                "status": "unavailable",
+                "error": "daemon is draining; adopt elsewhere",
+            }), "application/json"
+        adopted = self.adopt_sessions(src, only=only)
+        return 200, _json_bytes({
+            "status": "ok",
+            "adopted": adopted,
+            "sessions_active": len(self._sessions),
+        }), "application/json"
 
     def replay_journal(self) -> int:
         """Takeover: push every journal-pending request back through

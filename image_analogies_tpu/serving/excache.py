@@ -328,23 +328,36 @@ OBSERVED_WARMUP_FILE = "warmup.observed.json"
 OBSERVED_WARMUP_KIND = "serve_warmup_observed"
 
 
-def save_observed_warmup(path: str, shapes) -> None:
+def save_observed_warmup(path: str, shapes, merge: bool = False) -> None:
     """Persist the runtime-observed working set (round 16 satellite:
     warmup-manifest drift).  `shapes` is an LRU-ordered iterable of
     (height, width, channels) actually served by this process; the
     successor merges them into its warmup so restarts pre-compile the
     REAL traffic mix, not just the hand-declared manifest.  Atomic
     write (tmp + replace): a crash mid-write leaves the previous
-    generation readable."""
+    generation readable.
+
+    `merge=True` is the round-21 shared-warm-tier mode: the file lives
+    under a fleet-shared warm dir, so N replicas write it — each
+    writer UNIONS its shapes into whatever is already on disk instead
+    of overwriting (last-writer-wins would shrink the fleet's observed
+    set to one replica's traffic slice).  The read-union-replace race
+    between two simultaneous drains can drop at most one writer's
+    fresh shapes for one generation; the loser re-merges them on its
+    next sighting, so the union converges."""
     import os
 
+    entries = [
+        {"height": int(h), "width": int(w), "channels": int(c)}
+        for (h, w, c) in shapes
+    ]
+    if merge:
+        entries = merge_warmup_entries(load_observed_warmup(path),
+                                       entries)
     doc = {
         "schema_version": WARMUP_SCHEMA_VERSION,
         "kind": OBSERVED_WARMUP_KIND,
-        "entries": [
-            {"height": int(h), "width": int(w), "channels": int(c)}
-            for (h, w, c) in shapes
-        ],
+        "entries": entries,
     }
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
@@ -657,6 +670,10 @@ class DiskExecCache:
         self._loaded: Dict[tuple, Any] = {}
         # key_str(exec_key) -> {"shape", "warmup_shape", "blobs"}
         self._entries: Dict[str, Dict[str, Any]] = {}
+        # Keys THIS process deliberately dropped (dead blobs found by
+        # probe/restore): the shared-dir index merge must not
+        # resurrect them from a sibling's older index generation.
+        self._dropped: set = set()
         self._ctx = threading.local()
         self.errors = 0
         self.stored = 0
@@ -835,12 +852,41 @@ class DiskExecCache:
                     }
 
     def _write_index(self) -> None:
+        """Whole-index write, MERGED with whatever a sibling process
+        already put on disk (round 21 shared warm tier: N replicas
+        root their DiskExecCache at one `--warm-dir`, so last-writer-
+        wins would silently discard every other replica's sealed
+        entries).  Same-fingerprint on-disk entries this process
+        neither holds nor deliberately dropped carry through; a key
+        dropped here as dead stays dropped (a sibling that re-seals it
+        writes it back).  The read-merge-replace race between two
+        simultaneous seals can lose one writer's newest entry for one
+        generation — its next seal or index write restores it, so the
+        union converges."""
         import os
 
+        entries: Dict[str, Dict[str, Any]] = {}
+        path = self._index_path()
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+            if (isinstance(doc, dict)
+                    and doc.get("schema_version") == DISK_SCHEMA_VERSION
+                    and doc.get("fingerprint") == self._fp
+                    and isinstance(doc.get("entries"), dict)):
+                for kstr, e in doc["entries"].items():
+                    if str(kstr) in self._dropped:
+                        continue
+                    if (isinstance(e, dict)
+                            and isinstance(e.get("blobs"), list)):
+                        entries[str(kstr)] = e
+        except (OSError, ValueError):
+            pass
+        entries.update(self._entries)
         doc = {
             "schema_version": DISK_SCHEMA_VERSION,
             "fingerprint": self._fp,
-            "entries": self._entries,
+            "entries": entries,
         }
         tmp = self._index_path() + ".tmp"
         try:
@@ -1037,6 +1083,7 @@ class DiskExecCache:
             if self._entries.get(kstr) == entry:
                 return
             self._entries[kstr] = entry
+            self._dropped.discard(kstr)
             self._write_index()
 
     # -------------------------------------------------- verdict/restore
@@ -1076,6 +1123,7 @@ class DiskExecCache:
             # dispatch recompile + re-seal.
             with self._lock:
                 self._entries.pop(kstr, None)
+                self._dropped.add(kstr)
                 self._write_index()
         self._count("misses", kind)
         return "miss"
@@ -1106,6 +1154,7 @@ class DiskExecCache:
             if not ok:
                 with self._lock:
                     self._entries.pop(kstr, None)
+                    self._dropped.add(kstr)
                     self._write_index()
                 continue
             report.append({

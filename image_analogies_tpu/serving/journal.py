@@ -216,6 +216,44 @@ class RequestJournal:
         self._publish()
         return True
 
+    def compact(self) -> int:
+        """Rewrite the journal down to its still-pending entries (one
+        atomic generation: tmp + replace, then the `.1` rotation file
+        is dropped — its retired history is now redundant).  The drain
+        path runs this AFTER the session snapshot lands: the pending
+        set a takeover successor replays must never be the freshest
+        thing on disk while the session snapshot the router was told
+        exists is still unwritten, so ordering is sessions first,
+        compaction last (a SIGKILL between the two loses only the
+        compaction, which the rotation path redoes for free).  Returns
+        the number of pending entries kept; OSError is counted, never
+        raised (the live journal stays as it was)."""
+        with self._lock:
+            try:
+                tmp = self.path + ".tmp"
+                size = 0
+                with open(tmp, "wb") as fh:
+                    for prec in self._pending.values():
+                        pline = (json.dumps(
+                            prec, sort_keys=True,
+                            separators=(",", ":"),
+                        ) + "\n").encode()
+                        fh.write(pline)
+                        size += len(pline)
+                if self._fd is not None:
+                    os.close(self._fd)
+                    self._fd = None
+                os.replace(tmp, self.path)
+                self._size = size
+                try:
+                    os.unlink(self.path + ".1")
+                except FileNotFoundError:
+                    pass
+                return len(self._pending)
+            except OSError:
+                self.errors += 1
+                return 0
+
     # -- read side ------------------------------------------------------
 
     def pending_entries(self) -> List[Dict[str, Any]]:

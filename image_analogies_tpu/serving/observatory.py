@@ -32,6 +32,7 @@ observatory's request-path overhead into the committed artifact.
 from __future__ import annotations
 
 import json
+import os
 import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional, Sequence
@@ -46,13 +47,38 @@ OBS_ROUND = 19
 # registry dump per replica would swamp the artifact with engine
 # counters that have per-replica meaning only).
 KEEP_PREFIXES = ("ia_serve_", "ia_request_", "ia_slo_", "ia_anomaly_",
-                 "ia_excache_", "ia_observatory_")
+                 "ia_excache_", "ia_observatory_", "ia_route_")
 
 
 def parse_targets(spec: str) -> List[str]:
-    """"host:p1,host:p2" (or full http:// URLs) -> base URLs."""
+    """"host:p1,host:p2" (or full http:// URLs) -> base URLs.
+
+    Round 21: the spec may instead name the fleet router's replica-
+    discovery file (written and kept current by `ia-synth route
+    --discovery-out`) — either as a bare path that exists on disk or
+    explicitly as `@PATH`.  Its `targets` list (replicas + the router
+    itself) becomes the scrape set, so fleet scrapes track membership
+    changes (adds, drains, rolling restarts) without a hand-maintained
+    target list."""
+    spec = str(spec)
+    path = None
+    if spec.startswith("@"):
+        path = spec[1:]
+    elif "," not in spec and os.path.isfile(spec):
+        path = spec
+    if path is not None:
+        from .router import load_discovery
+
+        try:
+            doc = load_discovery(path)
+        except (OSError, ValueError) as e:
+            raise ValueError(f"discovery file {path}: {e}")
+        targets = [str(t).rstrip("/") for t in doc.get("targets") or []]
+        if not targets:
+            raise ValueError(f"discovery file {path}: no targets")
+        return targets
     out = []
-    for part in str(spec).split(","):
+    for part in spec.split(","):
         part = part.strip().rstrip("/")
         if not part:
             continue

@@ -269,3 +269,60 @@ def _watch(name: str, status: str, observed, threshold,
            detail: str) -> Dict[str, Any]:
     return {"watch": name, "status": status, "observed": observed,
             "threshold": threshold, "detail": detail}
+
+
+def fleet_watches(replicas: List[Dict[str, Any]],
+                  registry: Optional[MetricsRegistry] = None
+                  ) -> Dict[str, Any]:
+    """Round 21 router-side watches, graded over the fleet router's
+    replica table (ReplicaHandle snapshots) rather than a time-series
+    ring — the router has no synthesis metrics of its own; what can go
+    wrong AT the router is membership-shaped: a replica that stopped
+    answering the poller without being drained (`replica_down`), and
+    the terminal case of zero routable replicas (`fleet_unroutable`).
+    Same report shape as AnomalyDetector.evaluate, same status gauge,
+    so `ia-synth obs` and the sentinel read router anomalies through
+    the exact machinery that reads replica anomalies."""
+    watches: List[Dict[str, Any]] = []
+    if not replicas:
+        watches.append(_watch("replica_down", "no_data", None, 0,
+                              "no replicas registered"))
+        watches.append(_watch("fleet_unroutable", "no_data", None, 1,
+                              "no replicas registered"))
+    else:
+        down = [r["name"] for r in replicas
+                if not r.get("alive") and not r.get("draining")]
+        watches.append(_watch(
+            "replica_down", "firing" if down else "ok", len(down), 0,
+            ("replicas down without drain: " + ", ".join(down))
+            if down else f"{len(replicas)} replica(s) answering",
+        ))
+        routable = sum(
+            1 for r in replicas
+            if r.get("alive") and not r.get("draining")
+        )
+        watches.append(_watch(
+            "fleet_unroutable", "ok" if routable else "firing",
+            routable, 1,
+            f"{routable} live non-draining replica(s)",
+        ))
+    if registry is not None:
+        g = registry.gauge(
+            ANOMALY_STATUS_GAUGE,
+            "live anomaly watch status (1 firing, 0 ok, -1 no_data)",
+        )
+        for w in watches:
+            g.set(STATUS_VALUES[w["status"]],
+                  labels={"watch": w["watch"]})
+    firing = [w["watch"] for w in watches if w["status"] == "firing"]
+    return {
+        "schema_version": ANOMALY_SCHEMA_VERSION,
+        "kind": "anomaly",
+        "window_s": None,
+        "window_status": "ok" if replicas else "no_data",
+        "watches": watches,
+        "firing": firing,
+        "verdict": "firing" if firing else (
+            "ok" if replicas else "no_data"
+        ),
+    }

@@ -1,0 +1,743 @@
+"""Fleet-router tests (round 21): the front tier
+(serving/router.py), the shared warm tier's merge semantics
+(serving/excache.py observed-warmup union + disk-index merge), the
+round-21 drain-order contract and cross-replica session migration
+(serving/daemon.py), the observatory's discovery-file targets, the
+fleet anomaly watches, the ROUTER_r21.json validator
+(tools/check_router.py), and the committed artifact.
+
+Routing logic runs against STUB replicas (a tiny HTTP server that
+answers /serving and /synthesize) — affinity, spread, retry and drain
+handling are router-side properties and need no engine.  The
+migration contract runs against real in-process SynthDaemons with
+SEQUENTIAL lifetimes (module fixture `migration_scenario`): replica
+A serves two session frames and drains, replica B adopts the
+snapshot over POST /sessions/adopt, and B's next frame must be
+bit-identical to an uninterrupted reference stream with the warm-
+cost accounting preserved.  The subprocess `ia-synth route` CLI
+lifecycle is slow-marked (it costs private interpreters + compiles).
+"""
+
+import base64
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from check_router import validate_router  # noqa: E402
+
+from image_analogies_tpu.config import SynthConfig  # noqa: E402
+from image_analogies_tpu.serving.daemon import SynthDaemon  # noqa: E402
+from image_analogies_tpu.serving.excache import (  # noqa: E402
+    DiskExecCache,
+    OBSERVED_WARMUP_FILE,
+    exec_key,
+    key_str,
+    load_observed_warmup,
+    save_observed_warmup,
+)
+from image_analogies_tpu.serving.journal import (  # noqa: E402
+    RequestJournal,
+)
+from image_analogies_tpu.serving.observatory import (  # noqa: E402
+    parse_targets,
+)
+from image_analogies_tpu.serving.router import (  # noqa: E402
+    FleetRouter,
+    load_discovery,
+)
+from image_analogies_tpu.telemetry.anomaly import (  # noqa: E402
+    fleet_watches,
+)
+from image_analogies_tpu.telemetry.metrics import (  # noqa: E402
+    MetricsRegistry,
+    set_registry,
+)
+
+_SERVE_CFG = dict(
+    levels=2, matcher="patchmatch", pallas_mode="off",
+    em_iters=1, pm_iters=2,
+)
+
+
+def _body(frame: np.ndarray, session_id=None) -> bytes:
+    doc = {
+        "image_b64": base64.b64encode(
+            np.ascontiguousarray(frame).tobytes()
+        ).decode(),
+        "shape": list(frame.shape),
+        "dtype": "float32",
+    }
+    if session_id is not None:
+        doc["session_id"] = session_id
+    return json.dumps(doc).encode()
+
+
+def _post(url: str, body: bytes, timeout: float = 300.0,
+          headers=None):
+    h = {"Content-Type": "application/json"}
+    if headers:
+        h.update(headers)
+    req = urllib.request.Request(
+        url + "/synthesize", data=body, headers=h, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(
+                resp.headers
+            )
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _post_json(url: str, doc, timeout: float = 60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get_json(url: str, timeout: float = 30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _sha(doc: dict) -> str:
+    return hashlib.sha256(
+        base64.b64decode(doc["image_b64"])
+    ).hexdigest()
+
+
+# --------------------------------------------------- stub replicas
+class _StubReplica:
+    """The replica surface the router actually consumes: GET /serving
+    (queue_depth / inflight / draining) and POST /synthesize.  Knobs
+    let one test fake a deep queue, a draining 503, or a dead socket
+    without paying an engine compile."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.queue_depth = 0
+        self.draining_snapshot = False
+        self.refuse_unavailable = False
+        self.served = []
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def _send(self, code, doc):
+                payload = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802
+                if self.path.startswith("/serving"):
+                    self._send(200, {
+                        "queue_depth": stub.queue_depth,
+                        "inflight": 0,
+                        "draining": stub.draining_snapshot,
+                        "state_dir": None,
+                        "warm_dir": None,
+                    })
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                if stub.refuse_unavailable:
+                    self._send(503, {"status": "unavailable"})
+                    return
+                stub.served.append(body)
+                self._send(200, {"served_by": stub.name})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        self.url = f"http://127.0.0.1:{self._httpd.server_port}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _router(**kw):
+    kw.setdefault("poll_interval_s", 30.0)  # polls only on add
+    return FleetRouter(MetricsRegistry(), **kw).start()
+
+
+class TestFleetRouterRouting:
+    def test_queue_depth_steers_to_lighter_replica(self):
+        sa, sb = _StubReplica("a"), _StubReplica("b")
+        sa.queue_depth = 5
+        router = _router()
+        try:
+            router.add_replica(sa.url, name="ra")
+            router.add_replica(sb.url, name="rb")
+            code, doc, hdrs = _post(router.url, _body(
+                np.zeros((8, 8, 3), np.float32)
+            ))
+            assert code == 200
+            assert doc["served_by"] == "b"
+            assert hdrs["X-Routed-To"] == "rb"
+        finally:
+            router.stop()
+            sa.stop()
+            sb.stop()
+
+    def test_session_affinity_pins_and_repins_off_draining(self):
+        sa, sb = _StubReplica("a"), _StubReplica("b")
+        router = _router()
+        body = _body(np.zeros((8, 8, 3), np.float32), session_id="s1")
+        try:
+            router.add_replica(sa.url, name="ra")
+            router.add_replica(sb.url, name="rb")
+            # First sighting pins (tie-break: lowest name = ra); the
+            # repeat is a HIT even though rb is equally idle.
+            for _ in range(3):
+                code, doc, _ = _post(router.url, body)
+                assert (code, doc["served_by"]) == (200, "a")
+            assert router.affinity_counts == {
+                "hit": 2, "new": 1, "repin": 0,
+            }
+            # ra starts draining: the pin must MOVE, not 503.
+            sa.draining_snapshot = True
+            router._poll_one(router._replicas["ra"])
+            code, doc, _ = _post(router.url, body)
+            assert (code, doc["served_by"]) == (200, "b")
+            assert router.affinity_counts["repin"] == 1
+            # ...and stay moved.
+            code, doc, _ = _post(router.url, body)
+            assert doc["served_by"] == "b"
+            assert router.affinity_counts["hit"] == 3
+        finally:
+            router.stop()
+            sa.stop()
+            sb.stop()
+
+    def test_conn_error_retries_on_survivor_and_marks_down(self):
+        sa, sb = _StubReplica("a"), _StubReplica("b")
+        router = _router()
+        try:
+            router.add_replica(sa.url, name="ra")
+            router.add_replica(sb.url, name="rb")
+            sa.stop()  # dead socket, router still believes alive
+            code, doc, _ = _post(router.url, _body(
+                np.zeros((8, 8, 3), np.float32)
+            ))
+            assert (code, doc["served_by"]) == (200, "b")
+            assert router.retries == 1
+            assert not router._replicas["ra"].alive
+        finally:
+            router.stop()
+            sb.stop()
+
+    def test_draining_refusal_retries_and_marks_draining(self):
+        sa, sb = _StubReplica("a"), _StubReplica("b")
+        sa.refuse_unavailable = True
+        router = _router()
+        try:
+            router.add_replica(sa.url, name="ra")
+            router.add_replica(sb.url, name="rb")
+            code, doc, _ = _post(router.url, _body(
+                np.zeros((8, 8, 3), np.float32)
+            ))
+            assert (code, doc["served_by"]) == (200, "b")
+            assert router._replicas["ra"].draining
+        finally:
+            router.stop()
+            sa.stop()
+            sb.stop()
+
+    def test_no_replica_is_503_with_retry_after(self):
+        router = _router()
+        try:
+            code, doc, hdrs = _post(router.url, _body(
+                np.zeros((8, 8, 3), np.float32)
+            ))
+            assert code == 503
+            assert doc["status"] == "unavailable"
+            assert "no live" in doc["error"]
+            assert "Retry-After" in hdrs
+        finally:
+            router.stop()
+
+    def test_fleet_endpoint_and_discovery_file(self, tmp_path):
+        disc = str(tmp_path / "fleet.json")
+        sa = _StubReplica("a")
+        router = _router(discovery_path=disc)
+        try:
+            router.add_replica(sa.url, name="ra")
+            fleet = _get_json(router.url + "/fleet")
+            assert [r["name"] for r in fleet["replicas"]] == ["ra"]
+            doc = load_discovery(disc)
+            assert doc["kind"] == "fleet_discovery"
+            assert sa.url in doc["targets"]
+            assert router.url in doc["targets"]
+            # observatory accepts the file (bare and @-prefixed) and
+            # still splits plain comma lists.
+            assert parse_targets(disc) == doc["targets"]
+            assert parse_targets("@" + disc) == doc["targets"]
+            assert parse_targets("h1:1,h2:2") == [
+                "http://h1:1", "http://h2:2",
+            ]
+        finally:
+            router.stop()
+            sa.stop()
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"kind": "not_discovery"}))
+        with pytest.raises(ValueError):
+            parse_targets(str(bad))
+
+
+def _by_watch(report):
+    return {w["watch"]: w for w in report["watches"]}
+
+
+class TestFleetWatches:
+    def test_replica_down_fires(self):
+        report = fleet_watches([
+            {"name": "ra", "alive": True, "draining": False},
+            {"name": "rb", "alive": False, "draining": False},
+        ])
+        assert _by_watch(report)["replica_down"]["status"] == "firing"
+        assert report["verdict"] == "firing"
+        assert report["firing"] == ["replica_down"]
+
+    def test_draining_replica_is_not_down(self):
+        report = fleet_watches([
+            {"name": "ra", "alive": True, "draining": False},
+            {"name": "rb", "alive": False, "draining": True},
+        ])
+        assert _by_watch(report)["replica_down"]["status"] == "ok"
+        assert report["verdict"] == "ok"
+
+    def test_unroutable_fleet_fires(self):
+        report = fleet_watches([
+            {"name": "ra", "alive": True, "draining": True},
+        ])
+        assert _by_watch(report)["fleet_unroutable"][
+            "status"] == "firing"
+
+    def test_empty_fleet_is_no_data(self):
+        report = fleet_watches([])
+        assert report["window_status"] == "no_data"
+        assert report["firing"] == []
+
+
+# ------------------------------------------------- shared warm tier
+class TestWarmTierMerge:
+    def test_observed_warmup_merge_unions_across_writers(self, tmp_path):
+        path = str(tmp_path / OBSERVED_WARMUP_FILE)
+        save_observed_warmup(path, [(24, 24, 3)], merge=True)
+        save_observed_warmup(path, [(32, 32, 3)], merge=True)
+        got = {(e["height"], e["width"]) for e in
+               load_observed_warmup(path)}
+        assert got == {(24, 24), (32, 32)}
+
+    def test_observed_warmup_overwrites_without_merge(self, tmp_path):
+        path = str(tmp_path / OBSERVED_WARMUP_FILE)
+        save_observed_warmup(path, [(24, 24, 3)])
+        save_observed_warmup(path, [(32, 32, 3)])
+        got = {(e["height"], e["width"]) for e in
+               load_observed_warmup(path)}
+        assert got == {(32, 32)}
+
+    def _sealed(self, cache, shape):
+        key = exec_key(shape, SynthConfig(**_SERVE_CFG), 1)
+        blob = f"stub-{shape[0]}.jexec"
+        with open(os.path.join(cache.blob_dir, blob), "wb") as fh:
+            fh.write(b"")
+        cache.seal(key, shape, [blob])
+        return key
+
+    def _index_keys(self, root):
+        with open(os.path.join(root, "index.json")) as fh:
+            return set(json.load(fh)["entries"])
+
+    def test_index_merge_preserves_sibling_entries(self, tmp_path):
+        root = str(tmp_path)
+        c1 = DiskExecCache(root)
+        c2 = DiskExecCache(root)
+        if not (c1.enabled and c2.enabled):
+            pytest.skip("disk excache disabled on this backend")
+        k1 = self._sealed(c1, (24, 24, 3))
+        k2 = self._sealed(c2, (32, 32, 3))
+        # c2's write happened after c1's: last-writer-wins would have
+        # dropped k1; the round-21 merge keeps both.
+        assert self._index_keys(root) == {key_str(k1), key_str(k2)}
+
+    def test_dropped_key_stays_dropped_across_writes(self, tmp_path):
+        root = str(tmp_path)
+        c1 = DiskExecCache(root)
+        if not c1.enabled:
+            pytest.skip("disk excache disabled on this backend")
+        k1 = self._sealed(c1, (24, 24, 3))
+        k2 = self._sealed(c1, (32, 32, 3))
+        # Make k1's blob unreadable -> the probe drops the entry.
+        os.unlink(os.path.join(c1.blob_dir, "stub-24.jexec"))
+        assert c1.probe(k1) == "miss"
+        assert key_str(k1) not in self._index_keys(root)
+        # A later index write (here: re-sealing k2) must NOT
+        # resurrect the dead on-disk entry it can read back.
+        c1._entries.pop(key_str(k2))
+        self._sealed(c1, (32, 32, 3))
+        assert self._index_keys(root) == {key_str(k2)}
+        # ...until someone actually re-seals it.
+        self._sealed(c1, (24, 24, 3))
+        assert key_str(k1) in self._index_keys(root)
+
+
+class TestJournalCompact:
+    def test_compact_keeps_pending_drops_history(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        j = RequestJournal(path)
+        j.append("r1", {"n": 1})
+        j.append("r2", {"n": 2})
+        j.append("r3", {"n": 3})
+        j.mark("r1")
+        j.mark("r3", "cancelled")
+        assert j.compact() == 1
+        j.close()
+        # A successor's scan sees ONLY the still-pending entry, and
+        # the file holds no retired history at all.
+        j2 = RequestJournal(path)
+        assert [e["request_id"] for e in j2.pending_entries()] == ["r2"]
+        counts = j2.counts()
+        assert (counts["appended"], counts["pending"]) == (1, 1)
+        j2.close()
+
+
+# ------------------------------------- migration (real daemons)
+@pytest.fixture(scope="module")
+def migration_scenario(tmp_path_factory):
+    """Satellite 4, end to end on the real engine with SEQUENTIAL
+    daemon lifetimes: a pristine reference daemon serves session
+    frames 1-3; replica A (own state dir) serves frames 1-2 and
+    drains (with a spy asserting the round-21 drain ORDER: the
+    session snapshot must be on disk before the journal compaction
+    runs); replica B adopts the session over POST /sessions/adopt and
+    serves frame 3."""
+    state_a = str(tmp_path_factory.mktemp("router-state-a"))
+    state_b = str(tmp_path_factory.mktemp("router-state-b"))
+    rng = np.random.default_rng(21)
+    a, ap = (
+        rng.random((24, 24, 3)).astype(np.float32) for _ in range(2)
+    )
+    # Small-region frame deltas + iteration headroom: warm_schedule
+    # floors at (2 pm, 1 em), so the serving default (pm 2 / em 1)
+    # would make warm and cold schedules IDENTICAL and the warm-cost
+    # assertion would compare two equal unit tallies.  pm 4 / em 2
+    # leaves room to scale down, and a 4x4 patch change keeps
+    # frame_delta far below the full-schedule threshold.
+    f0 = rng.random((24, 24, 3)).astype(np.float32)
+    f1 = f0.copy()
+    f1[:4, :4] = rng.random((4, 4, 3)).astype(np.float32)
+    f2 = f1.copy()
+    f2[4:8, 4:8] = rng.random((4, 4, 3)).astype(np.float32)
+    frames = [f0, f1, f2]
+    cfg = SynthConfig(
+        levels=2, matcher="patchmatch", pallas_mode="off",
+        em_iters=2, pm_iters=4,
+    )
+    out = {}
+
+    def spawn(state_dir):
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        daemon = SynthDaemon(
+            a, ap, cfg, registry=reg, state_dir=state_dir,
+            max_batch=1, max_wait_ms=5.0, max_queue_depth=8,
+            cache_capacity=4, max_retries=1,
+        ).start()
+        return daemon, prev
+
+    # -- reference: frames 1..3 on one uninterrupted stream.
+    ref, prev = spawn(None)
+    try:
+        for i, f in enumerate(frames):
+            if i == 2:
+                stream = ref._sessions["mig"]
+                before = (stream.run_units, stream.cold_units)
+            code, doc, _ = _post(ref.url, _body(f, session_id="mig"))
+            assert code == 200
+        out["ref_sha3"] = _sha(doc)
+        stream = ref._sessions["mig"]
+        out["ref_frame3_units"] = (
+            stream.run_units - before[0],
+            stream.cold_units - before[1],
+        )
+    finally:
+        ref.stop()
+        set_registry(prev)
+
+    # -- replica A: frames 1..2, then drain (order-spied).
+    da, prev = spawn(state_a)
+    try:
+        for f in frames[:2]:
+            code, _doc, _ = _post(da.url, _body(f, session_id="mig"))
+            assert code == 200
+        orig_compact = da.journal.compact
+        seen = {}
+
+        def spy_compact():
+            seen["sessions_json_at_compact"] = os.path.exists(
+                os.path.join(state_a, "sessions.json")
+            )
+            return orig_compact()
+
+        da.journal.compact = spy_compact
+        da._drain_snapshot()
+        out["drain_order"] = seen
+    finally:
+        da.stop()
+        set_registry(prev)
+
+    # -- replica B: adopt over HTTP, then frame 3.
+    db, prev = spawn(state_b)
+    try:
+        code, doc = _post_json(db.url + "/sessions/adopt", {
+            "state_dir": state_a, "sessions": ["mig"],
+        })
+        out["adopt"] = (code, doc)
+        out["bad_adopt"] = _post_json(
+            db.url + "/sessions/adopt", {"sessions": ["mig"]}
+        )
+        stream = db._sessions.get("mig")
+        out["adopted_t"] = None if stream is None else stream.t
+        code, doc, _ = _post(db.url, _body(frames[2],
+                                           session_id="mig"))
+        assert code == 200
+        out["mig_sha3"] = _sha(doc)
+        stream = db._sessions["mig"]
+        out["mig_frame3_units"] = (stream.run_units,
+                                   stream.cold_units)
+        out["mig_warm_frames"] = stream.warm_frames
+    finally:
+        db.stop()
+        set_registry(prev)
+    return out
+
+
+class TestSessionMigration:
+    def test_drain_writes_sessions_before_compaction(
+        self, migration_scenario
+    ):
+        assert migration_scenario["drain_order"] == {
+            "sessions_json_at_compact": True,
+        }
+
+    def test_adopt_endpoint_reports_the_session(
+        self, migration_scenario
+    ):
+        code, doc = migration_scenario["adopt"]
+        assert code == 200
+        assert doc["adopted"] == ["mig"]
+        assert doc["sessions_active"] >= 1
+
+    def test_adopt_validates_body(self, migration_scenario):
+        code, _doc = migration_scenario["bad_adopt"]
+        assert code == 400
+
+    def test_adopted_stream_resumes_at_frame_index(
+        self, migration_scenario
+    ):
+        assert migration_scenario["adopted_t"] == 2
+
+    def test_migrated_frame_bit_identical_to_reference(
+        self, migration_scenario
+    ):
+        assert (migration_scenario["mig_sha3"]
+                == migration_scenario["ref_sha3"])
+
+    def test_warm_cost_ratio_preserved_across_migration(
+        self, migration_scenario
+    ):
+        # The adopted stream's frame 3 must run WARM: same scheduled
+        # units as the uninterrupted reference's frame 3 (the
+        # warm_cost_ratio increment), not the cold equivalent.
+        run, cold = migration_scenario["mig_frame3_units"]
+        ref_run, ref_cold = migration_scenario["ref_frame3_units"]
+        assert migration_scenario["mig_warm_frames"] == 1
+        assert run == pytest.approx(ref_run)
+        assert cold == pytest.approx(ref_cold)
+        assert run < cold
+
+
+# ------------------------------------------------ validator + artifact
+def _valid_record():
+    single = {"replicas": 1, "clients": 1, "requests": 8,
+              "wall_s": 1.0, "throughput_rps": 8.0,
+              "p50_ms": 100.0, "p99_ms": 140.0}
+    fleet = {"replicas": 3, "clients": 3, "requests": 24,
+             "wall_s": 1.5, "throughput_rps": 16.0,
+             "p50_ms": 120.0, "p99_ms": 180.0,
+             "per_replica_requests": {"r0": 8, "r1": 8, "r2": 8}}
+    return {
+        "schema_version": 1, "kind": "router", "round": 21,
+        "protocol": {"mode": "weak_scaling",
+                     "clients_per_replica": 1,
+                     "requests_per_client": 8},
+        "single": single, "fleet": fleet,
+        "scaling_factor": 2.0,
+        "warm_start": {"replica": "r3", "first_request_ms": 200.0,
+                       "fleet_warm_p99_ms": 180.0,
+                       "warm_p99_ratio": 200.0 / 180.0},
+        "affinity": {"sessions": 4, "frames_per_session": 3,
+                     "hit": 8, "new": 4, "repin": 0,
+                     "expected_hits": 8, "hit_rate": 1.0},
+        "chaos": {"name": "replica_kill_midburst", "acked_loss": 0,
+                  "replay_bit_identical": True,
+                  "sessions_migrated": 1,
+                  "migrated_frame_bit_identical": True,
+                  "routed_burst": 4, "routed_served": 4},
+    }
+
+
+class TestCheckRouter:
+    def test_valid_record_passes(self):
+        assert validate_router(_valid_record()) == []
+
+    @pytest.mark.parametrize("mutate,needle", [
+        (lambda r: r["fleet"].update(replicas=2), "fleet.replicas"),
+        (lambda r: r.update(scaling_factor=1.2), "scaling_factor"),
+        (lambda r: r.update(scaling_factor=2.5), "re-derived"),
+        (lambda r: r["warm_start"].update(
+            first_request_ms=900.0,
+            warm_p99_ratio=5.0), "warm_p99_ratio"),
+        (lambda r: r["affinity"].update(hit=7), "affinity.hit"),
+        (lambda r: r["affinity"].update(repin=1), "repin"),
+        (lambda r: r["chaos"].update(acked_loss=2), "acked_loss"),
+        (lambda r: r["chaos"].update(
+            replay_bit_identical=False), "replay_bit_identical"),
+        (lambda r: r["chaos"].update(sessions_migrated=0),
+         "sessions_migrated"),
+        (lambda r: r["chaos"].update(routed_served=3),
+         "routed_served"),
+        (lambda r: r["protocol"].update(mode="strong"),
+         "weak_scaling"),
+        (lambda r: r["fleet"]["per_replica_requests"].update(r2=0),
+         "spread"),
+    ])
+    def test_each_gate_trips(self, mutate, needle):
+        rec = _valid_record()
+        mutate(rec)
+        errs = validate_router(rec)
+        assert any(needle in e for e in errs), errs
+
+    def test_throughput_rederived(self):
+        rec = _valid_record()
+        rec["fleet"]["throughput_rps"] = 20.0
+        rec["scaling_factor"] = 20.0 / 8.0
+        assert any("re-derived" in e for e in validate_router(rec))
+
+
+class TestCommittedRouterArtifact:
+    def test_committed_record_holds_the_fleet_claims(self):
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "ROUTER_r21.json"
+        )
+        assert os.path.exists(path), (
+            "ROUTER_r21.json missing — regenerate with "
+            "`python tools/serve_load.py --router-out ROUTER_r21.json`"
+        )
+        with open(path) as fh:
+            record = json.load(fh)
+        assert validate_router(record) == []
+
+
+# ------------------------------------------------------ CLI (slow)
+@pytest.mark.slow
+class TestRouteCLI:
+    def test_route_cli_fronts_a_live_replica(self, tmp_path):
+        from image_analogies_tpu.utils.io import save_image
+
+        rng = np.random.default_rng(3)
+        a, ap, b = (
+            rng.random((20, 20, 3)).astype(np.float32)
+            for _ in range(3)
+        )
+        a_path = str(tmp_path / "a.png")
+        ap_path = str(tmp_path / "ap.png")
+        save_image(a_path, a)
+        save_image(ap_path, ap)
+        serve_trace = str(tmp_path / "serve-trace")
+        route_trace = str(tmp_path / "route-trace")
+        disc = str(tmp_path / "fleet.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        serve = subprocess.Popen(
+            [sys.executable, "-m", "image_analogies_tpu.cli",
+             "serve", "--a", a_path, "--ap", ap_path, "--port", "0",
+             "--trace-dir", serve_trace, "--levels", "2",
+             "--matcher", "patchmatch", "--em-iters", "1",
+             "--pm-iters", "2", "--device", "cpu",
+             "--warm-dir", str(tmp_path / "warm")],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        route = None
+        try:
+            url = self._await_live(serve, serve_trace)
+            route = subprocess.Popen(
+                [sys.executable, "-m", "image_analogies_tpu.cli",
+                 "route", "--targets", url, "--port", "0",
+                 "--discovery-out", disc,
+                 "--trace-dir", route_trace],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            router_url = self._await_live(route, route_trace)
+            code, doc, hdrs = _post(router_url, _body(b))
+            assert code == 200
+            assert hdrs["X-Routed-To"] == "r0"
+            fleet = _get_json(router_url + "/fleet")
+            assert fleet["requests"]["proxied"] == 1
+            # The discovery file names both tiers; the observatory
+            # accepts it as a target spec.
+            targets = parse_targets(disc)
+            assert url in targets and router_url in targets
+            slo = _get_json(router_url + "/slo")
+            assert slo["anomalies"]["verdict"] == "ok"
+        finally:
+            for p in (route, serve):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=60)
+
+    @staticmethod
+    def _await_live(proc, trace_dir, timeout=300):
+        live = os.path.join(trace_dir, "live.json")
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if os.path.exists(live):
+                with open(live) as fh:
+                    return json.load(fh)["url"]
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"subprocess exited rc={proc.returncode}"
+                )
+            time.sleep(0.1)
+        raise RuntimeError("live.json never appeared")

@@ -875,9 +875,13 @@ class TestDaemonEndToEnd:
         assert [s["name"] for s in r["spans"]] == [
             "queued", "admitted", "cache-hit", "executed", "demuxed",
         ]
-        # The warm request must not have paid the compile again.
+        # The warm request must not have paid the compile again.  A
+        # real re-compile is orders of magnitude over the cold wall;
+        # 1.5x headroom keeps this robust when OTHER test modules have
+        # already warmed jax's process-global trace cache and "cold"
+        # itself is only milliseconds of dispatch jitter.
         cold_ms = daemon_scenario["cold"][1]["wall_ms"]
-        assert r["wall_ms"] < cold_ms
+        assert r["wall_ms"] < cold_ms * 1.5
 
     def test_response_image_roundtrips(self, daemon_scenario):
         _, r, _ = daemon_scenario["warm"]

@@ -366,6 +366,230 @@ def _arm_lattice_shape_burst(a_path, ap_path, size):
     return arm
 
 
+def _session_body(frame, session_id: str) -> bytes:
+    import numpy as np
+
+    return json.dumps({
+        "image_b64": base64.b64encode(
+            np.ascontiguousarray(frame.astype(np.float32)).tobytes()
+        ).decode(),
+        "shape": list(frame.shape),
+        "dtype": "float32",
+        "session_id": session_id,
+    }).encode()
+
+
+def arm_replica_kill_midburst(a_path, ap_path, size):
+    """Round 21 fleet arm: SIGKILL one replica of a ROUTED fleet under
+    a live burst, then roll the fleet through the full recovery story:
+
+      1. a reference daemon serves a 3-frame session (the no-migration
+         shas) and seeds the shared warm dir;
+      2. replicas A + B come up on per-replica state dirs over the
+         SHARED warm tier, fronted by an in-process FleetRouter; a
+         video session pins to A;
+      3. B is SIGKILLed with acked (journaled) requests still queued —
+         the router's in-flight proxies to B retry on A, so every
+         live client still gets a 200;
+      4. a --takeover successor B2 replays B's pending entries with
+         zero acked loss and bit-identical outputs, then joins the
+         router;
+      5. A drains THROUGH the router: its drain snapshot (sessions
+         before journal compaction — the round-21 ordering fix) lands,
+         the router migrates A's pinned session to B2 via
+         /sessions/adopt, and the session's NEXT frame — served by B2
+         — must be bit-identical to the reference daemon's frame 3.
+
+    Returns the arm dict ROUTER_r21.json embeds (check_router gates
+    acked_loss == 0, replay + migrated-frame bit-identity, and at
+    least one migrated session)."""
+    import numpy as np
+
+    from image_analogies_tpu.serving.journal import (
+        RequestJournal, journal_path,
+    )
+    from image_analogies_tpu.serving.router import FleetRouter
+    from image_analogies_tpu.telemetry.metrics import MetricsRegistry
+
+    rng = np.random.default_rng(2116)
+    sess_frames = [
+        rng.random((size, size, 3)).astype(np.float32)
+        for _ in range(3)
+    ]
+    burst_frames = [
+        rng.random((size, size, 3)).astype(np.float32)
+        for _ in range(4)
+    ]
+    # The direct backlog uses a shape the shared warm tier has NOT
+    # seen: B's first one stalls on a real XLA compile, so the kill
+    # reliably lands with acked-but-unserved entries queued behind it
+    # (warm-shape frames drain faster than a poll can observe).
+    backlog_frames = [
+        rng.random((size + 8, size + 8, 3)).astype(np.float32)
+        for _ in range(6)
+    ]
+    warm = tempfile.mkdtemp(prefix="ia_fleet_warm_")
+    sa = tempfile.mkdtemp(prefix="ia_fleet_sa_")
+    sb = tempfile.mkdtemp(prefix="ia_fleet_sb_")
+    traces = [
+        tempfile.mkdtemp(prefix=f"ia_fleet_t{i}_") for i in range(4)
+    ]
+    warm_extra = ("--warm-dir", warm)
+    # Fleet replicas take a direct backlog ON TOP of routed spillover;
+    # a deeper admission queue keeps back-pressure 429s out of the
+    # zero-acked-loss measurement (last --max-queue-depth wins).
+    fleet_extra = warm_extra + ("--max-queue-depth", "32")
+    arm = {"name": "replica_kill_midburst", "burst_size":
+           len(burst_frames) + len(backlog_frames),
+           "shared_warm_dir": True}
+    router = None
+    pa = pb = pb2 = None
+    try:
+        # 1. Reference session run (also seeds the shared warm tier).
+        ref_proc, ref_url = _spawn_serve(
+            a_path, ap_path, traces[0], extra=warm_extra
+        )
+        ref_shas = []
+        try:
+            for f in sess_frames:
+                code, resp, _ = _post(
+                    ref_url, _session_body(f, "s-mig")
+                )
+                if code != 200:
+                    raise RuntimeError(
+                        f"reference session frame failed: {code}"
+                    )
+                ref_shas.append(_response_sha(resp))
+        finally:
+            _reap(ref_proc)
+        # 2. Fleet: A first (the session pins to it while it is the
+        # only replica), then B, behind the router.
+        pa, ua = _spawn_serve(
+            a_path, ap_path, traces[1], state_dir=sa, extra=fleet_extra
+        )
+        router = FleetRouter(
+            MetricsRegistry(), poll_interval_s=0.2
+        ).start()
+        router.add_replica(ua, name="ra")
+        pinned_to = None
+        for f in sess_frames[:2]:
+            code, resp, hdrs = _post(
+                router.url, _session_body(f, "s-mig")
+            )
+            if code != 200:
+                raise RuntimeError(
+                    f"session frame via router failed: {code}"
+                )
+            pinned_to = hdrs.get("X-Routed-To")
+        arm["session_pinned_to"] = pinned_to
+        pb, ub = _spawn_serve(
+            a_path, ap_path, traces[2], state_dir=sb, extra=fleet_extra
+        )
+        router.add_replica(ub, name="rb")
+        # 3. Live burst through the router PLUS a direct backlog on B
+        # (max_batch 1 serializes it), so the kill lands with acked-
+        # but-unserved entries in B's journal.
+        frames_by_rid = {
+            f"fleet-{i}": f
+            for i, f in enumerate(burst_frames + backlog_frames)
+        }
+        routed = [(f"fleet-{i}", _body(f))
+                  for i, f in enumerate(burst_frames)]
+        direct = [(f"fleet-{i + 4}", _body(f))
+                  for i, f in enumerate(backlog_frames)]
+        threads_r, results_r = _burst(router.url, routed)
+        threads_d, _ = _burst(ub, direct)
+        deadline = time.monotonic() + 60
+        pending_seen = 0
+        while time.monotonic() < deadline:
+            ledger = _get_json(ub + "/journal")["ledger"]
+            pending_seen = ledger["pending"]
+            if ledger["appended"] >= 3 and pending_seen >= 2:
+                break
+            time.sleep(0.02)
+        arm["pending_seen_at_kill"] = pending_seen
+        pb.kill()  # SIGKILL: no drain, no snapshot, no goodbye
+        _reap(pb)
+        for t in threads_r + threads_d:
+            t.join(timeout=300)
+        # Every ROUTED request must have been served (B's failures
+        # retried on A); direct-to-B clients legitimately see resets.
+        arm["routed_burst"] = len(routed)
+        arm["routed_served"] = sum(
+            1 for r in results_r if r is not None and r[0] == 200
+        )
+        arm["router_retries"] = router.retries
+        disk = RequestJournal(journal_path(sb)).counts()
+        arm["pending_at_takeover"] = disk["pending"]
+        # 4. Takeover successor B2 replays B's pending set.
+        t0 = time.monotonic()
+        pb2, ub2 = _spawn_serve(
+            a_path, ap_path, traces[3], takeover=sb, extra=fleet_extra
+        )
+        snap = None
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            snap = _get_json(ub2 + "/journal")
+            if snap["ledger"]["pending"] == 0:
+                break
+            time.sleep(0.2)
+        arm["recovery_warm_ms"] = round(
+            (time.monotonic() - t0) * 1000.0, 1
+        )
+        ledger = snap["ledger"]
+        matches, mismatches = 0, 0
+        for rid, rec in snap["replayed"].items():
+            frame = frames_by_rid.get(rid)
+            if frame is None:
+                continue
+            code, resp, _ = _post(ub2, _body(frame))
+            if code == 200 and _response_sha(resp) == rec["sha256"]:
+                matches += 1
+            else:
+                mismatches += 1
+        arm.update({
+            "acked": ledger["appended"],
+            "acked_loss": ledger["pending"],
+            "replayed": ledger["replayed"],
+            "replay_verified": matches,
+            "replay_mismatched": mismatches,
+            "replay_bit_identical": bool(
+                matches >= 1 and mismatches == 0
+            ),
+        })
+        router.add_replica(ub2, name="rb2")
+        # 5. Graceful drain of A through the router: snapshot lands
+        # (sessions before journal compaction), session migrates to
+        # B2, and the migrated stream's next frame is bit-identical.
+        report = router.drain_replica("ra", wait_s=180)
+        arm["drain_report"] = {
+            "drained": report["drained"],
+            "sessions_migrated": report["sessions_migrated"],
+            "migrated_to": report.get("migrated_to"),
+        }
+        arm["sessions_migrated"] = len(report["sessions_migrated"])
+        try:
+            pa.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            pass
+        code, resp, hdrs = _post(
+            router.url, _session_body(sess_frames[2], "s-mig")
+        )
+        arm["migrated_frame_routed_to"] = hdrs.get("X-Routed-To")
+        arm["migrated_frame_bit_identical"] = bool(
+            code == 200 and _response_sha(resp) == ref_shas[2]
+        )
+        return arm
+    finally:
+        if router is not None:
+            router.stop()
+        for p in (pa, pb, pb2):
+            if p is not None:
+                _reap(p)
+        for d in (warm, sa, sb, *traces):
+            shutil.rmtree(d, ignore_errors=True)
+
+
 def _arm_serve_crash_torn(a_path, ap_path, size):
     """IA_FAULT_PLAN=serve_crash kills the daemon between journal
     append and ack; a torn half-line is appended on top; the takeover

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Validate a ROUTER_r21.json fleet-routing artifact (round 21).
+
+The fleet-router acceptance bar, held by arithmetic: the committed
+record must show
+
+  * a >= 3-replica routed fleet whose measured throughput under the
+    stated weak-scaling protocol (one closed-loop client per replica,
+    the replicas' own batching policy — NOT a per-request benchmark)
+    scales >= 1.6x over the single-replica baseline ON THE SAME BOX,
+    with `scaling_factor` re-derived here from the two throughput
+    cells;
+  * a replica ADDED MID-BURST over the shared warm tier (round-18
+    disk executable cache + round-20 observed-warmup union under the
+    common --warm-dir) whose FIRST routed request lands within 2x the
+    fleet's warm p99 — the cold-start number a fresh replica would
+    otherwise pay is seconds of XLA compile, so a ratio <= 2.0 is the
+    proof the warm tier actually engaged;
+  * session affinity with a 100% hit rate for non-draining replicas:
+    every sessioned request after a session's first must be a HIT
+    (`hit == expected_hits`, `repin == 0`) — a single silent re-pin
+    would cold-start a video stream mid-sequence;
+  * the embedded chaos replica-kill arm (tools/chaos_serve.py
+    `arm_replica_kill_midburst`): zero acked loss, bit-identical
+    journal replay on the --takeover successor, at least one session
+    MIGRATED off the drained replica, and the migrated session's next
+    frame bit-identical to the no-migration reference.
+
+Usage:
+    python tools/check_router.py ROUTER_r21.json
+
+Runs under pytest too (tests/test_router.py validates the COMMITTED
+artifact) so tier-1 fails if the record is missing, truncated, or any
+fleet claim stops reproducing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+ROUTER_SCHEMA_VERSION = 1
+MIN_FLEET_REPLICAS = 3
+MIN_SCALING_FACTOR = 1.6
+MAX_WARM_P99_RATIO = 2.0
+_REL = 1e-6
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _pos(v) -> bool:
+    return _num(v) and v > 0
+
+
+def _close(a, b) -> bool:
+    return abs(a - b) <= _REL * max(abs(a), abs(b), 1.0)
+
+
+def _validate_phase(phase, name: str, errs: List[str]) -> None:
+    if not isinstance(phase, dict):
+        errs.append(f"{name}: missing or not an object")
+        return
+    for key in ("replicas", "requests", "wall_s", "throughput_rps",
+                "p50_ms", "p99_ms"):
+        if not _pos(phase.get(key)):
+            errs.append(f"{name}.{key}: not a positive number "
+                        f"({phase.get(key)!r})")
+    wall, n, thr = (phase.get("wall_s"), phase.get("requests"),
+                    phase.get("throughput_rps"))
+    if _pos(wall) and _pos(n) and _pos(thr) and not _close(thr, n / wall):
+        errs.append(
+            f"{name}.throughput_rps {thr} != requests/wall_s "
+            f"{n / wall} (re-derived)"
+        )
+
+
+def validate_router(record: dict) -> List[str]:
+    errs: List[str] = []
+    if record.get("schema_version") != ROUTER_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {record.get('schema_version')!r} != "
+            f"{ROUTER_SCHEMA_VERSION}"
+        )
+    if record.get("kind") != "router":
+        errs.append(f"kind {record.get('kind')!r} != 'router'")
+
+    proto = record.get("protocol") or {}
+    if proto.get("mode") != "weak_scaling":
+        errs.append(
+            f"protocol.mode {proto.get('mode')!r} != 'weak_scaling' "
+            "(the scaling claim is only honest under the stated "
+            "closed-loop-client-per-replica protocol)"
+        )
+    if proto.get("clients_per_replica") != 1:
+        errs.append(
+            "protocol.clients_per_replica "
+            f"{proto.get('clients_per_replica')!r} != 1"
+        )
+
+    single = record.get("single")
+    fleet = record.get("fleet")
+    _validate_phase(single, "single", errs)
+    _validate_phase(fleet, "fleet", errs)
+    if isinstance(single, dict) and single.get("replicas") != 1:
+        errs.append(f"single.replicas {single.get('replicas')!r} != 1")
+    if isinstance(fleet, dict):
+        nrep = fleet.get("replicas")
+        if not (_num(nrep) and nrep >= MIN_FLEET_REPLICAS):
+            errs.append(
+                f"fleet.replicas {nrep!r} < {MIN_FLEET_REPLICAS}"
+            )
+        spread = fleet.get("per_replica_requests")
+        if not (isinstance(spread, dict) and spread):
+            errs.append("fleet.per_replica_requests: missing")
+        elif _num(fleet.get("requests")):
+            served = sum(v for v in spread.values() if _num(v))
+            if served < fleet["requests"]:
+                errs.append(
+                    f"fleet.per_replica_requests sums to {served} < "
+                    f"fleet.requests {fleet['requests']} (requests "
+                    "unaccounted for)"
+                )
+            if any(not _pos(v) for v in spread.values()):
+                errs.append(
+                    "fleet.per_replica_requests: a replica served 0 "
+                    "requests — the router did not spread the load"
+                )
+
+    scaling = record.get("scaling_factor")
+    if not _pos(scaling):
+        errs.append(f"scaling_factor {scaling!r}: not a number")
+    else:
+        if (isinstance(single, dict) and isinstance(fleet, dict)
+                and _pos(single.get("throughput_rps"))
+                and _pos(fleet.get("throughput_rps"))):
+            derived = (fleet["throughput_rps"]
+                       / single["throughput_rps"])
+            if not _close(scaling, derived):
+                errs.append(
+                    f"scaling_factor {scaling} != fleet/single "
+                    f"throughput {derived} (re-derived)"
+                )
+        if scaling < MIN_SCALING_FACTOR:
+            errs.append(
+                f"scaling_factor {scaling:.3f} < {MIN_SCALING_FACTOR} "
+                "(fleet does not beat one replica by the bar)"
+            )
+
+    warm = record.get("warm_start") or {}
+    first = warm.get("first_request_ms")
+    p99 = warm.get("fleet_warm_p99_ms")
+    ratio = warm.get("warm_p99_ratio")
+    if not _pos(first):
+        errs.append(f"warm_start.first_request_ms {first!r}")
+    if not _pos(p99):
+        errs.append(f"warm_start.fleet_warm_p99_ms {p99!r}")
+    if not _pos(ratio):
+        errs.append(f"warm_start.warm_p99_ratio {ratio!r}")
+    elif _pos(first) and _pos(p99):
+        if not _close(ratio, first / p99):
+            errs.append(
+                f"warm_start.warm_p99_ratio {ratio} != "
+                f"first/fleet_p99 {first / p99} (re-derived)"
+            )
+        if ratio > MAX_WARM_P99_RATIO:
+            errs.append(
+                f"warm_start.warm_p99_ratio {ratio:.3f} > "
+                f"{MAX_WARM_P99_RATIO} (mid-burst replica did not "
+                "start warm — shared warm tier not engaged)"
+            )
+
+    aff = record.get("affinity") or {}
+    for key in ("sessions", "hit", "new", "expected_hits"):
+        if not _num(aff.get(key)):
+            errs.append(f"affinity.{key} {aff.get(key)!r}: not a number")
+    if _num(aff.get("hit")) and _num(aff.get("expected_hits")):
+        if aff["hit"] != aff["expected_hits"]:
+            errs.append(
+                f"affinity.hit {aff['hit']} != expected_hits "
+                f"{aff['expected_hits']} (a sessioned request missed "
+                "its pinned replica)"
+            )
+    if aff.get("repin") != 0:
+        errs.append(
+            f"affinity.repin {aff.get('repin')!r} != 0 (a session was "
+            "re-pinned off a live, non-draining replica)"
+        )
+    if aff.get("hit_rate") != 1.0:
+        errs.append(
+            f"affinity.hit_rate {aff.get('hit_rate')!r} != 1.0"
+        )
+
+    chaos = record.get("chaos") or {}
+    if chaos.get("name") != "replica_kill_midburst":
+        errs.append(
+            f"chaos.name {chaos.get('name')!r} != "
+            "'replica_kill_midburst'"
+        )
+    if chaos.get("acked_loss") != 0:
+        errs.append(
+            f"chaos.acked_loss {chaos.get('acked_loss')!r} != 0 "
+            "(acked requests were lost across the replica kill)"
+        )
+    if chaos.get("replay_bit_identical") is not True:
+        errs.append("chaos.replay_bit_identical is not true")
+    if not (_num(chaos.get("sessions_migrated"))
+            and chaos["sessions_migrated"] >= 1):
+        errs.append(
+            f"chaos.sessions_migrated {chaos.get('sessions_migrated')!r}"
+            " < 1 (rolling restart migrated no sessions)"
+        )
+    if chaos.get("migrated_frame_bit_identical") is not True:
+        errs.append(
+            "chaos.migrated_frame_bit_identical is not true (the "
+            "migrated session's next frame diverged from the "
+            "no-migration reference)"
+        )
+    if (_num(chaos.get("routed_burst")) and _num(chaos.get(
+            "routed_served"))
+            and chaos["routed_served"] < chaos["routed_burst"]):
+        errs.append(
+            f"chaos.routed_served {chaos['routed_served']} < "
+            f"routed_burst {chaos['routed_burst']} (a live routed "
+            "client was dropped during the kill)"
+        )
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record", help="path to ROUTER_r21.json")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.record, "r", encoding="utf-8") as fh:
+            record = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"check_router: cannot read {args.record}: {e}",
+              file=sys.stderr)
+        return 2
+    errs = validate_router(record)
+    if errs:
+        print(f"check_router: {args.record}: {len(errs)} violation(s):")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    fleet = record.get("fleet") or {}
+    print(
+        f"check_router: {args.record} OK — {fleet.get('replicas')} "
+        f"replicas, scaling {record.get('scaling_factor'):.2f}x, "
+        f"added-replica warm ratio "
+        f"{(record.get('warm_start') or {}).get('warm_p99_ratio'):.2f}, "
+        f"chaos acked_loss {(record.get('chaos') or {}).get('acked_loss')}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,6 +67,7 @@ _MESH2D_RE = re.compile(r"^MESH2D_r(\d+)\.json$")
 _SERVE_PERSIST_RE = re.compile(r"^SERVE_r(\d+)\.json$")
 _OBS_RE = re.compile(r"^OBS_r(\d+)\.json$")
 _LATTICE_RE = re.compile(r"^LATTICE_r(\d+)\.json$")
+_ROUTER_RE = re.compile(r"^ROUTER_r(\d+)\.json$")
 
 PROVENANCES = ("measured", "carried", "modeled")
 
@@ -224,6 +225,28 @@ LATTICE_SERIES: Tuple[Dict, ...] = (
      "label": "never-seen-shape p99 over warm p99 (lattice admission)"},
 )
 
+# ROUTER artifacts (round 21: tools/serve_load.py --router-out)
+# carry the fleet-routing headlines: the weak-scaling throughput
+# factor of the routed >= 3-replica fleet over one replica (hard
+# floor 1.6 — the acceptance bar, re-stated here so a future round
+# cannot regress below it silently) and the mid-burst-added replica's
+# first routed request over the fleet warm p99 (hard ceiling 2.0 —
+# the shared-warm-tier proof; a cold-started replica pays seconds of
+# XLA compile and blows the ceiling by orders of magnitude).  Both
+# trends are held loosely (rel_tol 1.0) like the other shared-box
+# serving walls; the bounds are the real gates and check_router
+# enforces them per record.
+ROUTER_SERIES: Tuple[Dict, ...] = (
+    {"field": "scaling_factor", "direction": "higher",
+     "rel_tol": 1.0, "floor": 1.6, "since": 21,
+     "label": "fleet throughput scaling over one replica "
+              "(weak-scaling protocol)"},
+    {"field": "warm_p99_ratio", "direction": "lower",
+     "rel_tol": 1.0, "abs_tol": 0.5, "ceiling": 2.0, "since": 21,
+     "label": "mid-burst-added replica first request over fleet "
+              "warm p99 (shared warm tier)"},
+)
+
 # SCALE rows are keyed by size; each series is tracked per size.
 SCALE_SERIES: Tuple[Dict, ...] = (
     {"field": "wall_s", "direction": "lower", "rel_tol": 0.10,
@@ -341,7 +364,7 @@ def _flatten_serve_persist(rec):
 
 def load_history(root: str):
     """(bench, scale, video, slo, chaos_serve, mesh2d, serve_persist,
-    obs, lattice) lists of
+    obs, lattice, router) lists of
     (round, filename, payload), round-sorted.  BENCH payloads unwrap the driver's capture wrapper
     to the parsed record.  Builder probe files (BENCH_r*_builder*.json)
     do not match the round pattern and are deliberately out of scope —
@@ -356,6 +379,7 @@ def load_history(root: str):
     serve_persist = []
     obs = []
     lattice = []
+    router = []
     for name in sorted(os.listdir(root)):
         m = _BENCH_RE.match(name)
         if m:
@@ -408,6 +432,10 @@ def load_history(root: str):
         if m:
             with open(os.path.join(root, name)) as f:
                 lattice.append((int(m.group(1)), name, json.load(f)))
+        m = _ROUTER_RE.match(name)
+        if m:
+            with open(os.path.join(root, name)) as f:
+                router.append((int(m.group(1)), name, json.load(f)))
     bench.sort(key=lambda t: t[0])
     scale.sort(key=lambda t: t[0])
     video.sort(key=lambda t: t[0])
@@ -417,8 +445,9 @@ def load_history(root: str):
     serve_persist.sort(key=lambda t: t[0])
     obs.sort(key=lambda t: t[0])
     lattice.sort(key=lambda t: t[0])
+    router.sort(key=lambda t: t[0])
     return (bench, scale, video, slo, chaos_serve, mesh2d,
-            serve_persist, obs, lattice)
+            serve_persist, obs, lattice, router)
 
 
 # ------------------------------------------------------ schema (by era)
@@ -650,7 +679,7 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
     """All schema + trajectory checks over the committed history.
     Returns (violations, machine-readable report rows)."""
     (bench, scale, video, slo, chaos_serve, mesh2d,
-     serve_persist, obs, lattice) = load_history(root)
+     serve_persist, obs, lattice, router) = load_history(root)
     errs: List[str] = []
     report: List[Dict] = []
 
@@ -705,6 +734,14 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
 
         errs.extend(f"{name}: {e}" for e in validate_lattice(rec))
 
+    for rnd, name, rec in router:
+        # Fleet-routing artifacts carry their full contract — the
+        # scaling floor, warm-start ceiling, affinity matrix and the
+        # chaos replica-kill gates — in check_router.
+        from check_router import validate_router
+
+        errs.extend(f"{name}: {e}" for e in validate_router(rec))
+
     for decl in BENCH_SERIES:
         check_series(
             decl, [(r, n, rec) for r, n, rec in bench],
@@ -745,6 +782,18 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
         check_series(
             decl, [(r, n, rec) for r, n, rec in lattice],
             f"lattice.{decl['field']}", errs, report,
+        )
+    for decl in ROUTER_SERIES:
+        # scaling_factor is top-level; the warm-start ratio lives
+        # under warm_start — flatten the two headline cells.
+        check_series(
+            decl,
+            [(r, n, {
+                "scaling_factor": rec.get("scaling_factor"),
+                "warm_p99_ratio": (rec.get("warm_start") or {})
+                .get("warm_p99_ratio"),
+            }) for r, n, rec in router],
+            f"router.{decl['field']}", errs, report,
         )
     def _rows(data):
         rows = data.get("rows") if isinstance(data, dict) else None

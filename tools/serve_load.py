@@ -71,6 +71,7 @@ import gc
 import hashlib
 import json
 import os
+import shutil
 import statistics
 import subprocess
 import sys
@@ -85,6 +86,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from check_lattice import validate_lattice  # noqa: E402
 from check_obs import validate_obs  # noqa: E402
+from check_router import validate_router  # noqa: E402
 from check_serve import validate_serve  # noqa: E402
 from check_serve_persist import validate_serve_persist  # noqa: E402
 from check_slo import validate_slo  # noqa: E402
@@ -1145,6 +1147,279 @@ def run_lattice(args) -> dict:
         set_registry(prev)
 
 
+def run_router(args) -> dict:
+    """Round-21 fleet-router arm (`--router-out`): `ia-synth serve`
+    SUBPROCESS replicas (per-replica state dirs, one SHARED
+    --warm-dir) behind an in-process FleetRouter, graded under the
+    weak-scaling protocol this box can honestly support.
+
+    On a single core, strong scaling (one client, N replicas) is a
+    physical no-op: aggregate compute throughput is one core no
+    matter how many replicas share it.  What N replicas DO buy is
+    overlap of the batching policy's head-of-line wait (max_wait_ms)
+    across independent clients — replica i can sit in its coalesce
+    wait while replica j computes.  So the protocol is one closed-loop
+    client per replica (clients_per_replica = 1, the load grows WITH
+    the fleet) and the claim is throughput per wall-second:
+    1 client / 1 replica vs N clients / N replicas, identical
+    per-replica batching policy, identical request mix, warm on both
+    sides.  Expected scaling = N*(w + c) / (w + N*c) for head wait w
+    and per-request compute c — the committed floor is 1.6x.
+
+    Also measured here: the mid-burst replica add (spawn a fresh
+    replica over the shared warm tier while 3 clients burst, route
+    its FIRST request, compare against the fleet's warm p99), the
+    session-affinity hit-rate matrix, and the embedded chaos
+    replica-kill arm (tools/chaos_serve.py)."""
+    import numpy as np
+
+    import chaos_serve
+    from image_analogies_tpu.serving.router import FleetRouter
+    from image_analogies_tpu.telemetry.metrics import MetricsRegistry
+    from image_analogies_tpu.utils.io import save_image
+
+    size = args.size
+    reqs = max(8, args.requests_per_client)
+    a, ap_img, _ = _make_inputs(args.seed, size)
+    rng = np.random.default_rng(args.seed + 21)
+    frames = [
+        rng.random((size, size, 3)).astype(np.float32)
+        for _ in range(8)
+    ]
+    asset_dir = tempfile.mkdtemp(prefix="ia_router_assets_")
+    warm = tempfile.mkdtemp(prefix="ia_router_warm_")
+    states = [tempfile.mkdtemp(prefix=f"ia_router_s{i}_")
+              for i in range(4)]
+    traces = [tempfile.mkdtemp(prefix=f"ia_router_t{i}_")
+              for i in range(4)]
+    a_path = os.path.join(asset_dir, "a.png")
+    ap_path = os.path.join(asset_dir, "ap.png")
+    save_image(a_path, a)
+    save_image(ap_path, ap_img)
+    # The replicas' OWN policy, identical on every replica and in both
+    # phases: max_batch 4 / max_wait_ms 75 (the round-13 coalesce
+    # family).  The wait is the quantity the fleet overlaps.
+    wait_ms = 75.0
+    policy = ("--max-batch", "4", "--max-wait-ms", str(wait_ms),
+              "--max-queue-depth", "32", "--warm-dir", warm)
+
+    def spawn(i):
+        return chaos_serve._spawn_serve(
+            a_path, ap_path, traces[i], state_dir=states[i],
+            extra=policy,
+        )
+
+    def closed_loop(n, lat_out, routed_out, stop=None):
+        for k in range(n):
+            if stop is not None and stop.is_set():
+                return
+            t0 = time.perf_counter()
+            code, _doc, hdrs = chaos_serve._post(
+                router.url, _frame_body(frames[k % len(frames)])
+            )
+            dt = (time.perf_counter() - t0) * 1000.0
+            if code == 200:
+                lat_out.append(dt)
+                rep = hdrs.get("X-Routed-To")
+                routed_out[rep] = routed_out.get(rep, 0) + 1
+
+    def phase_cells(nrep, lat, wall_s):
+        p50, p99 = _quantiles(lat)
+        return {
+            "replicas": nrep, "clients": nrep,
+            "requests": len(lat), "wall_s": wall_s,
+            "throughput_rps": len(lat) / wall_s,
+            "p50_ms": p50, "p99_ms": p99,
+        }
+
+    procs = []
+    router = FleetRouter(MetricsRegistry(), poll_interval_s=0.2)
+    try:
+        router.start()
+        # ---- phase 1: single replica, single closed-loop client.
+        p0, u0 = spawn(0)
+        procs.append(p0)
+        router.add_replica(u0, name="r0")
+        code, _d, _h = chaos_serve._post(
+            router.url, _frame_body(frames[0])
+        )  # untimed warmup: the one cold compile, sealed to the tier
+        if code != 200:
+            raise RuntimeError(f"router warmup request failed: {code}")
+        lat1: List[float] = []
+        spread1: dict = {}
+        gc.disable()
+        t0 = time.perf_counter()
+        closed_loop(reqs, lat1, spread1)
+        wall1 = time.perf_counter() - t0
+        gc.enable()
+        single = phase_cells(1, lat1, wall1)
+
+        # ---- phase 2: grow to 3 replicas + 3 clients (weak scaling).
+        for i in (1, 2):
+            p, u = spawn(i)
+            procs.append(p)
+            router.add_replica(u, name=f"r{i}")
+        # One untimed settling round so both phases measure the same
+        # steady state (the single phase had its warmup request too).
+        for f in frames[:3]:
+            chaos_serve._post(router.url, _frame_body(f))
+        lat3: List[float] = []
+        spread3: dict = {}
+        threads = [
+            threading.Thread(
+                target=closed_loop, args=(reqs, lat3, spread3)
+            )
+            for _ in range(3)
+        ]
+        gc.disable()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall3 = time.perf_counter() - t0
+        gc.enable()
+        fleet = phase_cells(3, lat3, wall3)
+        fleet["per_replica_requests"] = spread3
+
+        # ---- phase 3: add a replica MID-BURST over the warm tier.
+        stop = threading.Event()
+        bg = [
+            threading.Thread(
+                target=closed_loop, args=(10_000, [], {}, stop)
+            )
+            for _ in range(3)
+        ]
+        for t in bg:
+            t.start()
+        try:
+            t_spawn = time.perf_counter()
+            p3, u3 = spawn(3)
+            procs.append(p3)
+            spawn_ms = (time.perf_counter() - t_spawn) * 1000.0
+            router.add_replica(u3, name="r3")
+            first_ms = None
+            attempts = 0
+            for _ in range(20):
+                attempts += 1
+                t0 = time.perf_counter()
+                code, _d, hdrs = chaos_serve._post(
+                    router.url, _frame_body(frames[attempts % 8])
+                )
+                dt = (time.perf_counter() - t0) * 1000.0
+                if code == 200 and hdrs.get("X-Routed-To") == "r3":
+                    first_ms = dt
+                    break
+        finally:
+            stop.set()
+            for t in bg:
+                t.join()
+        if first_ms is None:
+            raise RuntimeError(
+                "mid-burst replica never won a routed request"
+            )
+        disk_snap = chaos_serve._get_json(u3 + "/serving").get(
+            "disk_cache"
+        )
+        warm_start = {
+            "replica": "r3",
+            "spawn_to_live_ms": round(spawn_ms, 1),
+            "route_attempts": attempts,
+            "first_request_ms": first_ms,
+            "fleet_warm_p99_ms": fleet["p99_ms"],
+            "warm_p99_ratio": first_ms / fleet["p99_ms"],
+            "disk_cache": disk_snap,
+        }
+
+        # ---- phase 4: session affinity (4 sessions x 3 frames,
+        # interleaved so every replica stays a live candidate between
+        # a session's consecutive frames).
+        before = dict(router.affinity_counts)
+        n_sessions, n_frames = 4, 3
+        for k in range(n_frames):
+            for s in range(n_sessions):
+                code, _d, _h = chaos_serve._post(
+                    router.url,
+                    chaos_serve._session_body(
+                        frames[(s + k) % len(frames)], f"aff-{s}"
+                    ),
+                )
+                if code != 200:
+                    raise RuntimeError(
+                        f"affinity frame failed: {code}"
+                    )
+        after = dict(router.affinity_counts)
+        delta = {k: after[k] - before[k] for k in after}
+        expected_hits = n_sessions * (n_frames - 1)
+        affinity = {
+            "sessions": n_sessions,
+            "frames_per_session": n_frames,
+            "hit": delta["hit"], "new": delta["new"],
+            "repin": delta["repin"],
+            "expected_hits": expected_hits,
+            "hit_rate": (delta["hit"] / expected_hits
+                         if expected_hits else None),
+        }
+        fleet_snapshot = {
+            "replicas": router.replicas(),
+            "proxied": router.proxied,
+            "proxy_errors": router.proxy_errors,
+            "retries": router.retries,
+        }
+    finally:
+        router.stop()
+        for p in procs:
+            chaos_serve._reap(p)
+        for d in (asset_dir, warm, *states, *traces):
+            shutil.rmtree(d, ignore_errors=True)
+
+    # ---- phase 5: the chaos replica-kill arm (own fleet + dirs).
+    asset_dir2 = tempfile.mkdtemp(prefix="ia_router_assets2_")
+    try:
+        a_path2 = os.path.join(asset_dir2, "a.png")
+        ap_path2 = os.path.join(asset_dir2, "ap.png")
+        save_image(a_path2, a)
+        save_image(ap_path2, ap_img)
+        chaos = chaos_serve.arm_replica_kill_midburst(
+            a_path2, ap_path2, size
+        )
+    finally:
+        shutil.rmtree(asset_dir2, ignore_errors=True)
+
+    return {
+        "schema_version": 1,
+        "kind": "router",
+        "round": 21,
+        "generated_by": "tools/serve_load.py --router-out",
+        "proxy_size": size,
+        "config": {
+            "levels": 2, "matcher": "patchmatch", "em_iters": 1,
+            "pm_iters": 2, "max_batch": 4, "max_wait_ms": wait_ms,
+            "shared_warm_dir": True,
+        },
+        "protocol": {
+            "mode": "weak_scaling",
+            "clients_per_replica": 1,
+            "requests_per_client": reqs,
+            "note": (
+                "single-core box: strong scaling is physically "
+                "impossible (aggregate compute = 1 core), so the "
+                "fleet claim is head-of-line-wait overlap under one "
+                "closed-loop client per replica — N*(w+c)/(w+N*c) "
+                f"with w = max_wait_ms = {wait_ms:g}"
+            ),
+        },
+        "single": single,
+        "fleet": fleet,
+        "scaling_factor": (fleet["throughput_rps"]
+                           / single["throughput_rps"]),
+        "warm_start": warm_start,
+        "affinity": affinity,
+        "chaos": chaos,
+        "fleet_snapshot": fleet_snapshot,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
@@ -1167,6 +1442,13 @@ def main(argv=None) -> int:
                     "unbucketed reference under a never-seen-shape "
                     "burst: bounded exec keys, all-hit cold shapes, "
                     "crop bit-identity, honest bypass)")
+    ap.add_argument("--router-out", default=None, metavar="PATH",
+                    help="write a ROUTER_r21.json fleet-routing "
+                    "artifact (round 21; subprocess replicas over a "
+                    "shared warm tier behind the FleetRouter: "
+                    "weak-scaling throughput, mid-burst replica add, "
+                    "session affinity, embedded chaos replica-kill "
+                    "arm)")
     ap.add_argument("--lattice-spec", default="16:36",
                     metavar="SPEC",
                     help="lattice spec for the round-20 arm "
@@ -1207,9 +1489,9 @@ def main(argv=None) -> int:
         return run_persist_phase(args)
 
     if not (args.out or args.persist_out or args.obs_out
-            or args.lattice_out):
+            or args.lattice_out or args.router_out):
         print("serve_load: need at least one of --out / --persist-out "
-              "/ --obs-out / --lattice-out")
+              "/ --obs-out / --lattice-out / --router-out")
         return 1
 
     if args.out:
@@ -1284,6 +1566,25 @@ def main(argv=None) -> int:
             f"{ek['resident_after_burst'] - ek['resident_after_warmup']}"
             f" keys, p99 cold/warm "
             f"{lattice_record['p99_cold_over_warm']}x)"
+        )
+
+    if args.router_out:
+        router_record = run_router(args)
+        rerrs = validate_router(router_record)
+        if rerrs:
+            print("serve_load: generated router record INVALID:")
+            for e in rerrs:
+                print(f"  - {e}")
+            return 1
+        _write_json(args.router_out, router_record)
+        print(
+            f"serve_load: wrote {args.router_out} (scaling "
+            f"{router_record['scaling_factor']:.2f}x over "
+            f"{router_record['fleet']['replicas']} replicas, "
+            "added-replica warm ratio "
+            f"{router_record['warm_start']['warm_p99_ratio']:.2f}, "
+            "chaos acked_loss "
+            f"{router_record['chaos']['acked_loss']})"
         )
 
     if args.obs_out:
