@@ -1010,6 +1010,62 @@ def cmd_health(args) -> int:
     return 1 if health["verdict"] == "violated" else 0
 
 
+def _cmd_trace_fleet(args) -> int:
+    """`ia-synth trace ID --fleet DISCOVERY`: the round-22 one-command
+    cross-process waterfall.  Walks the router's discovery file, asks
+    every process `GET /request?id=`, joins router + replica records by
+    the forwarded span context, and renders one waterfall with the
+    clock-skew bound and the honest unattributed gap."""
+    import json
+
+    from .serving.fleettrace import (
+        fetch_fleet_trace,
+        join_fleet_trace,
+        render_fleet_waterfall,
+    )
+    from .serving.router import load_discovery
+
+    try:
+        discovery = load_discovery(args.fleet)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"trace: discovery file {args.fleet}: {e}")
+    fetched = fetch_fleet_trace(discovery, args.request_id,
+                                timeout=10.0)
+    router_doc = fetched.get("router") or {}
+    router_rec = router_doc.get("request")
+    replica_recs = []
+    replica_events = {}
+    for rep in fetched.get("replicas") or []:
+        doc = rep.get("doc") or {}
+        rec = doc.get("request")
+        if rec is not None:
+            replica_recs.append(rec)
+            replica_events[str(rep.get("name"))] = (
+                doc.get("flight_events") or []
+            )
+    if router_rec is None and not replica_recs:
+        detail = "; ".join(fetched.get("errors") or [])
+        raise SystemExit(
+            f"trace: request {args.request_id!r} unknown to every "
+            "process in the discovery file"
+            + (f" ({detail})" if detail else "")
+        )
+    joined = join_fleet_trace(
+        router_rec, replica_recs, args.request_id,
+        router_events=router_doc.get("flight_events") or [],
+        replica_events=replica_events,
+    )
+    if fetched.get("errors"):
+        joined.setdefault("notes", []).extend(
+            f"unreachable mid-fetch: {e}" for e in fetched["errors"]
+        )
+    if args.format == "json":
+        print(json.dumps(joined, indent=1))
+    else:
+        print(render_fleet_waterfall(joined))
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Reconstruct one serving request's critical path (round 15): the
     structured access log is the source of truth for phase attribution
@@ -1018,17 +1074,27 @@ def cmd_trace(args) -> int:
     `serve_request` span tree from flight.json for the span-side view.
     Round 19: `--url` asks a LIVE daemon instead (GET /request?id=),
     so tracing needs no filesystem access to the daemon's artifacts.
+    Round 22: `--fleet DISCOVERY` walks the router's replica-discovery
+    file, pulls the router-side AND replica-side records for the id,
+    joins them by the forwarded `X-Parent-Span` context, and renders
+    ONE cross-process waterfall with an explicit clock-skew bound and
+    an honest unattributed gap (never imputed).
     Prints a phase-attributed waterfall; exits nonzero when the id is
     not in the (possibly rotated) log / not known to the daemon."""
     import json
 
     from .serving.accesslog import phase_fields
 
-    if bool(args.url) == bool(args.trace_dir):
+    modes = [bool(args.url), bool(args.trace_dir),
+             bool(getattr(args, "fleet", None))]
+    if sum(modes) != 1:
         raise SystemExit(
-            "trace: exactly one of --url (live daemon) or --trace-dir "
-            "(post-mortem artifacts) is required"
+            "trace: exactly one of --url (live daemon), --trace-dir "
+            "(post-mortem artifacts) or --fleet (router discovery "
+            "file) is required"
         )
+    if getattr(args, "fleet", None):
+        return _cmd_trace_fleet(args)
     if args.url:
         import urllib.error
         import urllib.parse
@@ -1170,12 +1236,17 @@ def cmd_route(args) -> int:
     queue-depth awareness from each replica's /serving snapshot,
     session affinity for video streams, drain-time session migration —
     and keep a replica-discovery file current for `ia-synth obs`.
+    Round 22: with --trace-dir, every proxied request gets a span tree
+    (received/pick/proxy_attempt/respond) in the router's flight ring,
+    a line in the router's own access.jsonl, and the `X-Parent-Span`/
+    `X-Trace-Hop` headers it forwards join the replica's serve_request
+    tree to this hop (`ia-synth trace ID --fleet DISCOVERY`).
     Imports no JAX; this process is pure coordination."""
     import signal as _signal
     import threading
 
     from .serving.router import FleetRouter
-    from .telemetry.metrics import MetricsRegistry
+    from .utils.profiling import telemetry_session
 
     try:
         from .serving.observatory import parse_targets
@@ -1183,42 +1254,53 @@ def cmd_route(args) -> int:
         targets = parse_targets(args.targets)
     except ValueError as e:
         raise SystemExit(f"route: {e}")
-    registry = MetricsRegistry()
-    router = FleetRouter(
-        registry,
-        host=args.host,
-        port=args.port,
-        poll_interval_s=args.poll_interval_s,
-        discovery_path=args.discovery_out,
-        proxy_timeout_s=args.proxy_timeout_s,
-    ).start()
-    stop = threading.Event()
-    _signal.signal(_signal.SIGTERM, lambda *_: stop.set())
-    try:
-        for url in targets:
-            handle = router.add_replica(url)
-            state = "up" if handle.alive else "DOWN"
-            print(f"route: replica {handle.name} {handle.url} "
-                  f"[{state}]")
-        if args.trace_dir:
-            os.makedirs(args.trace_dir, exist_ok=True)
-            router.live.announce(args.trace_dir)
-        print(
-            f"routing on {router.url} (POST /synthesize "
-            "/replicas/add /replicas/remove /drain_replica; GET "
-            "/fleet /replicas /slo /metrics /metrics.json /healthz)",
-            flush=True,
-        )
-        if args.discovery_out:
-            print(f"route: discovery file at {args.discovery_out} "
-                  "(pass to `ia-synth obs --targets`)")
-        while not stop.wait(1.0):
-            pass
-        print("route: exiting", flush=True)
-    except KeyboardInterrupt:
-        print("route: interrupted")
-    finally:
-        router.stop()
+    with telemetry_session(
+        None, enabled=True, artifact_dir=args.trace_dir,
+        metrics_port=None,
+    ) as tracer:
+        router = FleetRouter(
+            tracer.registry,
+            tracer=tracer,
+            host=args.host,
+            port=args.port,
+            poll_interval_s=args.poll_interval_s,
+            discovery_path=args.discovery_out,
+            proxy_timeout_s=args.proxy_timeout_s,
+            flight=getattr(tracer, "flight_recorder", None),
+            access_log_path=(
+                os.path.join(args.trace_dir, "access.jsonl")
+                if args.trace_dir else None
+            ),
+        ).start()
+        stop = threading.Event()
+        _signal.signal(_signal.SIGTERM, lambda *_: stop.set())
+        try:
+            for url in targets:
+                handle = router.add_replica(url)
+                state = "up" if handle.alive else "DOWN"
+                print(f"route: replica {handle.name} {handle.url} "
+                      f"[{state}]")
+            if args.trace_dir:
+                os.makedirs(args.trace_dir, exist_ok=True)
+                router.live.announce(args.trace_dir)
+            print(
+                f"routing on {router.url} (POST /synthesize "
+                "/replicas/add /replicas/remove /drain_replica; GET "
+                "/fleet /replicas /request /slo /metrics /metrics.json "
+                "/healthz)",
+                flush=True,
+            )
+            if args.discovery_out:
+                print(f"route: discovery file at {args.discovery_out} "
+                      "(pass to `ia-synth obs --targets` and "
+                      "`ia-synth trace --fleet`)")
+            while not stop.wait(1.0):
+                pass
+            print("route: exiting", flush=True)
+        except KeyboardInterrupt:
+            print("route: interrupted")
+        finally:
+            router.stop()
     return 0
 
 
@@ -1596,7 +1678,16 @@ def main(argv=None) -> int:
         "--url", default=None, metavar="URL",
         help="ask a LIVE daemon over HTTP instead of reading "
         "artifacts (GET /request?id=; round 19); exactly one of "
-        "--trace-dir/--url",
+        "--trace-dir/--url/--fleet",
+    )
+    p.add_argument(
+        "--fleet", default=None, metavar="DISCOVERY",
+        help="cross-process waterfall (round 22): walk the router's "
+        "replica-discovery file (ia-synth route --discovery-out), "
+        "pull the router-side and replica-side records for this id, "
+        "join them by the forwarded X-Parent-Span context, and render "
+        "ONE waterfall with a clock-skew bound and an honest "
+        "unattributed gap; exactly one of --trace-dir/--url/--fleet",
     )
     p.add_argument(
         "--access-log", default=None, metavar="JSONL",

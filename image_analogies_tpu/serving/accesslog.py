@@ -124,13 +124,25 @@ def find_request(path: str, request_id: str
     return found
 
 
+# Lifecycle order of every phase any access record can carry.  A
+# REPLICA record (serving/daemon.py) carries the queue..demux subset;
+# a ROUTER record (serving/router.py) carries pick/proxy/respond.  The
+# two sets are disjoint per record, so one ordered tuple serves both
+# readers — and the fleet waterfall (serving/fleettrace.py) relies on
+# that shared order when it nests a replica's phases inside the
+# router's proxy window.
+PHASE_ORDER = (
+    "pick_ms", "queue_ms", "compile_ms", "restore_ms",
+    "execute_ms", "demux_ms", "proxy_ms", "respond_ms",
+)
+
+
 def phase_fields(rec: Dict[str, Any]) -> List[tuple]:
     """(phase, millis) pairs present in one record, in lifecycle
     order — shared by the trace CLI and tools/serve_load.py so the
     committed critical path and the printed waterfall agree."""
     out = []
-    for phase in ("queue_ms", "compile_ms", "restore_ms",
-                  "execute_ms", "demux_ms"):
+    for phase in PHASE_ORDER:
         v = rec.get(phase)
         if isinstance(v, (int, float)):
             out.append((phase[:-3], float(v)))

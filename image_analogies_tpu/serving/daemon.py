@@ -193,6 +193,36 @@ def _request_id_from_headers(headers) -> str:
     return uuid.uuid4().hex[:12]
 
 
+# X-Trace-Hop is a small decimal (the router sends 1; a deeper proxy
+# chain counts up).  Anything else is replaced with 1, never rejected.
+_TRACE_HOP_RE = re.compile(r"^\d{1,3}$")
+
+
+def _trace_context_from_headers(headers) -> Dict[str, Any]:
+    """Inbound distributed-trace context (round 22): `X-Parent-Span`
+    (the upstream hop's span id — the fleet router's, normally) and
+    `X-Trace-Hop`.  Returns {} when neither header is present (direct
+    untraced traffic pays nothing).  Malformed or oversized values are
+    REPLACED with generated/clamped ones and echoed back — the
+    round-15 `X-Request-Id` policy: a hostile value must not poison
+    logs/span attrs, and a request must never be rejected over
+    telemetry decoration."""
+    parent = hop = None
+    for k, v in (headers or {}).items():
+        lk = str(k).lower()
+        if lk == "x-parent-span" and isinstance(v, str):
+            parent = v
+        elif lk == "x-trace-hop" and isinstance(v, str):
+            hop = v
+    if parent is None and hop is None:
+        return {}
+    if parent is None or not _REQUEST_ID_RE.match(parent):
+        parent = uuid.uuid4().hex[:12]
+    hop_n = int(hop) if (hop is not None
+                         and _TRACE_HOP_RE.match(hop)) else 1
+    return {"parent_span": parent, "hop": hop_n}
+
+
 def _phase_attribution(req: ServeRequest,
                        total_ms: float) -> Dict[str, float]:
     """queue/compile/execute/demux millis from the request's lifecycle
@@ -846,8 +876,16 @@ class SynthDaemon:
         journal -> enqueue -> block on completion.  Every exit echoes
         `request_id` in the body (the machine-parseable error
         contract), books the `ia_request_duration_ms` cell for its
-        outcome, and appends the structured access-log line."""
+        outcome, and appends the structured access-log line.
+
+        Round 22: inbound trace context (`X-Parent-Span`/
+        `X-Trace-Hop`, forwarded by the fleet router) is validated
+        here — malformed values replaced, never rejected — echoed on
+        EVERY exit body alongside `request_id`, and recorded on the
+        request's `serve_request` span root so the router's
+        `route_request` tree and this one join by id."""
         rid = _request_id_from_headers(headers)
+        tctx = _trace_context_from_headers(headers)
         t_in = time.monotonic()
         bytes_in = len(body) if body else 0
         try:
@@ -858,12 +896,12 @@ class SynthDaemon:
         except ValueError as e:
             payload = _json_bytes({
                 "status": "rejected", "error": str(e),
-                "request_id": rid,
+                "request_id": rid, **tctx,
             })
             self._book_response(
                 rid, None, "rejected", 400,
                 (time.monotonic() - t_in) * 1000.0, bytes_in,
-                len(payload),
+                len(payload), trace=tctx,
             )
             return 400, payload, "application/json"
         if self._draining.is_set():
@@ -879,17 +917,21 @@ class SynthDaemon:
                          "successor",
                 "request_id": rid,
                 "retry_after_s": retry,
+                **tctx,
             })
             self._book_response(
                 rid, None, "unavailable", 503,
                 (time.monotonic() - t_in) * 1000.0, bytes_in,
-                len(payload),
+                len(payload), trace=tctx,
             )
             return (
                 503, payload, "application/json",
                 {"Retry-After": str(int(np.ceil(retry)))},
             )
         req = self._make_request(frame, session, req_id=rid)
+        if tctx:
+            req.trace_parent = tctx.get("parent_span")
+            req.trace_hop = tctx.get("hop")
         if deadline_ms is not None:
             req.deadline_t = t_in + deadline_ms / 1000.0
         if ctx is not None:
@@ -918,11 +960,12 @@ class SynthDaemon:
                 "error": shed_error,
                 "request_id": rid,
                 "retry_after_s": retry_after,
+                **tctx,
             })
             self._book_response(
                 rid, req, "shed", 429,
                 (time.monotonic() - t_in) * 1000.0, bytes_in,
-                len(payload),
+                len(payload), trace=tctx,
             )
             return (
                 429, payload, "application/json",
@@ -946,18 +989,20 @@ class SynthDaemon:
             self._outstanding += 1
         try:
             return self._await_response(
-                rid, req, t_in, bytes_in
+                rid, req, t_in, bytes_in, tctx
             )
         finally:
             with self._outstanding_lock:
                 self._outstanding -= 1
 
     def _await_response(self, rid: str, req: ServeRequest,
-                        t_in: float, bytes_in: int):
+                        t_in: float, bytes_in: int,
+                        tctx: Optional[Dict[str, Any]] = None):
         """The admitted request's wait-and-respond tail, under the
         drain machinery's outstanding-responses counter (graceful
         drain waits for this to return before snapshotting state and
         exiting — an in-flight response is never cut mid-write)."""
+        tctx = tctx or {}
         self.queue.put(req)
         self._g_depth.set(len(self.queue))
         if not req.done.wait(REQUEST_TIMEOUT_S):
@@ -969,12 +1014,12 @@ class SynthDaemon:
             req.error = "request timed out in the daemon"
             payload = _json_bytes({
                 "status": "failed", "request_id": rid,
-                "error": req.error,
+                "error": req.error, **tctx,
             })
             self._book_response(
                 rid, req, "timeout", 504,
                 (time.monotonic() - req.enqueue_t) * 1000.0, bytes_in,
-                len(payload),
+                len(payload), trace=tctx,
             )
             return 504, payload, "application/json"
         total_ms = (time.monotonic() - req.enqueue_t) * 1000.0
@@ -985,27 +1030,28 @@ class SynthDaemon:
             # body exists for the rare still-listening client.
             payload = _json_bytes({
                 "status": "cancelled", "request_id": rid,
-                "error": req.error,
+                "error": req.error, **tctx,
             })
             self._book_response(
                 rid, req, "cancelled", 499, total_ms, bytes_in,
-                len(payload),
+                len(payload), trace=tctx,
             )
             return 499, payload, "application/json"
         if req.status != "ok":
             payload = _json_bytes({
                 "status": "failed", "request_id": rid,
-                "error": req.error, "spans": req.spans,
+                "error": req.error, "spans": req.spans, **tctx,
             })
             self._book_response(
                 rid, req, "failed", 500, total_ms, bytes_in,
-                len(payload),
+                len(payload), trace=tctx,
             )
             return 500, payload, "application/json"
         out = np.asarray(req.result, np.float32)
         payload = _json_bytes({
             "status": "ok",
             "request_id": rid,
+            **tctx,
             "cache": req.cache,
             "batch_size": req.batch_size,
             "wall_ms": round(total_ms, 3),
@@ -1017,13 +1063,15 @@ class SynthDaemon:
             ).decode(),
         })
         self._book_response(
-            rid, req, "ok", 200, total_ms, bytes_in, len(payload)
+            rid, req, "ok", 200, total_ms, bytes_in, len(payload),
+            trace=tctx,
         )
         return 200, payload, "application/json"
 
     def _book_response(self, rid: str, req: Optional[ServeRequest],
                        outcome: str, code: int, total_ms: float,
-                       bytes_in: int, bytes_out: int) -> None:
+                       bytes_in: int, bytes_out: int,
+                       trace: Optional[Dict[str, Any]] = None) -> None:
         """Response-time bookkeeping, one call per exit path: the
         request-duration observation (always — it is the SLO engine's
         raw material) and the access-log line (observability only).
@@ -1057,6 +1105,9 @@ class SynthDaemon:
             "bytes_in": bytes_in,
             "bytes_out": bytes_out,
         }
+        if trace:
+            entry["parent_span"] = trace.get("parent_span")
+            entry["hop"] = trace.get("hop")
         if req is not None:
             entry["t0"] = round(req.t0, 6)
             entry["session_id"] = req.session
@@ -1124,9 +1175,13 @@ class SynthDaemon:
             }), "application/json"
         events = []
         if self.flight is not None:
-            from ..telemetry.flight import request_events
+            from ..telemetry.flight import tree_events
 
-            events = request_events(self.flight.to_dict(), rid)
+            # Whole-tree events (round 22): the serve_request root
+            # plus its lifecycle/run children, so the fleet waterfall
+            # can nest the replica's inner spans inside the router's
+            # proxy window without a second scrape.
+            events = tree_events(self.flight.to_dict(), rid)
         return 200, _json_bytes({
             "request": entry, "flight_events": events,
         }), "application/json"
@@ -1459,8 +1514,10 @@ class SynthDaemon:
         dispatcher never races the restore of a stream it is using."""
         import dataclasses
 
+        from ..telemetry.spans import span_at
         from ..video.sequence import VideoStream
 
+        p_adopt0 = time.perf_counter()
         idx_path = os.path.join(source_state_dir, "sessions.json")
         try:
             with open(idx_path, "r", encoding="utf-8") as fh:
@@ -1473,6 +1530,7 @@ class SynthDaemon:
         wanted = None if only is None else {str(s) for s in only}
         cfg = dataclasses.replace(self.cfg, save_level_artifacts=None)
         adopted: List[str] = []
+        restores = []  # (sid, restored, p_start, p_end) for the span
         for sid, dirname in sessions.items():
             if not (isinstance(sid, str) and isinstance(dirname, str)):
                 continue
@@ -1480,21 +1538,46 @@ class SynthDaemon:
                 continue
             sdir = os.path.join(source_state_dir, "sessions",
                                 os.path.basename(dirname))
+            p_s0 = time.perf_counter()
             stream = VideoStream(
                 self.a, self.ap, cfg=cfg, registry=self.registry
             )
-            if stream.restore_state(sdir):
+            restored = stream.restore_state(sdir)
+            restores.append((sid, restored, p_s0,
+                             time.perf_counter()))
+            if restored:
                 self._sessions[sid] = stream
                 self._sessions.move_to_end(sid)
                 adopted.append(sid)
         while len(self._sessions) > self.max_sessions:
             self._sessions.popitem(last=False)
+        p_adopt1 = time.perf_counter()
         if adopted:
             self.registry.counter(
                 "ia_serve_sessions_adopted_total",
                 "session streams adopted from another replica's drain "
                 "snapshot (round 21 fleet migration)",
             ).inc(len(adopted))
+        self.registry.histogram(
+            "ia_serve_adopt_ms",
+            "wall of one /sessions/adopt restore (round 22 migration "
+            "visibility: the replica half of a drain migration)",
+        ).observe((p_adopt1 - p_adopt0) * 1000.0)
+        if self.tracer.enabled:
+            # Migration visibility (round 22): the adopt is a real
+            # span tree — one session_restore child per stream — so a
+            # repinned session's first frame can point at the restore
+            # cost instead of an anonymous stall.
+            root = span_at(
+                "sessions_adopt", p_adopt0, p_adopt1,
+                source=source_state_dir, sessions=len(adopted),
+            )
+            for sid, restored, a, b in restores:
+                root.children.append(span_at(
+                    "session_restore", a, b, session=sid,
+                    restored=restored,
+                ))
+            self.tracer.attach_tree(root)
         return adopted
 
     def _route_sessions_adopt(self, body: Optional[bytes]):
@@ -1857,6 +1940,12 @@ class SynthDaemon:
                 cache=req.cache, batch_size=req.batch_size,
                 outcome=req.status,
             )
+            if req.trace_parent is not None:
+                # Round-22 join key: the upstream (router) span id —
+                # the fleet waterfall matches this against the
+                # route_request tree's span_id.
+                root.attrs["parent_span"] = req.trace_parent
+                root.attrs["hop"] = req.trace_hop
             for i, (name, t_ms) in enumerate(events):
                 nxt = (events[i + 1][1] if i + 1 < len(events)
                        else rel_end)

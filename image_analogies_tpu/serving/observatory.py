@@ -37,7 +37,12 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional, Sequence
 
-from ..telemetry.slo import REQUEST_DURATION_METRIC, evaluate_slo
+from ..telemetry.slo import (
+    ROUTE_DURATION_METRIC,
+    ROUTE_OBJECTIVES,
+    REQUEST_DURATION_METRIC,
+    evaluate_slo,
+)
 
 OBS_SCHEMA_VERSION = 1
 OBS_ROUND = 19
@@ -183,16 +188,47 @@ def fleet_slo(merged: Dict[str, Any]) -> Dict[str, Any]:
     return evaluate_slo(merged)
 
 
+def fleet_route_slo(merged: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Round 22: the router hop graded with the SAME engine over the
+    pooled `ia_route_duration_ms` family — router and replica burn
+    rates sit side by side in one report WITHOUT double-counting: a
+    request contributes to `ia_request_duration_ms` on the replica
+    that served it and to `ia_route_duration_ms` at the router that
+    proxied it, and the two families are graded separately (router
+    `unavailable`/`shed` outcomes are availability-excluded by the
+    round-16 outcome taxonomy, same as on replicas).  None when no
+    router was in the scrape set — absent, never imputed."""
+    fam = merged.get(ROUTE_DURATION_METRIC)
+    if not fam or not (fam.get("values") or {}):
+        return None
+    return evaluate_slo(merged, ROUTE_OBJECTIVES,
+                        metric=ROUTE_DURATION_METRIC)
+
+
 def aggregate(targets: Sequence[str], span_s: Optional[float] = None,
               timeout: float = 10.0) -> Dict[str, Any]:
-    """Scrape every target and assemble the OBS record."""
+    """Scrape every target and assemble the OBS record.
+
+    Round 22 honesty rule: a target that is in the scrape set but
+    unreachable mid-scrape DEGRADES the fleet verdict — its traffic is
+    missing from the pooled families, so the fleet numbers are a
+    floor, not the truth.  The record says so (`degraded` +
+    `warnings`) instead of silently grading the survivors."""
     replicas = [scrape_replica(t, span_s, timeout) for t in targets]
     live = [r for r in replicas if r["error"] is None]
+    unreachable = [r for r in replicas if r["error"] is not None]
     merged = merge_registries([r["metrics"] for r in live])
     fleet: Dict[str, Any] = {
         "replicas_total": len(replicas),
         "replicas_live": len(live),
+        "degraded": bool(unreachable),
+        "warnings": [
+            f"target {r['url']} unreachable mid-scrape "
+            f"({r['error']}); pooled numbers exclude its traffic"
+            for r in unreachable
+        ],
         "slo": fleet_slo(merged),
+        "route_slo": fleet_route_slo(merged),
         "merged_metrics": merged,
         "anomalies_firing": sorted({
             f"{r['url']}:{w}"
@@ -270,11 +306,26 @@ def render_dashboard(record: Dict[str, Any]) -> str:
             + (f" p99={_fmt_ms(o.get('observed_p99_ms'))}ms"
                if o.get("kind") == "latency" else "")
         )
+    route = fleet.get("route_slo")
+    if route:
+        lines.append("")
+        lines.append("router hop objectives (pooled):")
+        for o in route.get("objectives", []):
+            burn = o.get("burn_rate")
+            lines.append(
+                f"  {o['name']:<24} {o['status']:<10} "
+                f"burn={'-' if burn is None else f'{burn:.4f}'} "
+                f"bad={o.get('bad_count', 0)}/{o.get('denominator', 0)}"
+                + (f" p99={_fmt_ms(o.get('observed_p99_ms'))}ms"
+                   if o.get("kind") == "latency" else "")
+            )
     firing = fleet.get("anomalies_firing") or []
     lines.append("")
     lines.append(
         "anomalies firing: " + (", ".join(firing) if firing else "none")
     )
+    for warn in fleet.get("warnings") or []:
+        lines.append(f"WARNING (fleet degraded): {warn}")
     return "\n".join(lines) + "\n"
 
 

@@ -58,6 +58,12 @@ class ServeRequest:
     b_stats: Optional[Tuple[float, float]]  # canonical bucket stats
     session: Optional[str] = None  # session-affinity id (daemon)
     req_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
+    # Inbound distributed-trace context (round 22): the upstream span
+    # id (the router's `X-Parent-Span`) and hop count, validated at
+    # ingest; None for untraced/direct traffic.  Recorded on the
+    # serve_request root's attrs so the router and replica trees join.
+    trace_parent: Optional[str] = None
+    trace_hop: Optional[int] = None
     enqueue_t: float = field(default_factory=time.monotonic)
     # Absolute anchors for the SAME instant `enqueue_t` names: `t0` is
     # wall-clock epoch seconds (so post-mortem dumps from DIFFERENT

@@ -43,10 +43,35 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 ROUTER_SCHEMA_VERSION = 1
 DISCOVERY_KIND = "fleet_discovery"
+
+# Outcome vocabulary for ia_route_duration_ms — aligned with the
+# replica's ia_request_duration_ms outcomes so telemetry/slo.py's
+# admitted/bad split applies unchanged: `unavailable`/`shed`/
+# `cancelled`/`rejected` are availability-EXCLUDED (round-16
+# semantics: the backend never owed those requests a response), while
+# `failed`/`timeout` burn budget and `ok` earns it.
+_OUTCOME_BY_CODE = {
+    200: "ok", 400: "rejected", 429: "shed", 499: "cancelled",
+    503: "unavailable", 504: "timeout",
+}
+
+
+def _outcome_for_code(code: int) -> str:
+    return _OUTCOME_BY_CODE.get(code, "failed")
+
+
+def _header(headers, name: str) -> Optional[str]:
+    """Case-insensitive header lookup over whatever mapping the HTTP
+    layer handed us."""
+    want = name.lower()
+    for k, v in (headers or {}).items():
+        if str(k).lower() == want and isinstance(v, str):
+            return v
+    return None
 
 # One proxy hop is bounded by the replica's own behavior (admission
 # sheds, dispatch deadlines); the router just needs to outlast a cold
@@ -133,7 +158,7 @@ class FleetRouter:
                  scrape_timeout_s: float = 5.0,
                  proxy_timeout_s: float = DEFAULT_PROXY_TIMEOUT_S,
                  discovery_path: Optional[str] = None,
-                 flight=None):
+                 flight=None, access_log_path: Optional[str] = None):
         from ..telemetry.spans import as_tracer
 
         self.registry = registry
@@ -193,6 +218,49 @@ class FleetRouter:
             "router proxy wall per request (pick + replica round "
             "trip), by outcome",
         )
+        from ..telemetry.slo import (
+            REQUEST_DURATION_BUCKETS,
+            ROUTE_DURATION_METRIC,
+        )
+
+        # Router-observed end-to-end latency — same bucket ladder and
+        # outcome vocabulary as the replica family, so the existing
+        # SloEngine grades the router hop with unchanged budget
+        # arithmetic (round-22 satellite: router requests no longer
+        # vanish from SLO math).
+        self._h_duration = r.histogram(
+            ROUTE_DURATION_METRIC,
+            "router-observed request latency (ms) by outcome/replica "
+            "— the raw family the router SLO objectives grade",
+            buckets=REQUEST_DURATION_BUCKETS,
+        )
+        self._c_retries = r.counter(
+            "ia_route_retries_total",
+            "proxy attempts re-routed to another replica, by reason "
+            "(conn_error: connection-level failure; draining: replica "
+            "refused before admission)",
+        )
+        self._c_unrouted = r.counter(
+            "ia_route_unrouted_total",
+            "requests the router could not place on any live "
+            "non-draining replica (503 + Retry-After)",
+        )
+        self._h_migration = r.histogram(
+            "ia_route_migration_ms",
+            "drain-time session migration wall (drain signal -> "
+            "sessions adopted + re-pinned) per drain_replica call",
+        )
+        # Router-side JSONL access log (round-22 tentpole): same
+        # durability contract as the replica's (serving/accesslog.py),
+        # one line per routed request with per-phase walls and the
+        # chosen replica.  Off (None) unless a path is given — the
+        # hot path stays allocation-free when untraced.
+        from .accesslog import AccessLog
+
+        self.access = (
+            AccessLog(access_log_path) if access_log_path else None
+        )
+        self._slo_engine = None
 
     # ------------------------------------------------------ lifecycle
     def start(self) -> "FleetRouter":
@@ -210,6 +278,7 @@ class FleetRouter:
                 ("GET", "/fleet"): self._route_fleet,
                 ("GET", "/replicas"): self._route_replicas,
                 ("GET", "/slo"): self._route_slo,
+                ("GET", "/request"): self._route_request,
                 ("POST", "/replicas/add"): self._route_add,
                 ("POST", "/replicas/remove"): self._route_remove,
                 ("POST", "/drain_replica"): self._route_drain_replica,
@@ -230,6 +299,8 @@ class FleetRouter:
         if self.live is not None:
             self.live.stop()
             self.live = None
+        if self.access is not None:
+            self.access.close()
 
     @property
     def url(self) -> str:
@@ -318,11 +389,15 @@ class FleetRouter:
               exclude: Optional[str] = None):
         """One routing decision under the lock: affinity first (a live
         non-draining pinned replica is a `hit`), else least score.
-        Returns (handle, affinity_result|None); books the outstanding
-        increment the caller must pair with `_settle`."""
+        Returns (handle, affinity_result|None, considered) where
+        `considered` lists every candidate's outstanding score at
+        decision time (the `pick` span's attrs — round-22 trace
+        fabric); books the outstanding increment the caller must pair
+        with `_settle`."""
         with self._lock:
             result = None
             handle = None
+            considered: List[Dict[str, Any]] = []
             if session is not None:
                 pinned = self._affinity.get(session)
                 if pinned is not None:
@@ -330,13 +405,18 @@ class FleetRouter:
                     if (h is not None and h.alive and not h.draining
                             and h.name != exclude):
                         handle, result = h, "hit"
+                        considered = [{"replica": h.name,
+                                       "score": h.score(),
+                                       "pinned": True}]
             if handle is None:
                 candidates = [
                     h for h in self._replicas.values()
                     if h.alive and not h.draining and h.name != exclude
                 ]
+                considered = [{"replica": h.name, "score": h.score()}
+                              for h in candidates]
                 if not candidates:
-                    return None, None
+                    return None, None, considered
                 handle = min(
                     candidates, key=lambda h: (h.score(), h.name)
                 )
@@ -352,7 +432,7 @@ class FleetRouter:
                 float(handle.outstanding),
                 labels={"replica": handle.name},
             )
-            return handle, result
+            return handle, result, considered
 
     def _settle(self, handle: ReplicaHandle, ok: bool) -> None:
         with self._lock:
@@ -374,36 +454,140 @@ class FleetRouter:
         journals before ack, and replayed outputs are bit-identical);
         HTTP-level replies (200/400/429/503) pass through — except a
         draining 503, which re-routes once because the poller simply
-        hasn't caught the drain yet."""
+        hasn't caught the drain yet.
+
+        Round-22 trace fabric: every request gets a validated (or
+        generated — malformed values replaced, never rejected, the
+        round-15 id policy) `X-Request-Id`, the router's own span id is
+        forwarded downstream as `X-Parent-Span` with an incremented
+        `X-Trace-Hop`, and — when the router is traced — the whole hop
+        is reconstructed as a `route_request` span tree (received ->
+        pick -> proxy_attempt per try -> respond) plus one access-log
+        line with per-phase walls and the chosen replica, joinable
+        with the replica's `serve_request` tree by request id."""
+        from ..telemetry.spans import new_span_id, span_at
+        from .fleettrace import parse_hop, valid_token
+
+        p_recv = time.perf_counter()
+        t0_wall = time.time()
         session = _session_from_body(body)
-        rid = None
-        for k, v in (headers or {}).items():
-            if str(k).lower() == "x-request-id" and isinstance(v, str):
-                rid = v
-                break
-        t0 = time.monotonic()
+        raw_rid = _header(headers, "x-request-id")
+        rid = (raw_rid if raw_rid is not None and valid_token(raw_rid)
+               else new_span_id())
+        raw_parent = _header(headers, "x-parent-span")
+        client_parent = (
+            raw_parent
+            if raw_parent is None or valid_token(raw_parent)
+            else new_span_id()
+        )
+        hop_in = parse_hop(_header(headers, "x-trace-hop"))
+        hop_out = (hop_in if hop_in is not None else 0) + 1
+        span_id = new_span_id()
+        traced = self.tracer.enabled or self.access is not None
+        bytes_in = len(body or b"")
+        children: List[Tuple[str, float, float, Dict[str, Any]]] = []
+        attempts: List[Dict[str, Any]] = []
+        retries = 0
+        pick_ms = 0.0
+        proxy_ms = 0.0
+        p_received_end = time.perf_counter()
+        if traced:
+            children.append(("received", p_recv, p_received_end, {}))
+
+        def finish(code, payload, ctype, extra_headers, outcome,
+                   replica, proxy_outcome):
+            p_end = time.perf_counter()
+            total_ms = (p_end - p_recv) * 1000.0
+            self._h_proxy.observe(total_ms,
+                                  labels={"outcome": proxy_outcome})
+            self._h_duration.observe(total_ms, labels={
+                "outcome": outcome, "replica": replica or "none",
+            }, exemplar=rid)
+            out_headers = {
+                "X-Request-Id": rid,
+                "X-Parent-Span": span_id,
+                "X-Trace-Hop": str(hop_out),
+            }
+            out_headers.update(extra_headers or {})
+            if traced:
+                p_last = children[-1][2] if children else p_recv
+                children.append(("respond", p_last, p_end, {}))
+                root_attrs: Dict[str, Any] = {
+                    "request_id": rid, "span_id": span_id,
+                    "outcome": outcome, "http_status": code,
+                    "replica": replica, "attempts": len(attempts),
+                    "retries": retries, "hop": hop_in or 0,
+                }
+                if session is not None:
+                    root_attrs["session"] = session
+                if client_parent is not None:
+                    root_attrs["parent_span"] = client_parent
+                root = span_at("route_request", p_recv, p_end,
+                               **root_attrs)
+                for name, a, b, attrs in children:
+                    root.children.append(
+                        span_at(name, a, b, request_id=rid, **attrs)
+                    )
+                self.tracer.attach_tree(root)
+                if self.access is not None:
+                    entry: Dict[str, Any] = {
+                        "ts": root.ts, "t0": round(t0_wall, 6),
+                        "kind": "router", "route": "/synthesize",
+                        "request_id": rid, "span_id": span_id,
+                        "hop": hop_in or 0,
+                        "session_id": session, "outcome": outcome,
+                        "http_status": code, "replica": replica,
+                        "attempts": attempts, "retries": retries,
+                        "total_ms": round(total_ms, 3),
+                        "pick_ms": round(pick_ms, 3),
+                        "proxy_ms": round(proxy_ms, 3),
+                        "respond_ms": round(
+                            (p_end - p_last) * 1000.0, 3),
+                        "bytes_in": bytes_in,
+                        "bytes_out": len(payload or b""),
+                    }
+                    if client_parent is not None:
+                        entry["parent_span"] = client_parent
+                    self.access.log(entry)
+            return (code, payload, ctype, out_headers)
+
         exclude = None
         for attempt in (0, 1):
-            handle, _ = self._pick(session, exclude=exclude)
+            p_pick0 = time.perf_counter()
+            handle, aff, considered = self._pick(session,
+                                                 exclude=exclude)
+            p_pick1 = time.perf_counter()
+            pick_ms += (p_pick1 - p_pick0) * 1000.0
+            if traced:
+                pick_attrs: Dict[str, Any] = {
+                    "replica": handle.name if handle else None,
+                    "considered": considered,
+                }
+                if aff is not None:
+                    pick_attrs["affinity"] = aff
+                children.append(("pick", p_pick0, p_pick1, pick_attrs))
             if handle is None:
+                self._c_unrouted.inc()
                 payload = json.dumps({
                     "status": "unavailable",
                     "error": "no live non-draining replica",
+                    "request_id": rid,
                 }).encode()
-                self._h_proxy.observe(
-                    (time.monotonic() - t0) * 1000.0,
-                    labels={"outcome": "unrouted"},
-                )
-                return (503, payload, "application/json",
-                        {"Retry-After": "1"})
-            hdrs = {"Content-Type": "application/json"}
-            if rid:
-                hdrs["X-Request-Id"] = rid
+                return finish(503, payload, "application/json",
+                              {"Retry-After": "1"}, "unavailable",
+                              None, "unrouted")
+            hdrs = {
+                "Content-Type": "application/json",
+                "X-Request-Id": rid,
+                "X-Parent-Span": span_id,
+                "X-Trace-Hop": str(hop_out),
+            }
             req = urllib.request.Request(
                 handle.url + "/synthesize", data=body or b"{}",
                 method="POST", headers=hdrs,
             )
             code = None
+            p_send = time.perf_counter()
             try:
                 with urllib.request.urlopen(
                     req, timeout=self.proxy_timeout_s
@@ -417,6 +601,9 @@ class FleetRouter:
                 # Connection refused/reset: the replica is gone (or
                 # going).  Mark it down so the next pick skips it and
                 # retry the request elsewhere once.
+                p_fail = time.perf_counter()
+                wall = (p_fail - p_send) * 1000.0
+                proxy_ms += wall
                 self._settle(handle, ok=False)
                 with self._lock:
                     handle.alive = False
@@ -424,20 +611,36 @@ class FleetRouter:
                 self._c_requests.inc(labels={
                     "replica": handle.name, "outcome": "conn_error",
                 })
-                if attempt == 0:
+                retrying = attempt == 0
+                attempts.append({
+                    "replica": handle.name, "outcome": "conn_error",
+                    "wall_ms": round(wall, 3),
+                    "retry_reason": "conn_error" if retrying else None,
+                })
+                if traced:
+                    children.append(("proxy_attempt", p_send, p_fail, {
+                        "replica": handle.name, "outcome": "conn_error",
+                        "retry_reason": (
+                            "conn_error" if retrying else None),
+                    }))
+                if retrying:
                     with self._lock:
                         self.retries += 1
+                    retries += 1
+                    self._c_retries.inc(
+                        labels={"reason": "conn_error"})
                     exclude = handle.name
                     continue
                 payload = json.dumps({
                     "status": "unavailable",
                     "error": "replica connection failed twice",
+                    "request_id": rid,
                 }).encode()
-                self._h_proxy.observe(
-                    (time.monotonic() - t0) * 1000.0,
-                    labels={"outcome": "conn_error"},
-                )
-                return (502, payload, "application/json")
+                return finish(502, payload, "application/json", {},
+                              "failed", handle.name, "conn_error")
+            p_resp = time.perf_counter()
+            wall = (p_resp - p_send) * 1000.0
+            proxy_ms += wall
             draining_503 = False
             if code == 503 and attempt == 0:
                 try:
@@ -454,26 +657,45 @@ class FleetRouter:
                 with self._lock:
                     handle.draining = True
                     self.retries += 1
+                retries += 1
+                self._c_retries.inc(labels={"reason": "draining"})
                 self._g_draining.set(
                     1.0, labels={"replica": handle.name}
                 )
                 self._c_requests.inc(labels={
                     "replica": handle.name, "outcome": "draining",
                 })
+                attempts.append({
+                    "replica": handle.name, "outcome": "draining",
+                    "wall_ms": round(wall, 3),
+                    "retry_reason": "draining",
+                })
+                if traced:
+                    children.append(("proxy_attempt", p_send, p_resp, {
+                        "replica": handle.name, "outcome": "draining",
+                        "retry_reason": "draining",
+                    }))
                 exclude = handle.name
                 continue
             self._settle(handle, ok=code == 200)
             self._c_requests.inc(labels={
                 "replica": handle.name, "outcome": str(code),
             })
-            self._h_proxy.observe(
-                (time.monotonic() - t0) * 1000.0,
-                labels={"outcome": "ok" if code == 200 else "error"},
-            )
+            attempts.append({
+                "replica": handle.name, "outcome": str(code),
+                "wall_ms": round(wall, 3),
+            })
+            if traced:
+                children.append(("proxy_attempt", p_send, p_resp, {
+                    "replica": handle.name, "outcome": str(code),
+                }))
             out_headers = {"X-Routed-To": handle.name}
             if "Retry-After" in resp_headers:
                 out_headers["Retry-After"] = resp_headers["Retry-After"]
-            return (code, payload, "application/json", out_headers)
+            return finish(code, payload, "application/json",
+                          out_headers, _outcome_for_code(code),
+                          handle.name,
+                          "ok" if code == 200 else "error")
         raise AssertionError("unreachable")
 
     # ------------------------------------------------- drain/migrate
@@ -485,7 +707,18 @@ class FleetRouter:
         hand its pinned sessions to the least-loaded survivor via
         /sessions/adopt and re-pin them.  Synchronous; returns the
         migration report.  The caller owns the process afterwards
-        (kill, takeover, re-add)."""
+        (kill, takeover, re-add).
+
+        Round-22 migration visibility: the whole drain is one
+        `drain_migration` span tree (drain_wait -> sessions_adopt ->
+        repin) attached to the router tracer, and the drain-to-adopted
+        wall lands in `ia_route_migration_ms` — so a repinned
+        session's first frame shows its true cost attribution in the
+        fleet waterfall instead of an anonymous stall."""
+        from ..telemetry.spans import span_at
+
+        p_drain0 = time.perf_counter()
+        mig_children: List[Any] = []
         with self._lock:
             handle = self._replicas.get(name)
             if handle is None:
@@ -520,6 +753,12 @@ class FleetRouter:
                 report["drained"] = True
                 break
             time.sleep(0.1)
+        p_wait1 = time.perf_counter()
+        if self.tracer.enabled:
+            mig_children.append(span_at(
+                "drain_wait", p_drain0, p_wait1, replica=name,
+                drained=report["drained"],
+            ))
         if pinned and handle.state_dir:
             with self._lock:
                 candidates = [
@@ -530,6 +769,7 @@ class FleetRouter:
                     candidates, key=lambda h: (h.score(), h.name)
                 ) if candidates else None
             if target is not None:
+                p_adopt0 = time.perf_counter()
                 try:
                     resp = _http_json(
                         target.url + "/sessions/adopt",
@@ -540,16 +780,48 @@ class FleetRouter:
                         }).encode(),
                     )
                     adopted = resp.get("adopted") or []
+                    p_adopt1 = time.perf_counter()
+                    if self.tracer.enabled:
+                        mig_children.append(span_at(
+                            "sessions_adopt", p_adopt0, p_adopt1,
+                            source=name, target=target.name,
+                            sessions=len(adopted),
+                        ))
                     with self._lock:
                         for sid in adopted:
                             self._affinity[sid] = target.name
                         self.migrations += len(adopted)
                     if adopted:
                         self._c_migrations.inc(len(adopted))
+                    if self.tracer.enabled:
+                        mig_children.append(span_at(
+                            "repin", p_adopt1, time.perf_counter(),
+                            target=target.name,
+                            sessions=len(adopted),
+                        ))
                     report["sessions_migrated"] = adopted
                     report["migrated_to"] = target.name
                 except (urllib.error.URLError, OSError, ValueError) as e:
                     report["migrate_error"] = f"{type(e).__name__}: {e}"
+                    if self.tracer.enabled:
+                        mig_children.append(span_at(
+                            "sessions_adopt", p_adopt0,
+                            time.perf_counter(), source=name,
+                            target=target.name, error=str(e),
+                        ))
+        p_done = time.perf_counter()
+        migration_ms = round((p_done - p_drain0) * 1000.0, 3)
+        report["migration_ms"] = migration_ms
+        self._h_migration.observe(migration_ms)
+        if self.tracer.enabled:
+            root = span_at(
+                "drain_migration", p_drain0, p_done, replica=name,
+                drained=report["drained"],
+                migrated_to=report["migrated_to"],
+                sessions=len(report["sessions_migrated"]),
+            )
+            root.children.extend(mig_children)
+            self.tracer.attach_tree(root)
         self._write_discovery()
         return report
 
@@ -607,16 +879,52 @@ class FleetRouter:
 
     def _route_slo(self, _body):
         """Router-grade /slo: the standard objective evaluation over
-        the router's registry plus the fleet anomaly watches, so the
-        observatory scrapes the router exactly like a replica."""
+        the router's OWN duration family (`ia_route_duration_ms`,
+        graded by the same SloEngine the replicas use — round-22
+        satellite) plus the fleet anomaly watches, so the observatory
+        scrapes the router exactly like a replica."""
         from ..telemetry.anomaly import fleet_watches
-        from ..telemetry.slo import evaluate_slo
+        from ..telemetry.slo import ROUTE_DURATION_METRIC, SloEngine
 
-        report = evaluate_slo(self.registry.to_dict())
+        if self._slo_engine is None:
+            self._slo_engine = SloEngine(
+                self.registry, metric=ROUTE_DURATION_METRIC
+            )
+        report = self._slo_engine.evaluate()
         report["anomalies"] = fleet_watches(
             self.replicas(), self.registry
         )
         return 200, _json_bytes(report), "application/json"
+
+    def _route_request(self, _body, _headers, ctx):
+        """GET /request?id=<rid>: the router half of one request's
+        fleet trace — its access-log record plus the route_request
+        span-tree events still in the flight ring.  Mirrors the
+        replica's endpoint so `ia-synth trace <id> --fleet` walks both
+        with one code path."""
+        from ..telemetry.flight import tree_events
+        from .accesslog import find_request
+
+        rid = (ctx.get("query") or {}).get("id") if ctx else None
+        if not rid:
+            return 400, _json_bytes(
+                {"status": "rejected", "error": "id query param "
+                 "required"}
+            ), "application/json"
+        entry = (find_request(self.access.path, rid)
+                 if self.access is not None else None)
+        events = (tree_events(self.flight.to_dict(), rid)
+                  if self.flight is not None else [])
+        if entry is None and not events:
+            return 404, _json_bytes(
+                {"status": "unknown", "request_id": rid}
+            ), "application/json"
+        return 200, _json_bytes({
+            "request_id": rid,
+            "kind": "router",
+            "request": entry,
+            "flight_events": events,
+        }), "application/json"
 
     def _route_add(self, body):
         try:

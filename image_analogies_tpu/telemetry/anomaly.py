@@ -271,6 +271,99 @@ def _watch(name: str, status: str, observed, threshold,
             "threshold": threshold, "detail": detail}
 
 
+# Router-path thresholds (round 22).  Cumulative fractions, graded
+# like the SLO's lifetime window: retries are a normal transient
+# during drains, so the retry ceiling is generous; ANY sustained
+# unrouted traffic is an incident; a drain migration that takes
+# longer than the replica's own request timeout means sessions are
+# repaying cold starts.
+ROUTE_RETRY_RATE_MAX = 0.2
+ROUTE_UNROUTED_FRAC_MAX = 0.05
+ROUTE_MIGRATION_P99_MAX_MS = 30000.0
+
+
+def _counter_sum(metrics: Dict[str, Any], name: str) -> float:
+    vals = (metrics.get(name) or {}).get("values") or {}
+    return float(sum(v for v in vals.values()
+                     if isinstance(v, (int, float))))
+
+
+def _histogram_merged(metrics: Dict[str, Any],
+                      name: str) -> Optional[Dict[str, Any]]:
+    """All of one histogram family's cells pooled bucket-by-bucket
+    (same arithmetic the observatory uses), or None when silent."""
+    vals = (metrics.get(name) or {}).get("values") or {}
+    merged: Optional[Dict[str, Any]] = None
+    for cell in vals.values():
+        if not isinstance(cell, dict):
+            continue
+        if merged is None:
+            merged = {"count": 0, "sum": 0.0,
+                      "buckets": dict.fromkeys(
+                          cell.get("buckets") or {}, 0)}
+        merged["count"] += int(cell.get("count") or 0)
+        merged["sum"] += float(cell.get("sum") or 0.0)
+        for b, c in (cell.get("buckets") or {}).items():
+            merged["buckets"][b] = merged["buckets"].get(b, 0) + c
+    return merged
+
+
+def _router_path_watches(metrics: Dict[str, Any]
+                         ) -> List[Dict[str, Any]]:
+    """Round-22 router-path watches over the router's own serialized
+    registry: retry rate, unroutable 503s, and drain-migration
+    latency.  Each grades `no_data` (never fires, never imputes) until
+    its family has traffic."""
+    from .slo import ROUTE_DURATION_METRIC, quantile_from_cell
+
+    watches: List[Dict[str, Any]] = []
+    dur = _histogram_merged(metrics, ROUTE_DURATION_METRIC)
+    requests = float(dur["count"]) if dur else 0.0
+    retries = _counter_sum(metrics, "ia_route_retries_total")
+    if requests <= 0:
+        watches.append(_watch(
+            "route_retry_rate", "no_data", None, ROUTE_RETRY_RATE_MAX,
+            "no routed requests yet"))
+    else:
+        rate = retries / requests
+        watches.append(_watch(
+            "route_retry_rate",
+            "firing" if rate > ROUTE_RETRY_RATE_MAX else "ok",
+            round(rate, 4), ROUTE_RETRY_RATE_MAX,
+            f"{int(retries)} retries over {int(requests)} routed "
+            "request(s)"))
+    unrouted = _counter_sum(metrics, "ia_route_unrouted_total")
+    if requests <= 0 and unrouted <= 0:
+        watches.append(_watch(
+            "route_unrouted", "no_data", None,
+            ROUTE_UNROUTED_FRAC_MAX, "no routed requests yet"))
+    else:
+        frac = unrouted / max(1.0, requests + unrouted)
+        watches.append(_watch(
+            "route_unrouted",
+            "firing" if (unrouted > 0
+                         and frac > ROUTE_UNROUTED_FRAC_MAX)
+            else "ok",
+            round(frac, 4), ROUTE_UNROUTED_FRAC_MAX,
+            f"{int(unrouted)} unrouted 503(s) against "
+            f"{int(requests)} routed request(s)"))
+    mig = _histogram_merged(metrics, "ia_route_migration_ms")
+    if not mig or not mig["count"]:
+        watches.append(_watch(
+            "route_migration_latency", "no_data", None,
+            ROUTE_MIGRATION_P99_MAX_MS, "no drain migrations yet"))
+    else:
+        p99 = quantile_from_cell(mig, 0.99)
+        watches.append(_watch(
+            "route_migration_latency",
+            "firing" if (p99 is not None
+                         and p99 > ROUTE_MIGRATION_P99_MAX_MS)
+            else "ok",
+            p99, ROUTE_MIGRATION_P99_MAX_MS,
+            f"p99 over {mig['count']} drain migration(s)"))
+    return watches
+
+
 def fleet_watches(replicas: List[Dict[str, Any]],
                   registry: Optional[MetricsRegistry] = None
                   ) -> Dict[str, Any]:
@@ -280,9 +373,12 @@ def fleet_watches(replicas: List[Dict[str, Any]],
     wrong AT the router is membership-shaped: a replica that stopped
     answering the poller without being drained (`replica_down`), and
     the terminal case of zero routable replicas (`fleet_unroutable`).
-    Same report shape as AnomalyDetector.evaluate, same status gauge,
-    so `ia-synth obs` and the sentinel read router anomalies through
-    the exact machinery that reads replica anomalies."""
+    Round 22 adds the router-PATH watches (retry rate, unroutable
+    503s, migration latency) graded from the router's own registry
+    when one is provided.  Same report shape as
+    AnomalyDetector.evaluate, same status gauge, so `ia-synth obs`
+    and the sentinel read router anomalies through the exact
+    machinery that reads replica anomalies."""
     watches: List[Dict[str, Any]] = []
     if not replicas:
         watches.append(_watch("replica_down", "no_data", None, 0,
@@ -307,6 +403,7 @@ def fleet_watches(replicas: List[Dict[str, Any]],
             f"{routable} live non-draining replica(s)",
         ))
     if registry is not None:
+        watches.extend(_router_path_watches(registry.to_dict()))
         g = registry.gauge(
             ANOMALY_STATUS_GAUGE,
             "live anomaly watch status (1 firing, 0 ok, -1 no_data)",

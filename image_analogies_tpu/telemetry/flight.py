@@ -317,6 +317,37 @@ def request_events(dump: Dict[str, Any],
     ]
 
 
+def tree_events(dump: Dict[str, Any],
+                request_id: str) -> List[Dict[str, Any]]:
+    """Every event of every span TREE rooted at `request_id` — the
+    round-22 fleet-trace accessor.  `request_events` only matches
+    events whose own attrs carry the id; a request tree's lifecycle
+    children (enqueue/dispatch/... on a replica, or a grafted run
+    subtree) do not.  Attached trees are replayed depth-first through
+    the observer hook (spans.Tracer.attach_tree), so in the ring a
+    root's open..close bracket contains exactly its tree: track the
+    open/close depth from each matching root's open event and collect
+    until it returns to zero.  Events the ring already evicted are
+    simply absent — honest truncation, never reconstruction."""
+    out: List[Dict[str, Any]] = []
+    depth = 0
+    for ev in stack_events(dump):
+        kind = ev.get("kind")
+        if depth == 0:
+            if (kind == "open"
+                    and (ev.get("attrs") or {}).get("request_id")
+                    == request_id):
+                depth = 1
+                out.append(ev)
+            continue
+        out.append(ev)
+        if kind == "open":
+            depth += 1
+        elif kind == "close":
+            depth -= 1
+    return out
+
+
 def read_flight(path: str) -> Dict[str, Any]:
     import json
 

@@ -138,6 +138,10 @@ _OVERHEAD_GAUGES = (
     # anomaly watches on the live daemon), measured by
     # tests/test_observatory.py's paired daemon arms.
     "ia_observatory_overhead_frac",
+    # Round 22: the router trace fabric (span tree + access-log write
+    # per proxied request), measured by tools/serve_load.py's paired
+    # traced/bare router arms (min-paired-delta).
+    "ia_route_trace_overhead_frac",
 )
 
 # Straggler watch (round 10): a level whose slowest shard finishes

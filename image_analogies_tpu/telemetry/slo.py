@@ -49,6 +49,14 @@ SCHEMA_VERSION = 1
 
 REQUEST_DURATION_METRIC = "ia_request_duration_ms"
 
+# The fleet router's own duration family (round 22): same bucket
+# ladder, same outcome vocabulary, graded by the same engine — pass it
+# as `metric=` to SloEngine/evaluate_slo.  Kept separate from the
+# replica family so pooling router + replica burn rates never double-
+# counts a request (every routed request also lands in exactly one
+# replica's ia_request_duration_ms).
+ROUTE_DURATION_METRIC = "ia_route_duration_ms"
+
 # Explicit bucket ladder for ia_request_duration_ms: denser than the
 # registry default in the 5 ms - 5 s band where a warm CPU-proxy serve
 # lands, and containing EVERY DEFAULT_OBJECTIVES latency threshold as
@@ -120,6 +128,18 @@ DEFAULT_OBJECTIVES: Tuple[Objective, ...] = (
     Objective(name="warm_p99_latency_ms", kind="latency", target=0.99,
               threshold_ms=30000.0,
               labels={"outcome": "ok", "cache": "hit"}),
+    Objective(name="availability", kind="availability", target=0.99),
+    Objective(name="shed_rate", kind="shed_rate", target=0.9),
+)
+
+# Objectives for the router hop (ia_route_duration_ms{outcome,
+# replica}): no cache label exists at the router — it never knows a
+# replica's cache verdict — so the latency objective filters on
+# outcome alone.  Availability/shed arithmetic is label-free and
+# shared verbatim.
+ROUTE_OBJECTIVES: Tuple[Objective, ...] = (
+    Objective(name="route_p99_latency_ms", kind="latency", target=0.99,
+              threshold_ms=30000.0, labels={"outcome": "ok"}),
     Objective(name="availability", kind="availability", target=0.99),
     Objective(name="shed_rate", kind="shed_rate", target=0.9),
 )
@@ -277,13 +297,17 @@ _STATUS_VERDICT = {
 
 def evaluate_slo(metrics: Dict[str, Any],
                  objectives: Sequence[Objective] = DEFAULT_OBJECTIVES,
-                 window_s: Optional[float] = None) -> Dict[str, Any]:
+                 window_s: Optional[float] = None,
+                 metric: str = REQUEST_DURATION_METRIC
+                 ) -> Dict[str, Any]:
     """Grade `objectives` against a serialized metrics dict
     (MetricsRegistry.to_dict()) — the whole record when offline, a
-    snapshot delta when the SloEngine calls it.  Returns the versioned
+    snapshot delta when the SloEngine calls it.  `metric` names the
+    duration family to grade (the replica family by default; pass
+    ROUTE_DURATION_METRIC for the router hop).  Returns the versioned
     slo report; never raises on silent/missing families (objectives
     grade `no_data`)."""
-    values = _family_values(metrics)
+    values = _family_values(metrics, name=metric)
     by_outcome = _outcome_counts(values)
     graded: List[Dict[str, Any]] = []
     for obj in objectives:
@@ -325,7 +349,7 @@ def evaluate_slo(metrics: Dict[str, Any],
     report: Dict[str, Any] = {
         "schema_version": SCHEMA_VERSION,
         "kind": "slo",
-        "metric": REQUEST_DURATION_METRIC,
+        "metric": metric,
         "window_s": window_s,
         "outcomes": by_outcome,
         "objectives": graded,
@@ -364,10 +388,17 @@ class SloEngine:
     publishes the burn-rate gauges.  With no prior snapshot in range
     the window is 'since start' — stated in the report."""
 
-    def __init__(self, registry, objectives: Sequence[Objective]
-                 = DEFAULT_OBJECTIVES, window_s: float = 300.0,
-                 max_snapshots: int = 64):
+    def __init__(self, registry, objectives: Optional[
+                     Sequence[Objective]] = None,
+                 window_s: float = 300.0,
+                 max_snapshots: int = 64,
+                 metric: str = REQUEST_DURATION_METRIC):
         self.registry = registry
+        self.metric = metric
+        if objectives is None:
+            objectives = (ROUTE_OBJECTIVES
+                          if metric == ROUTE_DURATION_METRIC
+                          else DEFAULT_OBJECTIVES)
         self.objectives = tuple(objectives)
         self.window_s = float(window_s)
         self._snaps: "deque[Tuple[float, Dict]]" = deque(
@@ -376,7 +407,8 @@ class SloEngine:
 
     def evaluate(self) -> Dict[str, Any]:
         now = time.monotonic()
-        current = _family_values(self.registry.to_dict())
+        current = _family_values(self.registry.to_dict(),
+                                 name=self.metric)
         while self._snaps and now - self._snaps[0][0] > self.window_s:
             self._snaps.popleft()
         if self._snaps:
@@ -388,9 +420,8 @@ class SloEngine:
             values = current
         self._snaps.append((now, current))
         report = evaluate_slo(
-            {REQUEST_DURATION_METRIC: {"kind": "histogram",
-                                       "values": values}},
-            self.objectives, window_s=window,
+            {self.metric: {"kind": "histogram", "values": values}},
+            self.objectives, window_s=window, metric=self.metric,
         )
         publish_slo_gauges(report, self.registry)
         return report

@@ -42,6 +42,7 @@ tools/check_report.py):
 from __future__ import annotations
 
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 from ..utils.progress import _iso_now
@@ -351,6 +352,15 @@ def span_at(name: str, t_start: float, t_end: float,
     sp.t_end = max(float(t_start), float(t_end))
     sp.ts = _iso_now(-(time.perf_counter() - sp.t_start) * 1000.0)
     return sp
+
+
+def new_span_id() -> str:
+    """Fresh 12-hex id — the shared grammar for generated request ids
+    AND span ids (`^[A-Za-z0-9._-]{1,64}$` accepts it), so the round-15
+    replaced-never-rejected policy has one generator for both the
+    `X-Request-Id` and `X-Parent-Span` headers (serving/daemon.py,
+    serving/router.py)."""
+    return uuid.uuid4().hex[:12]
 
 
 NULL_TRACER = Tracer(enabled=False)

@@ -1,0 +1,312 @@
+#!/usr/bin/env python
+"""Validate a TRACE_r22.json fleet-trace-fabric artifact (round 22).
+
+The cross-process tracing acceptance bar, held by arithmetic: the
+committed record must show
+
+  * a ROUTED request whose router-side and replica-side records were
+    JOINED into one waterfall by the forwarded `X-Parent-Span`
+    context, with `critical_path_coverage` >= 0.95 of the
+    router-observed wall attributed to NAMED spans — re-derived here
+    as attributed/total, with `unattributed_ms` the honest remainder
+    (>= 0, never imputed onto neighbors);
+  * a RETRY arm (a draining replica's 503 re-routed once) whose retry
+    cost appears as a named `proxy_attempt` row in the same waterfall,
+    and whose span-side retry count RECONCILES exactly with the
+    router's `ia_route_retries_total` counter — a traced retry the
+    metrics don't know about (or vice versa) means one of the two
+    fabrics is lying;
+  * a MIGRATION arm: `drain_replica` moved at least one pinned
+    session, its wall landed in `ia_route_migration_ms`, the
+    `sessions_adopt` span is present, and the session's next frame
+    routed to the adoption target;
+  * router tracing overhead < 2% of the request wall, measured
+    min-paired-delta between a traced and an untraced router over the
+    same fleet (the round-12/15/16/19 overhead discipline), published
+    as the `ia_route_trace_overhead_frac` gauge the sentinel watches;
+  * an honest clock model: `skew_bound_ms` is reported (>= 0) and the
+    per-process phase sums never exceed that process's own total —
+    walls are never mixed across clocks.
+
+Usage:
+    python tools/check_fleet_trace.py TRACE_r22.json
+
+Runs under pytest too (tests/test_fleet_trace.py validates the
+COMMITTED artifact) so tier-1 fails if the record is missing,
+truncated, or any trace claim stops reproducing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+TRACE_SCHEMA_VERSION = 1
+MIN_CRITICAL_PATH_COVERAGE = 0.95
+MAX_TRACE_OVERHEAD_FRAC = 0.02
+MIN_OVERHEAD_PAIRS = 4
+_REL = 1e-6
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _pos(v) -> bool:
+    return _num(v) and v > 0
+
+
+def _close(a, b, rel: float = _REL) -> bool:
+    return abs(a - b) <= rel * max(abs(a), abs(b), 1.0)
+
+
+def _validate_joined(joined, name: str, errs: List[str],
+                     require_coverage: bool = True) -> None:
+    """One joined fleet-trace record (serving/fleettrace.py
+    `join_fleet_trace` output): schema, re-derived attribution
+    arithmetic, honest skew + gap."""
+    if not isinstance(joined, dict):
+        errs.append(f"{name}: missing or not an object")
+        return
+    if joined.get("kind") != "fleet_trace":
+        errs.append(f"{name}.kind: {joined.get('kind')!r} != "
+                    "'fleet_trace'")
+    router = joined.get("router")
+    if not isinstance(router, dict):
+        errs.append(f"{name}.router: missing router record")
+        return
+    total = router.get("total_ms")
+    attributed = joined.get("attributed_ms")
+    unattributed = joined.get("unattributed_ms")
+    coverage = joined.get("critical_path_coverage")
+    if not _pos(total):
+        errs.append(f"{name}.router.total_ms: not positive "
+                    f"({total!r})")
+        return
+    if not _num(attributed) or attributed < 0:
+        errs.append(f"{name}.attributed_ms: {attributed!r}")
+        return
+    if attributed > total * (1.0 + _REL):
+        errs.append(
+            f"{name}.attributed_ms {attributed} exceeds the router-"
+            f"observed total {total} (attribution must be clipped, "
+            "never invented)"
+        )
+    if not _num(unattributed) or unattributed < 0:
+        errs.append(
+            f"{name}.unattributed_ms: {unattributed!r} (the gap is "
+            "reported >= 0, never imputed)"
+        )
+    elif not _close(unattributed, max(0.0, total - attributed),
+                    rel=1e-3):
+        errs.append(
+            f"{name}.unattributed_ms {unattributed} != total - "
+            f"attributed ({total} - {attributed})"
+        )
+    if not _num(coverage):
+        errs.append(f"{name}.critical_path_coverage: {coverage!r}")
+    else:
+        if not _close(coverage, attributed / total, rel=1e-3):
+            errs.append(
+                f"{name}.critical_path_coverage {coverage} != "
+                f"attributed/total ({attributed}/{total})"
+            )
+        if require_coverage and coverage < MIN_CRITICAL_PATH_COVERAGE:
+            errs.append(
+                f"{name}.critical_path_coverage {coverage} < "
+                f"{MIN_CRITICAL_PATH_COVERAGE} — the fleet waterfall "
+                "leaves too much of the router-observed wall "
+                "unattributed"
+            )
+    skew = joined.get("skew_bound_ms")
+    if not _num(skew) or skew < 0:
+        errs.append(f"{name}.skew_bound_ms: {skew!r} (the clock-skew "
+                    "bound must be reported, >= 0)")
+    rows = joined.get("rows")
+    if not isinstance(rows, list) or not rows:
+        errs.append(f"{name}.rows: empty waterfall")
+        return
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict) or not _num(row.get("wall_ms")) \
+                or row.get("wall_ms") < 0:
+            errs.append(f"{name}.rows[{i}]: malformed row {row!r}")
+            return
+    # Per-process honesty: each process's own phase walls must fit in
+    # its own observed total (walls are never mixed across clocks).
+    replica_sum = sum(
+        r["wall_ms"] for r in rows if r.get("process") != "router"
+    )
+    if replica_sum and not any(
+        isinstance(rep, dict) and rep.get("joined")
+        for rep in joined.get("replicas") or []
+    ):
+        errs.append(f"{name}: replica rows present but no replica "
+                    "record marked joined")
+    procs = {r.get("process") for r in rows}
+    if require_coverage and procs == {"router"}:
+        errs.append(
+            f"{name}.rows: router-only waterfall — no replica phases "
+            "nested (the join never happened)"
+        )
+
+
+def validate_fleet_trace(record) -> List[str]:
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if record.get("schema_version") != TRACE_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {record.get('schema_version')!r} != "
+            f"{TRACE_SCHEMA_VERSION}"
+        )
+    if record.get("kind") != "fleet_trace_load":
+        errs.append(f"kind {record.get('kind')!r} != "
+                    "'fleet_trace_load'")
+
+    # -- main arm: the >= 95% attribution gate --------------------
+    main_arm = record.get("main")
+    if not isinstance(main_arm, dict):
+        errs.append("main: missing routed-request arm")
+    else:
+        if main_arm.get("http_status") != 200:
+            errs.append(f"main.http_status "
+                        f"{main_arm.get('http_status')!r} != 200")
+        _validate_joined(main_arm.get("joined"), "main.joined", errs)
+
+    # -- retry arm: named retry span + counter reconciliation -----
+    retry = record.get("retry")
+    if not isinstance(retry, dict):
+        errs.append("retry: missing retry arm")
+    else:
+        if not (_num(retry.get("retries")) and retry["retries"] >= 1):
+            errs.append(f"retry.retries {retry.get('retries')!r}: the "
+                        "retry arm never retried")
+        if retry.get("http_status") != 200:
+            errs.append(f"retry.http_status "
+                        f"{retry.get('http_status')!r} != 200 (the "
+                        "re-route must have succeeded)")
+        joined = retry.get("joined")
+        _validate_joined(joined, "retry.joined", errs,
+                         require_coverage=False)
+        if isinstance(joined, dict):
+            rows = joined.get("rows") or []
+            if not any(
+                isinstance(r, dict)
+                and str(r.get("phase", "")).startswith("proxy_attempt")
+                and "draining" in str(r.get("phase"))
+                for r in rows
+            ):
+                errs.append(
+                    "retry.joined.rows: no proxy_attempt[draining...] "
+                    "row — the retry cost is not a named span"
+                )
+            if not _close(float(joined.get("retry_ms") or 0.0),
+                          float(retry.get("retry_ms") or -1.0),
+                          rel=1e-3):
+                errs.append(
+                    f"retry.retry_ms {retry.get('retry_ms')!r} != "
+                    f"joined.retry_ms {joined.get('retry_ms')!r}"
+                )
+
+    rec = record.get("reconciliation")
+    if not isinstance(rec, dict):
+        errs.append("reconciliation: missing")
+    else:
+        counter = rec.get("counter_retries_total")
+        spans = rec.get("span_retry_attempts")
+        if not _num(counter) or not _num(spans):
+            errs.append(
+                f"reconciliation: non-numeric cells ({counter!r}, "
+                f"{spans!r})"
+            )
+        elif counter != spans:
+            errs.append(
+                f"reconciliation: ia_route_retries_total {counter} != "
+                f"{spans} retry-reason proxy_attempt entries in the "
+                "access log — the span fabric and the metrics fabric "
+                "disagree"
+            )
+
+    # -- migration arm --------------------------------------------
+    mig = record.get("migration")
+    if not isinstance(mig, dict):
+        errs.append("migration: missing drain-migration arm")
+    else:
+        if not _pos(mig.get("migration_ms")):
+            errs.append(f"migration.migration_ms "
+                        f"{mig.get('migration_ms')!r}: not positive")
+        if not (_num(mig.get("sessions")) and mig["sessions"] >= 1):
+            errs.append(f"migration.sessions {mig.get('sessions')!r}: "
+                        "no session migrated")
+        spans = mig.get("spans")
+        if not isinstance(spans, list) or \
+                "sessions_adopt" not in spans:
+            errs.append(
+                f"migration.spans {spans!r}: no sessions_adopt span — "
+                "the adopt hop is invisible in the trace fabric"
+            )
+        if mig.get("post_migration_routed_to") != mig.get("target"):
+            errs.append(
+                "migration: the migrated session's next frame routed "
+                f"to {mig.get('post_migration_routed_to')!r}, not the "
+                f"adoption target {mig.get('target')!r}"
+            )
+
+    # -- overhead -------------------------------------------------
+    ovh = record.get("overhead")
+    if not isinstance(ovh, dict):
+        errs.append("overhead: missing")
+    else:
+        frac = ovh.get("frac")
+        if not _num(frac) or frac < 0:
+            errs.append(f"overhead.frac: {frac!r}")
+        elif frac >= MAX_TRACE_OVERHEAD_FRAC:
+            errs.append(
+                f"overhead.frac {frac} >= {MAX_TRACE_OVERHEAD_FRAC} — "
+                "router tracing is not within the telemetry budget"
+            )
+        pairs = ovh.get("pairs")
+        if not _num(pairs) or pairs < MIN_OVERHEAD_PAIRS:
+            errs.append(f"overhead.pairs {pairs!r} < "
+                        f"{MIN_OVERHEAD_PAIRS}")
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record", help="path to TRACE_r22.json")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.record) as f:
+            record = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"check_fleet_trace: cannot read {args.record}: {e}")
+        return 2
+    errs = validate_fleet_trace(record)
+    if errs:
+        print(f"check_fleet_trace: {args.record} INVALID:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    main_j = record["main"]["joined"]
+    print(
+        f"check_fleet_trace: {args.record} OK (coverage "
+        f"{main_j['critical_path_coverage']}, skew bound "
+        f"{main_j['skew_bound_ms']} ms, retries "
+        f"{record['retry']['retries']}, migration "
+        f"{record['migration']['migration_ms']} ms, overhead "
+        f"{record['overhead']['frac']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

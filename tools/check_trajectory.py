@@ -68,6 +68,7 @@ _SERVE_PERSIST_RE = re.compile(r"^SERVE_r(\d+)\.json$")
 _OBS_RE = re.compile(r"^OBS_r(\d+)\.json$")
 _LATTICE_RE = re.compile(r"^LATTICE_r(\d+)\.json$")
 _ROUTER_RE = re.compile(r"^ROUTER_r(\d+)\.json$")
+_TRACE_RE = re.compile(r"^TRACE_r(\d+)\.json$")
 
 PROVENANCES = ("measured", "carried", "modeled")
 
@@ -247,6 +248,31 @@ ROUTER_SERIES: Tuple[Dict, ...] = (
               "warm p99 (shared warm tier)"},
 )
 
+# TRACE artifacts (round 22: tools/serve_load.py --trace-out) carry
+# the fleet-trace-fabric headlines: how much of the router-observed
+# wall the joined cross-process waterfall attributes to NAMED spans
+# (hard floor 0.95 — the acceptance bar; below it the join is leaving
+# real work invisible), and the router tracing overhead measured
+# min-paired-delta between a traced and an untraced router (hard
+# ceiling 0.02 — the same telemetry budget the sentinel watches via
+# `ia_route_trace_overhead_frac`).  Both trends are held loosely
+# (rel_tol 1.0; overhead also abs_tol 0.01 because min-paired-delta
+# clamps to 0.0 when the paired arms tie, and a literal-zero best
+# would make ANY later positive measurement a "regression"); the
+# hard bounds are the real gates and check_fleet_trace enforces them
+# per record — this table tracks the trend AND re-states the bounds
+# so a future checker edit cannot silently drop them from history.
+TRACE_SERIES: Tuple[Dict, ...] = (
+    {"field": "critical_path_coverage", "direction": "higher",
+     "rel_tol": 1.0, "floor": 0.95, "since": 22,
+     "label": "fleet waterfall critical-path coverage "
+              "(attributed/total over the router-observed wall)"},
+    {"field": "router_trace_overhead_frac", "direction": "lower",
+     "rel_tol": 1.0, "abs_tol": 0.01, "ceiling": 0.02, "since": 22,
+     "label": "router trace-fabric overhead fraction "
+              "(min-paired-delta, traced vs bare router)"},
+)
+
 # SCALE rows are keyed by size; each series is tracked per size.
 SCALE_SERIES: Tuple[Dict, ...] = (
     {"field": "wall_s", "direction": "lower", "rel_tol": 0.10,
@@ -364,7 +390,7 @@ def _flatten_serve_persist(rec):
 
 def load_history(root: str):
     """(bench, scale, video, slo, chaos_serve, mesh2d, serve_persist,
-    obs, lattice, router) lists of
+    obs, lattice, router, trace) lists of
     (round, filename, payload), round-sorted.  BENCH payloads unwrap the driver's capture wrapper
     to the parsed record.  Builder probe files (BENCH_r*_builder*.json)
     do not match the round pattern and are deliberately out of scope —
@@ -380,6 +406,7 @@ def load_history(root: str):
     obs = []
     lattice = []
     router = []
+    trace = []
     for name in sorted(os.listdir(root)):
         m = _BENCH_RE.match(name)
         if m:
@@ -436,6 +463,10 @@ def load_history(root: str):
         if m:
             with open(os.path.join(root, name)) as f:
                 router.append((int(m.group(1)), name, json.load(f)))
+        m = _TRACE_RE.match(name)
+        if m:
+            with open(os.path.join(root, name)) as f:
+                trace.append((int(m.group(1)), name, json.load(f)))
     bench.sort(key=lambda t: t[0])
     scale.sort(key=lambda t: t[0])
     video.sort(key=lambda t: t[0])
@@ -446,8 +477,9 @@ def load_history(root: str):
     obs.sort(key=lambda t: t[0])
     lattice.sort(key=lambda t: t[0])
     router.sort(key=lambda t: t[0])
+    trace.sort(key=lambda t: t[0])
     return (bench, scale, video, slo, chaos_serve, mesh2d,
-            serve_persist, obs, lattice, router)
+            serve_persist, obs, lattice, router, trace)
 
 
 # ------------------------------------------------------ schema (by era)
@@ -679,7 +711,7 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
     """All schema + trajectory checks over the committed history.
     Returns (violations, machine-readable report rows)."""
     (bench, scale, video, slo, chaos_serve, mesh2d,
-     serve_persist, obs, lattice, router) = load_history(root)
+     serve_persist, obs, lattice, router, trace) = load_history(root)
     errs: List[str] = []
     report: List[Dict] = []
 
@@ -742,6 +774,17 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
 
         errs.extend(f"{name}: {e}" for e in validate_router(rec))
 
+    for rnd, name, rec in trace:
+        # Fleet-trace artifacts carry their full contract — the
+        # re-derived attribution arithmetic, retry reconciliation,
+        # migration spans and the overhead budget — in
+        # check_fleet_trace.
+        from check_fleet_trace import validate_fleet_trace
+
+        errs.extend(
+            f"{name}: {e}" for e in validate_fleet_trace(rec)
+        )
+
     for decl in BENCH_SERIES:
         check_series(
             decl, [(r, n, rec) for r, n, rec in bench],
@@ -794,6 +837,20 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
                 .get("warm_p99_ratio"),
             }) for r, n, rec in router],
             f"router.{decl['field']}", errs, report,
+        )
+    for decl in TRACE_SERIES:
+        # Coverage lives under the gated main arm's joined record;
+        # the overhead fraction under overhead — flatten both.
+        check_series(
+            decl,
+            [(r, n, {
+                "critical_path_coverage":
+                    ((rec.get("main") or {}).get("joined") or {})
+                    .get("critical_path_coverage"),
+                "router_trace_overhead_frac":
+                    (rec.get("overhead") or {}).get("frac"),
+            }) for r, n, rec in trace],
+            f"trace.{decl['field']}", errs, report,
         )
     def _rows(data):
         rows = data.get("rows") if isinstance(data, dict) else None

@@ -84,6 +84,7 @@ from typing import List, Optional, Tuple
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from check_fleet_trace import validate_fleet_trace  # noqa: E402
 from check_lattice import validate_lattice  # noqa: E402
 from check_obs import validate_obs  # noqa: E402
 from check_router import validate_router  # noqa: E402
@@ -1420,6 +1421,314 @@ def run_router(args) -> dict:
     }
 
 
+def run_fleet_trace(args) -> dict:
+    """Round-22 fleet-trace arm (`--trace-out`): two `ia-synth serve`
+    SUBPROCESS replicas (per-replica state dirs, shared warm tier)
+    behind an in-process TRACED FleetRouter (span tracer + flight ring
+    + router access log), exercised through every arm the trace fabric
+    claims:
+
+      - MAIN: the first routed request (cold compile — real named
+        work) fetched back over HTTP via the discovery file
+        (`fetch_fleet_trace`, the exact `ia-synth trace --fleet`
+        path) and joined into one waterfall; the committed
+        `critical_path_coverage` must re-derive >= 0.95.
+      - WARM: a warm repeat's joined trace, committed for reference
+        (structure-validated, not coverage-gated: a ~15 ms request's
+        HTTP framing is honestly reported as gap, not hidden).
+      - RETRY: r1 is drained AT THE DAEMON (the router's poller is
+        parked, so the router still believes it live), a pinned
+        session's next frame hits the draining 503 and re-routes —
+        the retry cost becomes a named proxy_attempt span, and the
+        access log's retry-reason entries must reconcile EXACTLY with
+        `ia_route_retries_total`.
+      - MIGRATION: `drain_replica` migrates the remaining pinned
+        session to the survivor; the drain_migration span tree and
+        `ia_route_migration_ms` make the move visible, and the
+        session's next frame must route to the adoption target.
+      - OVERHEAD: min-paired-delta between this traced router and an
+        untraced one over the same fleet, published as the
+        `ia_route_trace_overhead_frac` gauge the sentinel watches;
+        the committed fraction must stay under 2%.
+    """
+    import chaos_serve
+    from image_analogies_tpu.serving.fleettrace import (
+        fetch_fleet_trace,
+        join_fleet_trace,
+    )
+    from image_analogies_tpu.serving.router import FleetRouter
+    from image_analogies_tpu.telemetry.anomaly import fleet_watches
+    from image_analogies_tpu.telemetry.flight import FlightRecorder
+    from image_analogies_tpu.telemetry.metrics import MetricsRegistry
+    from image_analogies_tpu.telemetry.spans import Tracer
+    from image_analogies_tpu.utils.io import save_image
+
+    size = args.size
+    a, ap_img, b = _make_inputs(args.seed, size)
+    asset_dir = tempfile.mkdtemp(prefix="ia_trace_assets_")
+    warm = tempfile.mkdtemp(prefix="ia_trace_warm_")
+    states = [tempfile.mkdtemp(prefix=f"ia_trace_s{i}_")
+              for i in range(2)]
+    traces = [tempfile.mkdtemp(prefix=f"ia_trace_t{i}_")
+              for i in range(2)]
+    router_dir = tempfile.mkdtemp(prefix="ia_trace_router_")
+    a_path = os.path.join(asset_dir, "a.png")
+    ap_path = os.path.join(asset_dir, "ap.png")
+    save_image(a_path, a)
+    save_image(ap_path, ap_img)
+    body = _frame_body(b)
+    policy = ("--warm-dir", warm)
+
+    reg = MetricsRegistry()
+    tracer = Tracer(registry=reg)
+    flight = FlightRecorder(
+        tracer, reg,
+        path=os.path.join(router_dir, "flight.json"), capacity=2048,
+    )
+    tracer.add_observer(flight.observe)
+    discovery_path = os.path.join(router_dir, "discovery.json")
+    # poll_interval_s is parked high on BOTH routers: the retry arm
+    # depends on the router's view of r1 going stale between the
+    # daemon-level drain and the pinned pick.
+    router = FleetRouter(
+        reg, tracer=tracer, poll_interval_s=60.0, flight=flight,
+        discovery_path=discovery_path,
+        access_log_path=os.path.join(router_dir, "access.jsonl"),
+    )
+    bare = FleetRouter(MetricsRegistry(), poll_interval_s=60.0)
+    procs = []
+    try:
+        router.start()
+        bare.start()
+        for i in range(2):
+            p, u = chaos_serve._spawn_serve(
+                a_path, ap_path, traces[i], state_dir=states[i],
+                extra=policy,
+            )
+            procs.append(p)
+            router.add_replica(u, name=f"r{i}")
+            bare.add_replica(u, name=f"r{i}")
+
+        # ---- MAIN arm: the first routed request (cold compile).
+        main_rid = "r22-main"
+        t0 = time.perf_counter()
+        code, doc, hdrs = chaos_serve._post(router.url, body,
+                                            rid=main_rid)
+        main_wall_ms = (time.perf_counter() - t0) * 1000.0
+        if code != 200:
+            raise RuntimeError(
+                f"main arm: {code} ({doc.get('error')})"
+            )
+        if doc.get("request_id") != main_rid:
+            raise RuntimeError(
+                f"main arm: request_id {doc.get('request_id')!r} not "
+                "echoed"
+            )
+        main_replica = hdrs.get("X-Routed-To")
+
+        # Warm the OTHER replica (shared warm tier: a disk restore,
+        # not a second compile) so every later arm runs warm.
+        other = next(u for n, u in
+                     [(h["name"], h["url"]) for h in router.replicas()]
+                     if n != main_replica)
+        code, doc, _h = chaos_serve._post(other, body)
+        if code != 200:
+            raise RuntimeError(f"warm other replica: {code}")
+
+        # ---- WARM joined trace (reference, not coverage-gated).
+        warm_rid = "r22-warm"
+        code, doc, _h = chaos_serve._post(router.url, body,
+                                          rid=warm_rid)
+        if code != 200:
+            raise RuntimeError(f"warm arm: {code}")
+
+        # ---- OVERHEAD arm: traced vs bare router, min-paired-delta.
+        gc.collect()
+        gc.disable()
+        bases, deltas = [], []
+        for _ in range(8):
+            t0 = time.perf_counter()
+            chaos_serve._post(bare.url, body)
+            base = (time.perf_counter() - t0) * 1000.0
+            t0 = time.perf_counter()
+            chaos_serve._post(router.url, body)
+            traced_ms = (time.perf_counter() - t0) * 1000.0
+            bases.append(base)
+            deltas.append(traced_ms - base)
+        gc.enable()
+        overhead_frac = max(0.0, min(deltas) / statistics.median(bases))
+        reg.gauge(
+            "ia_route_trace_overhead_frac",
+            "measured router trace-fabric (span tree + access-log "
+            "write) request-path overhead fraction",
+        ).set(round(overhead_frac, 4))
+
+        # ---- sessions: pin retry + migration sessions to r1 and
+        # serve them there so r1's state dir holds real session state.
+        victim = "r1" if main_replica != "r1" else "r0"
+        survivor = "r0" if victim == "r1" else "r1"
+        with router._lock:
+            router._affinity["r22-retry"] = victim
+            router._affinity["r22-mig"] = victim
+        for sid in ("r22-retry", "r22-mig"):
+            code, _d, hdrs = chaos_serve._post(
+                router.url, chaos_serve._session_body(b, sid)
+            )
+            if code != 200 or hdrs.get("X-Routed-To") != victim:
+                raise RuntimeError(
+                    f"session {sid}: {code} routed to "
+                    f"{hdrs.get('X-Routed-To')!r}, wanted {victim!r}"
+                )
+
+        # ---- RETRY arm: drain the victim AT THE DAEMON (router's
+        # poller is parked, so its table is stale), then post the
+        # pinned session's next frame — draining 503, one re-route.
+        victim_url = next(h["url"] for h in router.replicas()
+                          if h["name"] == victim)
+        urllib.request.urlopen(urllib.request.Request(
+            victim_url + "/drain", data=b"{}", method="POST",
+            headers={"Content-Type": "application/json"},
+        ), timeout=60.0).read()
+        retry_rid = "r22-retry-1"
+        code, doc, hdrs = chaos_serve._post(
+            router.url, chaos_serve._session_body(b, "r22-retry"),
+            rid=retry_rid,
+        )
+        if code != 200 or hdrs.get("X-Routed-To") != survivor:
+            raise RuntimeError(
+                f"retry arm: {code} routed to "
+                f"{hdrs.get('X-Routed-To')!r}, wanted {survivor!r}"
+            )
+
+        # ---- MIGRATION arm: drain_replica migrates r22-mig to the
+        # survivor; its next frame must follow the adoption.
+        mig_report = router.drain_replica(victim, wait_s=60.0)
+        if "r22-mig" not in mig_report.get("sessions_migrated", []):
+            raise RuntimeError(
+                f"migration arm: r22-mig not migrated ({mig_report})"
+            )
+        code, _d, hdrs = chaos_serve._post(
+            router.url, chaos_serve._session_body(b, "r22-mig")
+        )
+        if code != 200:
+            raise RuntimeError(f"post-migration frame: {code}")
+        post_mig_routed = hdrs.get("X-Routed-To")
+        mig_span_names = sorted({
+            ev.get("name") for ev in flight.to_dict().get("events", [])
+            if ev.get("kind") == "open" and ev.get("name") in (
+                "drain_migration", "drain_wait", "sessions_adopt",
+                "repin",
+            )
+        })
+
+        # ---- fetch + join over HTTP: the ia-synth trace --fleet path.
+        with open(discovery_path) as f:
+            discovery = json.load(f)
+
+        def joined_for(rid):
+            fetched = fetch_fleet_trace(discovery, rid)
+            router_doc = fetched.get("router") or {}
+            reps = [r["doc"]["request"]
+                    for r in fetched.get("replicas") or []
+                    if (r.get("doc") or {}).get("request")]
+            return join_fleet_trace(
+                (router_doc.get("request") if router_doc else None),
+                reps, rid,
+            ), fetched.get("errors") or []
+
+        main_joined, main_errors = joined_for(main_rid)
+        warm_joined, _warm_errors = joined_for(warm_rid)
+        retry_joined, _retry_errors = joined_for(retry_rid)
+
+        # ---- reconciliation: metrics fabric vs span fabric.
+        from image_analogies_tpu.serving.accesslog import read_entries
+
+        counter_retries = _counter_total(
+            reg.to_dict(), "ia_route_retries_total"
+        )
+        span_retries = sum(
+            1
+            for entry in read_entries(router.access.path)
+            for att in (entry.get("attempts") or [])
+            if isinstance(att, dict) and att.get("retry_reason")
+        )
+        anomalies = fleet_watches(router.replicas(), reg)
+        snap = reg.to_dict()
+        record = {
+            "schema_version": 1,
+            "kind": "fleet_trace_load",
+            "round": 22,
+            "generated_by": "tools/serve_load.py --trace-out",
+            "proxy_size": size,
+            "config": {
+                "levels": 2, "matcher": "patchmatch", "em_iters": 1,
+                "pm_iters": 2, "replicas": 2,
+                "shared_warm_dir": True,
+            },
+            "main": {
+                "request_id": main_rid,
+                "http_status": 200,
+                "replica": main_replica,
+                "client_wall_ms": round(main_wall_ms, 3),
+                "fetch_errors": main_errors,
+                "joined": main_joined,
+            },
+            "warm": {
+                "request_id": warm_rid,
+                "joined": warm_joined,
+            },
+            "retry": {
+                "request_id": retry_rid,
+                "http_status": 200,
+                "retries": retry_joined.get("retries"),
+                "retry_ms": retry_joined.get("retry_ms"),
+                "routed_to": survivor,
+                "joined": retry_joined,
+            },
+            "migration": {
+                "replica": victim,
+                "target": mig_report.get("migrated_to"),
+                "migration_ms": mig_report.get("migration_ms"),
+                "sessions": len(mig_report.get("sessions_migrated")
+                                or []),
+                "spans": mig_span_names,
+                "post_migration_routed_to": post_mig_routed,
+            },
+            "overhead": {
+                "pairs": len(bases),
+                "base_median_ms": round(statistics.median(bases), 3),
+                "min_delta_ms": round(min(deltas), 3),
+                "frac": round(overhead_frac, 4),
+            },
+            "reconciliation": {
+                "counter_retries_total": counter_retries,
+                "span_retry_attempts": span_retries,
+            },
+            "router_metrics": {
+                "requests": _counter_total(
+                    snap, "ia_route_requests_total"
+                ),
+                "retries_total": counter_retries,
+                "unrouted_total": _counter_total(
+                    snap, "ia_route_unrouted_total"
+                ),
+            },
+            "anomalies": {
+                "verdict": anomalies.get("verdict"),
+                "firing": anomalies.get("firing"),
+            },
+        }
+        return record
+    finally:
+        gc.enable()
+        router.stop()
+        bare.stop()
+        for p in procs:
+            chaos_serve._reap(p)
+        for d in (asset_dir, warm, router_dir, *states, *traces):
+            shutil.rmtree(d, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
@@ -1449,6 +1758,14 @@ def main(argv=None) -> int:
                     "weak-scaling throughput, mid-burst replica add, "
                     "session affinity, embedded chaos replica-kill "
                     "arm)")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write a TRACE_r22.json fleet-trace-fabric "
+                    "artifact (round 22; two subprocess replicas "
+                    "behind a traced in-process router: joined cross-"
+                    "process waterfall >= 95%% attributed, named retry "
+                    "span reconciled with ia_route_retries_total, "
+                    "visible drain migration, min-paired-delta trace "
+                    "overhead)")
     ap.add_argument("--lattice-spec", default="16:36",
                     metavar="SPEC",
                     help="lattice spec for the round-20 arm "
@@ -1489,9 +1806,10 @@ def main(argv=None) -> int:
         return run_persist_phase(args)
 
     if not (args.out or args.persist_out or args.obs_out
-            or args.lattice_out or args.router_out):
+            or args.lattice_out or args.router_out or args.trace_out):
         print("serve_load: need at least one of --out / --persist-out "
-              "/ --obs-out / --lattice-out / --router-out")
+              "/ --obs-out / --lattice-out / --router-out / "
+              "--trace-out")
         return 1
 
     if args.out:
@@ -1585,6 +1903,25 @@ def main(argv=None) -> int:
             f"{router_record['warm_start']['warm_p99_ratio']:.2f}, "
             "chaos acked_loss "
             f"{router_record['chaos']['acked_loss']})"
+        )
+
+    if args.trace_out:
+        trace_record = run_fleet_trace(args)
+        terrs = validate_fleet_trace(trace_record)
+        if terrs:
+            print("serve_load: generated fleet-trace record INVALID:")
+            for e in terrs:
+                print(f"  - {e}")
+            return 1
+        _write_json(args.trace_out, trace_record)
+        mj = trace_record["main"]["joined"]
+        print(
+            f"serve_load: wrote {args.trace_out} (coverage "
+            f"{mj['critical_path_coverage']}, skew bound "
+            f"{mj['skew_bound_ms']} ms, retries "
+            f"{trace_record['retry']['retries']}, migration "
+            f"{trace_record['migration']['migration_ms']} ms, "
+            f"overhead {trace_record['overhead']['frac']})"
         )
 
     if args.obs_out:
