@@ -873,6 +873,8 @@ def cmd_serve(args) -> int:
             obs_capacity=args.obs_capacity,
             anomaly_config=anomaly_config,
             lattice=lattice_plan,
+            archive_dir=args.archive_dir,
+            archive_interval_s=args.archive_interval_s,
         )
         try:
             daemon.start()
@@ -914,7 +916,8 @@ def cmd_serve(args) -> int:
             print(
                 f"serving on {daemon.url} (POST /synthesize /drain; "
                 "GET /serving /slo /journal /obs/window /request "
-                "/metrics /metrics.json /healthz /progress)",
+                "/incidents /archive /metrics /metrics.json /healthz "
+                "/progress)",
                 flush=True,
             )
             while not daemon.drained.wait(1.0):
@@ -1230,6 +1233,255 @@ def cmd_obs(args) -> int:
     return 0
 
 
+def cmd_history(args) -> int:
+    """Restart-lineage view over a durable telemetry archive (round
+    23, telemetry/archive.py): group the archived snapshots by boot,
+    summarize each boot's window (obs generation span, SLO verdict,
+    latency p99, the anomaly baseline it graded against), diff
+    consecutive boots, and list the incidents captured along the way.
+    With --targets, each live endpoint is probed too — an endpoint
+    that is down while its archive is present renders FROM THE
+    ARCHIVE with an explicit degraded-fleet warning, never a silent
+    drop.  Exits 1 only when the archive itself holds no records."""
+    import json
+    import urllib.error
+    import urllib.request
+    from collections import OrderedDict
+
+    from .telemetry.archive import list_incidents, read_archive_entries
+
+    boots = OrderedDict()
+    records = 0
+    for rec in read_archive_entries(args.archive_dir):
+        records += 1
+        bid = rec.get("boot_id")
+        if not isinstance(bid, str):
+            continue
+        boot = boots.setdefault(bid, {
+            "boot_id": bid, "first_ts": rec.get("ts"),
+            "last_ts": rec.get("ts"), "snapshots": 0,
+            "incidents": [], "generation": None, "baseline": None,
+            "verdict": None, "p99_ms": None, "final": False,
+        })
+        boot["last_ts"] = rec.get("ts", boot["last_ts"])
+        kind = rec.get("kind")
+        if kind == "snapshot":
+            boot["snapshots"] += 1
+            g = rec.get("obs_generation")
+            if isinstance(g, int):
+                boot["generation"] = g
+            b = rec.get("anomaly_baseline_p99_ms")
+            if isinstance(b, (int, float)):
+                boot["baseline"] = float(b)
+            boot["final"] = bool(rec.get("final"))
+            slo = rec.get("slo") or {}
+            boot["verdict"] = slo.get("verdict", boot["verdict"])
+            lat = next(
+                (o for o in slo.get("objectives", [])
+                 if o.get("kind") == "latency"), None,
+            )
+            if lat and lat.get("observed_p99_ms") is not None:
+                boot["p99_ms"] = float(lat["observed_p99_ms"])
+        elif kind == "incident":
+            boot["incidents"].append(rec.get("id"))
+    warnings = []
+    if getattr(args, "targets", None):
+        from .serving.observatory import parse_targets
+
+        try:
+            targets = parse_targets(args.targets)
+        except ValueError as e:
+            raise SystemExit(f"history: {e}")
+        for t in targets:
+            try:
+                with urllib.request.urlopen(
+                    f"{t}/healthz", timeout=args.timeout
+                ):
+                    pass
+            except (urllib.error.URLError, OSError) as e:
+                warnings.append(
+                    f"target {t} unreachable ({type(e).__name__}: "
+                    f"{e}); history rendered from the archive only"
+                )
+    incidents = list_incidents(args.archive_dir)
+    if args.format == "json":
+        print(json.dumps({
+            "archive_dir": args.archive_dir,
+            "records": records,
+            "boots": list(boots.values()),
+            "incidents": incidents,
+            "warnings": warnings,
+        }, indent=1))
+        return 0 if boots else 1
+    print(
+        f"telemetry history — {args.archive_dir}: "
+        f"{len(boots)} boot(s), {records} record(s), "
+        f"{len(incidents)} incident bundle(s)"
+    )
+    prev = None
+    for boot in boots.values():
+
+        def _ts(v):
+            return (
+                time.strftime("%H:%M:%S", time.gmtime(v))
+                if isinstance(v, (int, float)) else "-"
+            )
+
+        p99 = boot["p99_ms"]
+        base = boot["baseline"]
+        print(
+            f"boot {boot['boot_id']:<22} "
+            f"{_ts(boot['first_ts'])}→{_ts(boot['last_ts'])}  "
+            f"snaps={boot['snapshots']:<4} "
+            f"gen={boot['generation'] if boot['generation'] is not None else '-':<4} "
+            f"verdict={boot['verdict'] or '-':<9} "
+            f"p99={f'{p99:.1f}ms' if p99 is not None else '-':<10} "
+            f"baseline={f'{base:.1f}ms' if base is not None else '-'}"
+            + ("  [drained]" if boot["final"] else "")
+        )
+        for inc in boot["incidents"]:
+            print(f"  incident {inc}")
+        if prev is not None:
+            pp, np_ = prev["p99_ms"], boot["p99_ms"]
+            carried = (
+                prev["baseline"] is not None
+                and boot["baseline"] == prev["baseline"]
+            ) or (
+                prev["p99_ms"] is None and boot["baseline"] is not None
+            )
+            diff = (
+                f"p99 {pp:.1f}→{np_:.1f}ms"
+                if pp is not None and np_ is not None else "p99 -"
+            )
+            print(
+                f"  ↳ restart diff vs {prev['boot_id']}: {diff}, "
+                f"baseline "
+                + ("carried" if boot["baseline"] is not None
+                   else "absent")
+            )
+        prev = boot
+    for warn in warnings:
+        print(f"WARNING (fleet degraded): {warn}")
+    if not boots:
+        print("history: archive holds no records", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_incident(args) -> int:
+    """Render one black-box incident bundle (round 23): the trigger,
+    the config/backend fingerprint, the graded SLO objectives and
+    anomaly watches at capture time, the access-log tail, and the
+    slowest tail request's phase waterfall — from the archive dir on
+    disk, or proxied live from a daemon/router URL."""
+    import json
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from .serving.accesslog import phase_fields
+
+    doc = None
+    if bool(args.url) == bool(args.archive_dir):
+        raise SystemExit(
+            "incident: exactly one of --archive-dir (on disk) or "
+            "--url (live daemon/router) is required"
+        )
+    if args.url:
+        base = args.url.rstrip("/")
+        if not base.startswith(("http://", "https://")):
+            base = "http://" + base
+        q = urllib.parse.quote(args.incident_id, safe="")
+        try:
+            with urllib.request.urlopen(
+                f"{base}/incidents?id={q}", timeout=10.0
+            ) as resp:
+                doc = json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            raise SystemExit(
+                f"incident: {args.incident_id!r}: endpoint answered "
+                f"{e.code}"
+            )
+        except (urllib.error.URLError, OSError) as e:
+            raise SystemExit(f"incident: cannot reach {args.url}: {e}")
+    else:
+        from .telemetry.archive import load_incident
+
+        doc = load_incident(args.archive_dir, args.incident_id)
+        if doc is None:
+            raise SystemExit(
+                f"incident: {args.incident_id!r} not found under "
+                f"{args.archive_dir}/incidents"
+            )
+    if args.format == "json":
+        print(json.dumps(doc, indent=1))
+        return 0
+    trig = doc.get("trigger") or {}
+    print(
+        f"incident {doc.get('id')}  trigger={trig.get('kind')}  "
+        f"ts={doc.get('ts')}"
+    )
+    if trig.get("watches"):
+        print(f"  watches firing: {', '.join(trig['watches'])}")
+    for o in trig.get("objectives") or []:
+        print(
+            f"  objective {o.get('name')}: {o.get('status')} "
+            f"(burn={o.get('burn_rate')})"
+        )
+    fp = doc.get("fingerprint") or {}
+    print(
+        f"  daemon: pid={fp.get('pid')} backend={fp.get('backend')} "
+        f"devices={fp.get('devices')} boot={fp.get('boot_id')}"
+    )
+    slo = doc.get("slo") or {}
+    print(f"  slo verdict at capture: {slo.get('verdict', '-')}")
+    for o in slo.get("objectives") or []:
+        burn = o.get("burn_rate")
+        print(
+            f"    {o.get('name'):<24} {o.get('status'):<10} "
+            f"burn={'-' if burn is None else f'{burn:.4f}'}"
+        )
+    anom = doc.get("anomaly") or {}
+    if anom:
+        print(
+            f"  anomaly verdict: {anom.get('verdict', '-')} "
+            f"(firing: "
+            f"{', '.join(anom.get('firing') or []) or 'none'})"
+        )
+    flight = doc.get("flight") or {}
+    if flight:
+        print(
+            f"  flight: {len(flight.get('events') or [])} span "
+            f"event(s) in ring, flushed_on="
+            f"{flight.get('flushed_on')}"
+        )
+    tail = doc.get("access_tail") or []
+    print(f"  access tail: {len(tail)} request(s)")
+    for rec in tail[-args.tail:]:
+        print(
+            f"    {str(rec.get('request_id')):<24} "
+            f"{str(rec.get('outcome')):<9} "
+            f"http={rec.get('http_status')} "
+            f"total={rec.get('total_ms')}ms"
+        )
+    served = [r for r in tail if r.get("total_ms") is not None]
+    if served:
+        worst = max(served, key=lambda r: float(r["total_ms"]))
+        total_ms = float(worst.get("total_ms") or 0.0)
+        print(
+            f"  slowest tail request {worst.get('request_id')} "
+            f"({total_ms:.3f} ms):"
+        )
+        width = 32
+        for name, ms in phase_fields(worst):
+            frac = ms / total_ms if total_ms > 0 else 0.0
+            bar = ("#" * max(1, int(round(frac * width)))
+                   if ms > 0 else "")
+            print(f"    {name:8s} {ms:10.3f} ms  "
+                  f"{100.0 * frac:5.1f}%  {bar}")
+    return 0
+
+
 def cmd_route(args) -> int:
     """Fleet router (round 21, serving/router.py): spread POST
     /synthesize across N daemon replicas — least outstanding work with
@@ -1516,6 +1768,24 @@ def main(argv=None) -> int:
         "envelope; omitted = the latency watch reports no_data",
     )
     p.add_argument(
+        "--archive-dir", default=None, metavar="DIR",
+        help="durable telemetry archive + black box (round 23): "
+        "observatory windows, SLO state, and anomaly baselines "
+        "persist to DIR as segmented JSONL (atomic sealing, "
+        "torn-tail-tolerant reload), so a restart with the same DIR "
+        "resumes its anomaly watches against pre-restart baselines; "
+        "an SLO fast_burn or firing watch atomically captures a "
+        "self-contained incident bundle under DIR/incidents "
+        "(rate-limited, disk-budgeted; GET /incidents, "
+        "`ia-synth history`, `ia-synth incident <id>`)",
+    )
+    p.add_argument(
+        "--archive-interval-s", type=float, default=30.0, metavar="S",
+        help="archive snapshot cadence (default 30; <= 0 keeps the "
+        "archive open for boot/drain records and incidents but skips "
+        "the periodic snapshots)",
+    )
+    p.add_argument(
         "--flight-ring", type=int, default=None, metavar="N",
         help="flight-recorder event-ring capacity (default: "
         "IA_FLIGHT_RING env or 512; memory scales linearly, "
@@ -1608,6 +1878,62 @@ def main(argv=None) -> int:
     )
     p.add_argument("--format", default="table", choices=["table", "json"])
     p.set_defaults(fn=cmd_obs)
+
+    p = sub.add_parser(
+        "history",
+        help="restart-lineage view over a durable telemetry archive: "
+        "per-boot window summaries, cross-restart diffs, incident "
+        "index (round 23)",
+    )
+    _add_common_flags(p)
+    p.add_argument(
+        "--archive-dir", required=True, metavar="DIR",
+        help="the daemon's --archive-dir (segmented archive.jsonl + "
+        "incidents/ live here)",
+    )
+    p.add_argument(
+        "--targets", default=None, metavar="HOST:PORT,... | FILE",
+        help="optionally probe these live endpoints too (same "
+        "grammar as `ia-synth obs --targets`, discovery files "
+        "included); an endpoint that is down while its archive is "
+        "present renders from the archive with a degraded-fleet "
+        "warning, never a silent drop",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=5.0, metavar="S",
+        help="per-probe HTTP timeout (default 5)",
+    )
+    p.add_argument("--format", default="table", choices=["table", "json"])
+    p.set_defaults(fn=cmd_history)
+
+    p = sub.add_parser(
+        "incident",
+        help="render one black-box incident bundle: trigger, "
+        "fingerprint, SLO/anomaly state at capture, access-log tail "
+        "+ slowest-request waterfall (round 23)",
+    )
+    _add_common_flags(p)
+    p.add_argument(
+        "incident_id",
+        help="the bundle id (from `ia-synth history`, GET "
+        "/incidents, or the incidents/ directory)",
+    )
+    p.add_argument(
+        "--archive-dir", default=None, metavar="DIR",
+        help="read the bundle from DIR/incidents on disk; exactly "
+        "one of --archive-dir/--url",
+    )
+    p.add_argument(
+        "--url", default=None, metavar="URL",
+        help="fetch the bundle live from a daemon or router "
+        "(GET /incidents?id=); exactly one of --archive-dir/--url",
+    )
+    p.add_argument(
+        "--tail", type=int, default=10, metavar="N",
+        help="access-tail rows to print (default 10)",
+    )
+    p.add_argument("--format", default="table", choices=["table", "json"])
+    p.set_defaults(fn=cmd_incident)
 
     p = sub.add_parser("examples", help="generate procedural example assets")
     _add_common_flags(p)

@@ -86,18 +86,22 @@ POINTS = (
     #                    executable-cache epoch eviction
     "serve_diskfull",  # journal write (key = write ordinal): OSError,
     #                    counted-not-raised
+    "archive_crash",   # telemetry-archive append (key = archive write
+    #                    ordinal): half the line hits disk, then the
+    #                    process hard-exits — SIGKILL mid-append
 )
 ACTIONS = ("raise", "hang", "truncate", "fail")
 
 # Serving-plane points: "fail" returns to the caller instead of
 # raising, and only the actions below are grammatical per point.
 SERVE_POINTS = ("serve_crash", "serve_hang", "serve_evict",
-                "serve_diskfull")
+                "serve_diskfull", "archive_crash")
 _SERVE_ACTIONS = {
     "serve_crash": ("fail",),
     "serve_hang": ("hang",),
     "serve_evict": ("fail",),
     "serve_diskfull": ("fail",),
+    "archive_crash": ("fail",),
 }
 
 # Actions that raise out of the injection point (and therefore fail a

@@ -21,10 +21,14 @@ Durability contract:
     A lock serializes writers anyway (rotation needs it), making the
     syscall-level guarantee a backstop, not the mechanism.
   - **Size-capped rotation.**  When the live file would exceed
-    `max_bytes` the writer renames it to `<path>.1` (clobbering the
-    previous rotation — one generation of history, bounded disk) and
-    reopens.  Readers (`read_entries`, the `ia-synth trace` CLI) walk
-    `.1` then the live file, oldest first.
+    `max_bytes` the writer seals it through a numbered shift chain —
+    `.{N-1}→.N … .1→.2`, then live→`.1`, each step one atomic
+    `os.replace`, the oldest generation dropping off the end — keeping
+    `generations` (default 4) files of history so an incident
+    bundle's access-log tail (round 23, telemetry/archive.py) can
+    reach back past one rotation.  Readers (`read_entries`, the
+    `ia-synth trace` CLI) walk `.N … .1` then the live file, oldest
+    first.
   - **Never the hot path's problem.**  `log()` swallows OSError after
     recording it on `self.errors` — a full disk degrades observability,
     not availability.
@@ -38,17 +42,25 @@ import threading
 from typing import Any, Dict, Iterator, List, Optional
 
 DEFAULT_MAX_BYTES = 4 * 1024 * 1024
+DEFAULT_GENERATIONS = 4
 
 
 class AccessLog:
-    """Append-only JSONL writer with size-capped rotation."""
+    """Append-only JSONL writer with size-capped rotation across
+    `generations` numbered history files."""
 
     def __init__(self, path: str,
-                 max_bytes: int = DEFAULT_MAX_BYTES):
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 generations: int = DEFAULT_GENERATIONS):
         if max_bytes < 1024:
             raise ValueError(f"max_bytes too small ({max_bytes})")
+        if generations < 1:
+            raise ValueError(
+                f"generations must be >= 1 ({generations})"
+            )
         self.path = str(path)
         self.max_bytes = int(max_bytes)
+        self.generations = int(generations)
         self.errors = 0
         self._lock = threading.Lock()
         self._fd: Optional[int] = None
@@ -74,8 +86,17 @@ class AccessLog:
                     self._open()
                 if self._size + len(line) > self.max_bytes and self._size:
                     os.close(self._fd)
-                    os.replace(self.path, self.path + ".1")
                     self._fd = None
+                    # Shift chain, oldest first: .{N-1}→.N … .1→.2,
+                    # live→.1.  Each step is one atomic os.replace, so
+                    # a crash mid-shift leaves every line readable in
+                    # SOME generation (possibly duplicated by number,
+                    # never lost or torn).
+                    for i in range(self.generations - 1, 0, -1):
+                        src = f"{self.path}.{i}"
+                        if os.path.exists(src):
+                            os.replace(src, f"{self.path}.{i + 1}")
+                    os.replace(self.path, self.path + ".1")
                     self._open()
                 os.write(self._fd, line)
                 self._size += len(line)
@@ -93,11 +114,19 @@ class AccessLog:
 
 
 def read_entries(path: str) -> Iterator[Dict[str, Any]]:
-    """Yield access-log records oldest-first across the rotation
-    (`<path>.1` then `<path>`), skipping unparseable lines (a crash
-    mid-write loses at most the final line; everything readable still
-    reads)."""
-    for p in (path + ".1", path):
+    """Yield access-log records oldest-first across every rotation
+    generation (`<path>.N … <path>.1` then `<path>`), skipping
+    unparseable lines (a crash mid-write loses at most the final
+    line; everything readable still reads).  The shift chain keeps
+    numbered generations contiguous from 1, so the scan stops at the
+    first gap — single-`.1` writers (the round-16 journal) read
+    exactly as before."""
+    gens = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        gens.append(f"{path}.{i}")
+        i += 1
+    for p in list(reversed(gens)) + [path]:
         if not os.path.exists(p):
             continue
         with open(p, "r", encoding="utf-8") as fh:

@@ -327,6 +327,9 @@ class SynthDaemon:
         obs_capacity: int = 120,
         anomaly_config=None,
         lattice=None,
+        archive_dir: Optional[str] = None,
+        archive_interval_s: float = 30.0,
+        incident_min_interval_s: float = 60.0,
     ):
         from ..parallel.batch import make_mesh
         from ..telemetry.anomaly import AnomalyDetector
@@ -478,6 +481,17 @@ class SynthDaemon:
                 self.obs, registry, config=anomaly_config,
                 max_queue_depth=max_queue_depth,
             )
+        # Round 23 durable telemetry archive + black box (both built
+        # in start(): reload must happen before the first anomaly
+        # evaluation, and the routes close over the live objects).
+        # Interval <= 0 keeps the archive open (boot/drain records,
+        # incidents) but skips the periodic snapshot cadence.
+        self.archive_dir = archive_dir
+        self.archive = None
+        self.incidents = None
+        self._archive_interval_s = float(archive_interval_s)
+        self._incident_min_interval_s = float(incident_min_interval_s)
+        self._archive_last_t = -float("inf")
         self._dispatch_seq = 0  # client-dispatch ordinal (fault keys)
         # request_id -> {"sha256", "shape"} for replayed requests; the
         # chaos harness reads it from GET /journal to assert replay
@@ -644,6 +658,44 @@ class SynthDaemon:
                 self._access_log_path
                 or os.path.join(self._work_dir, "access.jsonl")
             )
+        if self.archive_dir is not None:
+            # Durable telemetry archive (round 23): reload BEFORE the
+            # sampler starts — the first anomaly evaluation of this
+            # boot must already grade against the pre-restart baseline
+            # and the ring generation must already sit past every
+            # archived window's stamp.
+            from ..telemetry.archive import (
+                IncidentStore,
+                TelemetryArchive,
+            )
+
+            self.archive = TelemetryArchive(
+                self.archive_dir, registry=self.registry
+            )
+            self.incidents = IncidentStore(
+                self.archive_dir, registry=self.registry,
+                min_interval_s=self._incident_min_interval_s,
+            )
+            resumed = self.archive.resumed
+            if (self.obs is not None
+                    and resumed.get("generation") is not None):
+                self.obs.seed_generation(
+                    int(resumed["generation"]) + 1
+                )
+            if (self.anomaly is not None
+                    and resumed.get("baseline_p99_ms") is not None
+                    and self.anomaly.config.baseline_p99_ms is None):
+                # The operator gave no --baseline: the archived one
+                # (what the PREVIOUS boot graded against) carries
+                # over, so the latency watch never cold-starts to
+                # no_data across a restart.  An explicit --baseline
+                # always wins.
+                import dataclasses as _dc
+
+                self.anomaly.config = _dc.replace(
+                    self.anomaly.config,
+                    baseline_p99_ms=float(resumed["baseline_p99_ms"]),
+                )
         self.live = LiveTelemetryServer(
             self.tracer,
             self.registry,
@@ -660,17 +712,18 @@ class SynthDaemon:
                 ("GET", "/request"): self._route_request,
                 ("POST", "/drain"): self._route_drain,
                 ("POST", "/sessions/adopt"): self._route_sessions_adopt,
+                ("GET", "/incidents"): self._route_incidents,
+                ("GET", "/archive"): self._route_archive,
             },
         ).start()
         if self.obs is not None:
             # Anomaly evaluation rides the sampler tick (never the
             # request path): each tick snapshots the registry, then
             # grades the watches so /healthz and the status gauges are
-            # at most one interval stale.
-            self.obs.start_sampler(
-                on_tick=self.anomaly.evaluate
-                if self.anomaly is not None else None
-            )
+            # at most one interval stale.  With the archive on, the
+            # same tick also persists the periodic snapshot and runs
+            # the black-box trigger check (`_obs_tick`).
+            self.obs.start_sampler(on_tick=self._obs_tick)
         self._completer = threading.Thread(
             target=self._completer_loop, name="ia-serve-complete",
             daemon=True,
@@ -729,6 +782,9 @@ class SynthDaemon:
         if self.access is not None:
             self.access.close()
             self.access = None
+        if self.archive is not None:
+            self.archive.close()
+            self.archive = None
         if self.journal is not None:
             self.journal.close()
             self.journal = None
@@ -1152,6 +1208,213 @@ class SynthDaemon:
         return 200, _json_bytes(self.obs.window(span)), \
             "application/json"
 
+    # ------------------------------------ archive + black box (r23)
+    def _obs_tick(self) -> None:
+        """The sampler tick's full round-23 itinerary, in order: grade
+        the anomaly watches (round 19, unchanged), persist the
+        periodic archive snapshot when the cadence says so, then run
+        the black-box trigger check.  Never the request hot path —
+        and never lets an archive failure take the sampler down (the
+        archive itself counts-not-raises; this guard covers the
+        bundle assembly)."""
+        report = (self.anomaly.evaluate()
+                  if self.anomaly is not None else None)
+        if self.archive is None:
+            return
+        try:
+            now = time.monotonic()
+            if (self._archive_interval_s > 0
+                    and now - self._archive_last_t
+                    >= self._archive_interval_s):
+                self._archive_last_t = now
+                self._archive_snapshot(anomaly_report=report)
+            self._maybe_capture_incident(report)
+        except Exception:  # noqa: BLE001 - observer never kills
+            import logging
+
+            logging.getLogger("image_analogies_tpu").exception(
+                "telemetry archive tick failed"
+            )
+
+    def _archive_snapshot(self, anomaly_report=None,
+                          final: bool = False) -> bool:
+        """One durable snapshot record: the obs window view (with its
+        generation stamp), the graded SLO report, the anomaly report +
+        the ACTIVE latency baseline (what a successor must resume
+        against), and the lattice/shape-cardinality state."""
+        if self.archive is None:
+            return False
+        if anomaly_report is None and self.anomaly is not None:
+            anomaly_report = self.anomaly.evaluate()
+        return self.archive.append("snapshot", {
+            "final": bool(final),
+            "obs_window": (self.obs.window()
+                           if self.obs is not None else None),
+            "obs_generation": (self.obs.generation
+                               if self.obs is not None else None),
+            "slo": self.slo.evaluate(),
+            "anomaly": anomaly_report,
+            "anomaly_baseline_p99_ms": (
+                self.anomaly.config.baseline_p99_ms
+                if self.anomaly is not None else None
+            ),
+            "lattice": self._lattice_snapshot(),
+            "shape_cardinality": {
+                "raw": len(self._observed_raw_shapes),
+                "bucketed": len(self._observed_shapes),
+            },
+        })
+
+    def _maybe_capture_incident(self, anomaly_report=None) -> \
+            Optional[str]:
+        """The black-box trigger: an SLO objective in fast_burn/
+        exhausted, or a firing anomaly watch, captures ONE bundle
+        (the store rate-limits per trigger kind, so a burn episode
+        that stays hot across many ticks still yields one crime
+        scene).  Captures are also noted in the archive stream, so
+        `ia-synth history` shows incidents inline with the restarts
+        they explain."""
+        if self.incidents is None:
+            return None
+        slo_report = self.slo.evaluate()
+        burning = [
+            o for o in slo_report.get("objectives", [])
+            if o.get("status") in ("fast_burn", "exhausted")
+        ]
+        firing = list((anomaly_report or {}).get("firing") or [])
+        if not burning and not firing:
+            return None
+        trigger = {
+            "kind": "slo_burn" if burning else "anomaly",
+            "objectives": [
+                {"name": o.get("name"), "status": o.get("status"),
+                 "burn_rate": o.get("burn_rate")}
+                for o in burning
+            ],
+            "watches": firing,
+        }
+        inc_id = self.incidents.capture(
+            trigger, self._incident_bundle(slo_report, anomaly_report)
+        )
+        if inc_id is not None and self.archive is not None:
+            self.archive.append("incident", {
+                "id": inc_id, "trigger": trigger,
+            })
+        return inc_id
+
+    def _incident_bundle(self, slo_report,
+                         anomaly_report) -> Dict[str, Any]:
+        """A self-contained crime scene: everything the `ia-synth
+        incident <id>` renderer and a post-mortem need WITHOUT the
+        daemon still being alive."""
+        tail: List[Dict[str, Any]] = []
+        if self.access is not None:
+            from collections import deque as _deque
+
+            from .accesslog import read_entries as _read_entries
+
+            # Bounded tail across every rotation generation — the
+            # round-23 accesslog shift chain is what lets this reach
+            # back past one rotation.
+            tail = list(_deque(
+                _read_entries(self.access.path), maxlen=100
+            ))
+        return {
+            "flight": (self.flight.to_dict(reason="incident")
+                       if self.flight is not None else None),
+            "access_tail": tail,
+            "obs_window": (self.obs.window()
+                           if self.obs is not None else None),
+            "slo": slo_report,
+            "anomaly": anomaly_report,
+            "serving": {
+                "queue_depth": len(self.queue),
+                "inflight": self._inflight,
+                "draining": self._draining.is_set(),
+                "cache": self.cache.snapshot(),
+                "lattice": self._lattice_snapshot(),
+            },
+            "fingerprint": self._fingerprint(),
+        }
+
+    def _fingerprint(self) -> Dict[str, Any]:
+        """Config + backend identity for the bundle: enough to answer
+        "was the incident daemon running the config I think it was"."""
+        import dataclasses as _dc
+
+        backend = None
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:  # noqa: BLE001 - identity is best-effort
+            pass
+        return {
+            "pid": os.getpid(),
+            "boot_id": (self.archive.boot_id
+                        if self.archive is not None else None),
+            "backend": backend,
+            "devices": int(self.mesh.devices.size),
+            "config": (_dc.asdict(self.cfg)
+                       if _dc.is_dataclass(self.cfg)
+                       else str(self.cfg)),
+            "policy": {
+                "max_batch": self.policy.max_batch,
+                "max_wait_ms": self.policy.max_wait_ms,
+                "max_queue_depth": self.admission.max_depth,
+                "pipeline_window": self.pipeline_window,
+            },
+            "state_dir": self.state_dir,
+            "archive_dir": self.archive_dir,
+        }
+
+    def _route_incidents(self, _body, _headers, ctx):
+        """GET /incidents: the black-box index; `?id=` returns one
+        full bundle.  404 (not empty-list) when the archive plane is
+        off — absence of the FEATURE and absence of incidents must be
+        distinguishable to the router's fan-out."""
+        from ..telemetry.archive import list_incidents, load_incident
+
+        if self.incidents is None:
+            return 404, _json_bytes({
+                "error": "incident capture disabled "
+                         "(no --archive-dir)",
+            }), "application/json"
+        inc_id = (ctx.get("query") or {}).get("id") if ctx else None
+        if inc_id:
+            doc = load_incident(self.archive_dir, inc_id)
+            if doc is None:
+                return 404, _json_bytes({
+                    "error": f"incident {inc_id!r} not found",
+                    "id": inc_id,
+                }), "application/json"
+            return 200, _json_bytes(doc), "application/json"
+        return 200, _json_bytes({
+            "archive_dir": self.archive_dir,
+            "incidents": list_incidents(self.archive_dir),
+            **self.incidents.stats(),
+        }), "application/json"
+
+    def _route_archive(self, _body):
+        """GET /archive: live archive stats + what reload resumed —
+        the chaos harness asserts torn-tail tolerance and baseline
+        continuity from exactly this snapshot."""
+        if self.archive is None:
+            return 404, _json_bytes({
+                "error": "telemetry archive disabled "
+                         "(no --archive-dir)",
+            }), "application/json"
+        snap = self.archive.stats()
+        snap["incidents"] = (self.incidents.stats()
+                             if self.incidents is not None else None)
+        snap["anomaly_baseline_p99_ms"] = (
+            self.anomaly.config.baseline_p99_ms
+            if self.anomaly is not None else None
+        )
+        snap["obs_generation"] = (self.obs.generation
+                                  if self.obs is not None else None)
+        return 200, _json_bytes(snap), "application/json"
+
     def _route_request(self, _body, _headers, ctx):
         """GET /request?id=<request_id>: one request's access-log
         record + its flight-recorder events, live over HTTP — the
@@ -1334,6 +1597,15 @@ class SynthDaemon:
             logging.getLogger("image_analogies_tpu").exception(
                 "drain snapshot failed (continuing to exit)"
             )
+        if self.archive is not None:
+            # Final archive record BEFORE the flight flush: the
+            # successor's reload reads baselines/generation from the
+            # freshest possible window, and a SIGKILL past this point
+            # loses nothing the archive promised to keep.
+            try:
+                self._archive_snapshot(final=True)
+            except Exception:  # noqa: BLE001 - drain must terminate
+                pass
         if self.flight is not None:
             try:
                 # Sticky "drain" label: distinguishes a graceful

@@ -279,6 +279,7 @@ class FleetRouter:
                 ("GET", "/replicas"): self._route_replicas,
                 ("GET", "/slo"): self._route_slo,
                 ("GET", "/request"): self._route_request,
+                ("GET", "/incidents"): self._route_incidents,
                 ("POST", "/replicas/add"): self._route_add,
                 ("POST", "/replicas/remove"): self._route_remove,
                 ("POST", "/drain_replica"): self._route_drain_replica,
@@ -924,6 +925,62 @@ class FleetRouter:
             "kind": "router",
             "request": entry,
             "flight_events": events,
+        }), "application/json"
+
+    def _route_incidents(self, _body, _headers, ctx):
+        """GET /incidents: the fleet's black-box index — fan out to
+        every replica's /incidents (round 23, telemetry/archive.py)
+        and merge, tagged by replica.  `?id=` proxies one full bundle
+        from whichever replica has it.  A replica with the archive
+        plane off (404) or unreachable mid-fan-out is stated per
+        replica, never silently dropped — same honesty rule as the
+        observatory's degraded-fleet scrape."""
+        inc_id = (ctx.get("query") or {}).get("id") if ctx else None
+        with self._lock:
+            handles = list(self._replicas.values())
+        if inc_id:
+            import urllib.parse as _parse
+
+            q = _parse.quote(inc_id, safe="")
+            errors = []
+            for h in handles:
+                try:
+                    doc = _http_json(
+                        f"{h.url}/incidents?id={q}", 10.0
+                    )
+                except (urllib.error.URLError, OSError, ValueError) \
+                        as e:
+                    errors.append(
+                        f"{h.name}: {type(e).__name__}: {e}"
+                    )
+                    continue
+                doc["replica"] = h.name
+                return 200, _json_bytes(doc), "application/json"
+            return 404, _json_bytes({
+                "error": f"incident {inc_id!r} unknown to every "
+                         "replica",
+                "id": inc_id,
+                "errors": errors,
+            }), "application/json"
+        merged = []
+        for h in handles:
+            rec: Dict[str, Any] = {
+                "replica": h.name, "url": h.url, "error": None,
+                "incidents": [],
+            }
+            try:
+                doc = _http_json(f"{h.url}/incidents", 10.0)
+                rec["incidents"] = doc.get("incidents") or []
+                rec["captured"] = doc.get("captured")
+                rec["suppressed"] = doc.get("suppressed")
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                rec["error"] = f"{type(e).__name__}: {e}"
+            merged.append(rec)
+        return 200, _json_bytes({
+            "replicas": merged,
+            "incidents_total": sum(
+                len(r["incidents"]) for r in merged
+            ),
         }), "application/json"
 
     def _route_add(self, body):

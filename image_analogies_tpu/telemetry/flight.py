@@ -96,6 +96,10 @@ FLUSH_REASONS = (
     # from "sigterm" so a post-mortem can tell a planned takeover from
     # a kill even though both may begin with the same signal.
     "drain",
+    # Round 23: the dump was cut INTO a black-box incident bundle
+    # (telemetry/archive.py) while the process kept running — evidence
+    # capture, not a lifecycle event.
+    "incident",
 )
 
 class FlightRecorder:

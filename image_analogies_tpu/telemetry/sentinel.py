@@ -142,6 +142,11 @@ _OVERHEAD_GAUGES = (
     # per proxied request), measured by tools/serve_load.py's paired
     # traced/bare router arms (min-paired-delta).
     "ia_route_trace_overhead_frac",
+    # Round 23: the durable telemetry archive write path (periodic
+    # snapshot appends + incident capture), self-measured by
+    # telemetry/archive.py and independently re-measured by
+    # tools/archive_drill.py's paired on/off arms (min-paired-delta).
+    "ia_archive_overhead_frac",
 )
 
 # Straggler watch (round 10): a level whose slowest shard finishes

@@ -220,12 +220,22 @@ class TimeSeriesRing:
     sees fresh watch gauges without a scrape-ordering dependency)."""
 
     def __init__(self, registry: MetricsRegistry,
-                 interval_s: float = 5.0, capacity: int = 120):
+                 interval_s: float = 5.0, capacity: int = 120,
+                 generation: int = 0):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1 ({capacity})")
         self.registry = registry
         self.interval_s = float(interval_s)
         self.capacity = int(capacity)
+        # Round-23 monotonic epoch stamp: every window view (and hence
+        # every archived snapshot) carries the generation the ring was
+        # in when it was cut.  `reset()` increments it, and a daemon
+        # restarted with `--archive-dir` seeds PAST the archived value
+        # (`seed_generation`), so an archive reader can tell an
+        # in-process counter reset (same boot, generation bump) from a
+        # restart (new boot id) — and generations never run backwards
+        # across either.
+        self.generation = int(generation)
         self._snaps: "deque[Tuple[float, Dict]]" = deque(
             maxlen=self.capacity
         )
@@ -257,11 +267,23 @@ class TimeSeriesRing:
         `rebase` (default) immediately snapshots the current registry
         as the new epoch's base — without it, traffic arriving before
         the sampler's next tick would be absorbed INTO the base and
-        vanish from every window's delta."""
+        vanish from every window's delta.
+
+        Each reset advances `generation`: the dropped history is
+        STATED on every subsequent window view, never silent."""
         with self._lock:
             self._snaps.clear()
+            self.generation += 1
         if rebase:
             self.tick(now=now)
+
+    def seed_generation(self, generation: int) -> None:
+        """Raise the epoch stamp to at least `generation` (monotonic —
+        never lowers it): the archive-reload path calls this with
+        `archived generation + 1` so post-restart windows are stamped
+        strictly after every pre-restart one."""
+        with self._lock:
+            self.generation = max(self.generation, int(generation))
 
     def window(self, span_s: Optional[float] = None) -> Dict[str, Any]:
         with self._lock:
@@ -270,6 +292,7 @@ class TimeSeriesRing:
         view["interval_s"] = self.interval_s
         view["capacity"] = self.capacity
         view["ticks_total"] = self._ticks_total
+        view["generation"] = self.generation
         return view
 
     # -- sampler ------------------------------------------------------

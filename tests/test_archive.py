@@ -1,0 +1,453 @@
+"""Durable telemetry archive + black-box tests (round 23): the
+segmented append-only archive (telemetry/archive.py) — shift-chain
+rotation, torn-tail-tolerant reload, resume-state replay, compaction —
+the incident store's rate limiting and disk-budget janitor, the
+accesslog N-generation shift chain (round-23 satellite), the
+observatory ring's monotonic generation stamp, the `ia-synth history`
+degraded-fleet honesty rule, the ARCHIVE validator
+(tools/check_archive.py), and the COMMITTED ARCHIVE_r23.json artifact.
+
+Everything here is unit-level — no daemon subprocess, no jit, no
+clock waits.  The end-to-end restart/kill/capture claims live in
+tools/archive_drill.py and tools/chaos_serve.py (`archive_torn_
+reload` arm), whose committed record this file re-validates."""
+
+import argparse
+import copy
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "tools")
+)
+
+from check_archive import main as check_archive_main  # noqa: E402
+from check_archive import validate_archive  # noqa: E402
+
+from image_analogies_tpu.runtime.faults import FaultPlan  # noqa: E402
+from image_analogies_tpu.serving.accesslog import (  # noqa: E402
+    AccessLog,
+    read_entries,
+)
+from image_analogies_tpu.telemetry.archive import (  # noqa: E402
+    ARCHIVE_SCHEMA_VERSION,
+    IncidentStore,
+    TelemetryArchive,
+    archive_path,
+    list_incidents,
+    load_incident,
+    load_resume_state,
+    read_archive_entries,
+)
+from image_analogies_tpu.telemetry.flight import (  # noqa: E402
+    FLUSH_REASONS,
+)
+from image_analogies_tpu.telemetry.metrics import (  # noqa: E402
+    MetricsRegistry,
+)
+from image_analogies_tpu.telemetry.timeseries import (  # noqa: E402
+    TimeSeriesRing,
+)
+
+_ARTIFACT = os.path.join(
+    os.path.dirname(__file__), "..", "ARCHIVE_r23.json"
+)
+
+
+def _snapshot_payload(gen=0, baseline=50.0, p99=12.5,
+                      verdict="meeting", final=False):
+    return {
+        "final": final,
+        "obs_generation": gen,
+        "anomaly_baseline_p99_ms": baseline,
+        "slo": {
+            "verdict": verdict,
+            "objectives": [
+                {"name": "latency_p99", "kind": "latency",
+                 "status": "ok", "observed_p99_ms": p99},
+            ],
+        },
+        "anomaly": {"verdict": "ok", "firing": []},
+    }
+
+
+# ------------------------------------------------------ TelemetryArchive
+class TestTelemetryArchive:
+    def test_boot_record_and_stamps(self, tmp_path):
+        arch = TelemetryArchive(str(tmp_path))
+        arch.append("snapshot", _snapshot_payload())
+        arch.close()
+        recs = list(read_archive_entries(str(tmp_path)))
+        assert [r["kind"] for r in recs] == ["boot", "snapshot"]
+        for i, rec in enumerate(recs):
+            assert rec["schema_version"] == ARCHIVE_SCHEMA_VERSION
+            assert rec["boot_id"] == arch.boot_id
+            assert rec["seq"] == i
+            assert isinstance(rec["ts"], float)
+        # The boot record states what reload found (nothing, here).
+        assert recs[0]["resumed"]["records"] == 0
+        assert recs[0]["resumed"]["boots"] == 0
+
+    def test_shift_chain_rotation_keeps_generations(self, tmp_path):
+        arch = TelemetryArchive(
+            str(tmp_path), max_bytes=1024, generations=3
+        )
+        n = 40  # ~200 B/record -> several seals at max_bytes=1024
+        for i in range(n):
+            assert arch.append("note", {"i": i, "pad": "x" * 120})
+        arch.close()
+        path = archive_path(str(tmp_path))
+        assert arch.sealed >= 3
+        assert os.path.exists(f"{path}.1")
+        assert os.path.exists(f"{path}.2")
+        # The chain is bounded: nothing ever shifts past .generations.
+        assert not os.path.exists(f"{path}.{arch.generations + 1}")
+        notes = [r for r in read_archive_entries(str(tmp_path))
+                 if r["kind"] == "note"]
+        # Oldest generations dropped off the end; what remains is the
+        # NEWEST contiguous suffix, still in order.
+        idx = [r["i"] for r in notes]
+        assert idx == sorted(idx)
+        assert idx[-1] == n - 1
+        assert len(idx) < n  # something aged out -> bounded disk
+
+    def test_max_age_seals_stale_segment(self, tmp_path):
+        arch = TelemetryArchive(str(tmp_path), max_age_s=0.0)
+        arch.append("note", {"i": 0})  # oldest_t set by the boot rec
+        arch.close()
+        assert arch.sealed >= 1
+
+    def test_torn_tail_skipped_and_counted(self, tmp_path):
+        arch = TelemetryArchive(str(tmp_path))
+        arch.append("snapshot", _snapshot_payload(gen=4,
+                                                  baseline=75.0))
+        arch.close()
+        with open(archive_path(str(tmp_path)), "ab") as f:
+            f.write(b'{"kind":"snapshot","boot_id":"torn')
+        state = load_resume_state(str(tmp_path))
+        assert state["skipped_lines"] == 1
+        assert state["records"] == 2
+        assert state["baseline_p99_ms"] == 75.0
+        assert state["generation"] == 4
+
+    def test_write_error_counted_not_raised(self, tmp_path):
+        arch = TelemetryArchive(str(tmp_path))
+        os.close(arch._fd)  # the next write hits EBADF
+        assert arch.append("note", {"i": 0}) is False
+        assert arch.errors == 1
+        arch._fd = None  # don't double-close
+
+    def test_compact_keeps_newest_per_kind(self, tmp_path):
+        arch = TelemetryArchive(str(tmp_path))
+        for i in range(5):
+            arch.append("snapshot", _snapshot_payload(gen=i))
+        kept = arch.compact()
+        arch.close()
+        assert kept == 2  # boot + newest snapshot
+        snaps = [r for r in read_archive_entries(str(tmp_path))
+                 if r["kind"] == "snapshot"]
+        assert len(snaps) == 1
+        assert snaps[0]["obs_generation"] == 4
+
+    def test_overhead_gauge_published(self, tmp_path):
+        reg = MetricsRegistry()
+        arch = TelemetryArchive(str(tmp_path), registry=reg)
+        arch.append("note", {"i": 0})
+        arch.close()
+        fams = reg.to_dict()
+        assert "ia_archive_records" in fams
+        assert "ia_archive_overhead_frac" in fams
+        frac = list(
+            fams["ia_archive_overhead_frac"]["values"].values()
+        )[0]
+        assert 0.0 <= frac < 1.0
+
+
+class TestLoadResumeState:
+    def test_empty_dir_states_absence(self, tmp_path):
+        state = load_resume_state(str(tmp_path))
+        assert state["records"] == 0
+        assert state["boots"] == 0
+        assert state["generation"] is None
+        assert state["baseline_p99_ms"] is None
+        assert state["last_snapshot"] is None
+
+    def test_generation_is_max_baseline_is_last(self, tmp_path):
+        arch = TelemetryArchive(str(tmp_path))
+        arch.append("snapshot", _snapshot_payload(gen=3,
+                                                  baseline=10.0))
+        arch.append("snapshot", _snapshot_payload(gen=5,
+                                                  baseline=20.0))
+        arch.close()
+        state = load_resume_state(str(tmp_path))
+        assert state["generation"] == 5
+        assert state["baseline_p99_ms"] == 20.0
+        assert state["last_snapshot"]["obs_generation"] == 5
+
+    def test_boot_lineage_across_restarts(self, tmp_path):
+        a1 = TelemetryArchive(str(tmp_path))
+        a1.append("snapshot", _snapshot_payload())
+        a1.close()
+        a2 = TelemetryArchive(str(tmp_path))
+        # The second boot's reload saw exactly the first boot.
+        assert a2.resumed["boots"] == 1
+        assert a2.resumed["boot_ids"] == [a1.boot_id]
+        a2.close()
+        state = load_resume_state(str(tmp_path))
+        assert state["boots"] == 2
+        assert state["boot_ids"] == [a1.boot_id, a2.boot_id]
+
+    def test_incident_records_counted(self, tmp_path):
+        arch = TelemetryArchive(str(tmp_path))
+        arch.append("incident", {"id": "inc-x",
+                                 "trigger": {"kind": "anomaly"}})
+        arch.close()
+        assert load_resume_state(str(tmp_path))["incidents"] == 1
+
+
+# --------------------------------------------------------- IncidentStore
+class TestIncidentStore:
+    def _bundle(self):
+        return {
+            "flight": {"events": []}, "access_tail": [],
+            "obs_window": {"status": "ok"}, "slo": {},
+            "anomaly": {}, "serving": {}, "fingerprint": {"pid": 1},
+        }
+
+    def test_capture_roundtrip_and_listing(self, tmp_path):
+        store = IncidentStore(str(tmp_path))
+        trig = {"kind": "anomaly", "watches": ["latency_p99"],
+                "objectives": []}
+        inc_id = store.capture(trig, self._bundle())
+        assert inc_id is not None
+        doc = load_incident(str(tmp_path), inc_id)
+        assert doc["kind"] == "incident_bundle"
+        assert doc["trigger"] == trig
+        assert doc["fingerprint"] == {"pid": 1}
+        listing = list_incidents(str(tmp_path))
+        assert [s["id"] for s in listing] == [inc_id]
+        assert listing[0]["trigger_kind"] == "anomaly"
+        assert listing[0]["watches"] == ["latency_p99"]
+
+    def test_rate_limit_is_per_trigger_kind(self, tmp_path):
+        store = IncidentStore(str(tmp_path), min_interval_s=3600)
+        assert store.capture({"kind": "anomaly"},
+                             self._bundle()) is not None
+        # Same episode, same kind: suppressed, counted.
+        assert store.capture({"kind": "anomaly"},
+                             self._bundle()) is None
+        assert store.suppressed == 1
+        # A DIFFERENT kind is a different episode.
+        assert store.capture({"kind": "slo_burn"},
+                             self._bundle()) is not None
+        assert store.captured == 2
+
+    def test_janitor_bounds_count(self, tmp_path):
+        store = IncidentStore(str(tmp_path), min_interval_s=0.0,
+                              max_count=2)
+        ids = [store.capture({"kind": "anomaly"}, self._bundle())
+               for _ in range(4)]
+        assert all(ids)
+        left = [s["id"] for s in list_incidents(str(tmp_path))]
+        assert len(left) == 2
+        assert left == ids[-2:]  # oldest reaped first
+        assert store.reaped == 2
+
+    def test_load_incident_sanitizes_id(self, tmp_path):
+        store = IncidentStore(str(tmp_path))
+        store.capture({"kind": "anomaly"}, self._bundle())
+        assert load_incident(str(tmp_path),
+                             "../../../etc/passwd") is None
+
+    def test_unreadable_bundle_listed_as_error(self, tmp_path):
+        store = IncidentStore(str(tmp_path))
+        with open(os.path.join(store.dir, "inc-bad.json"), "w") as f:
+            f.write("{torn")
+        listing = list_incidents(str(tmp_path))
+        assert listing and "error" in listing[0]  # never dropped
+
+
+# ------------------------------------------ accesslog shift chain (r23)
+class TestAccessLogShiftChain:
+    def test_generations_validated(self, tmp_path):
+        with pytest.raises(ValueError):
+            AccessLog(str(tmp_path / "a.jsonl"), generations=0)
+
+    def test_shift_chain_and_ordered_read(self, tmp_path):
+        path = str(tmp_path / "access.jsonl")
+        log = AccessLog(path, max_bytes=1024, generations=4)
+        n = 60
+        for i in range(n):
+            log.log({"request_id": f"r{i:03d}", "pad": "x" * 100})
+        log.close()
+        assert os.path.exists(f"{path}.1")
+        assert os.path.exists(f"{path}.2")
+        assert not os.path.exists(f"{path}.5")
+        got = [r["request_id"] for r in read_entries(path)]
+        # Oldest-first across generations, newest entry last, and the
+        # retained span is the newest contiguous suffix.
+        assert got == sorted(got)
+        assert got[-1] == f"r{n - 1:03d}"
+        assert len(got) > n // 2
+
+    def test_single_generation_still_rotates(self, tmp_path):
+        path = str(tmp_path / "access.jsonl")
+        log = AccessLog(path, max_bytes=1024, generations=1)
+        for i in range(40):
+            log.log({"i": i, "pad": "x" * 100})
+        log.close()
+        assert os.path.exists(f"{path}.1")
+        assert not os.path.exists(f"{path}.2")
+
+
+# --------------------------------------- timeseries generation (r23)
+class TestTimeSeriesGeneration:
+    def test_reset_and_seed_matrix(self):
+        ring = TimeSeriesRing(MetricsRegistry(), interval_s=60)
+        assert ring.window()["generation"] == 0
+        ring.tick(now=1.0)
+        ring.tick(now=2.0)
+        assert ring.window()["generation"] == 0  # ticks don't bump
+        ring.reset(now=3.0)
+        assert ring.generation == 1
+        assert ring.window()["generation"] == 1
+        # Reload seeding is monotonic: raises, never lowers.
+        ring.seed_generation(5)
+        assert ring.generation == 5
+        ring.seed_generation(3)
+        assert ring.generation == 5
+        ring.reset(now=4.0)
+        assert ring.generation == 6
+
+    def test_ctor_generation(self):
+        ring = TimeSeriesRing(MetricsRegistry(), generation=7)
+        assert ring.window()["generation"] == 7
+
+
+# -------------------------------------------- history CLI honesty (r23)
+class TestHistoryCli:
+    def _populate(self, d):
+        arch = TelemetryArchive(str(d))
+        arch.append("snapshot", _snapshot_payload(gen=1))
+        arch.close()
+
+    def _args(self, d, **kw):
+        kw.setdefault("archive_dir", str(d))
+        kw.setdefault("targets", None)
+        kw.setdefault("timeout", 0.2)
+        kw.setdefault("format", "text")
+        return argparse.Namespace(**kw)
+
+    def test_degraded_target_warns_never_drops(self, tmp_path,
+                                               capsys):
+        from image_analogies_tpu.cli import cmd_history
+
+        self._populate(tmp_path)
+        # Port 9 (discard) refuses immediately: the replica is down
+        # but its archive is present — history must render WITH the
+        # warning, exit 0.
+        rc = cmd_history(
+            self._args(tmp_path, targets="127.0.0.1:9")
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "WARNING (fleet degraded)" in out
+        assert "rendered from the archive only" in out
+        assert "boot " in out  # the lineage still rendered
+
+    def test_healthy_run_has_no_warning(self, tmp_path, capsys):
+        from image_analogies_tpu.cli import cmd_history
+
+        self._populate(tmp_path)
+        rc = cmd_history(self._args(tmp_path))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "WARNING" not in out
+
+    def test_restart_diff_rendered(self, tmp_path, capsys):
+        from image_analogies_tpu.cli import cmd_history
+
+        a1 = TelemetryArchive(str(tmp_path))
+        a1.append("snapshot", _snapshot_payload(gen=1, p99=10.0))
+        a1.close()
+        a2 = TelemetryArchive(str(tmp_path))
+        a2.append("snapshot", _snapshot_payload(gen=2, p99=20.0))
+        a2.close()
+        rc = cmd_history(self._args(tmp_path))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "restart diff" in out
+        assert "baseline carried" in out
+
+    def test_json_mode_and_empty_archive(self, tmp_path, capsys):
+        from image_analogies_tpu.cli import cmd_history
+
+        self._populate(tmp_path)
+        rc = cmd_history(self._args(tmp_path, format="json",
+                                    targets="127.0.0.1:9"))
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert len(doc["boots"]) == 1
+        assert doc["warnings"]  # degradation stated in json too
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert cmd_history(self._args(empty)) == 1
+
+
+# ------------------------------------------------- fault-plan grammar
+class TestArchiveFaultGrammar:
+    def test_archive_crash_fail_parses(self):
+        plan = FaultPlan.parse("archive_crash:3:fail")
+        assert plan.armed() == [("archive_crash", 3, "fail")]
+
+    def test_archive_crash_rejects_other_actions(self):
+        with pytest.raises(ValueError):
+            FaultPlan.parse("archive_crash:0:hang")
+
+    def test_incident_is_a_flight_reason(self):
+        assert "incident" in FLUSH_REASONS
+
+
+# ------------------------------------- validator + committed artifact
+class TestArchiveArtifact:
+    def _load(self):
+        with open(_ARTIFACT) as f:
+            return json.load(f)
+
+    def test_committed_artifact_validates(self):
+        assert os.path.exists(_ARTIFACT), (
+            "ARCHIVE_r23.json is missing — regenerate with "
+            "`JAX_PLATFORMS=cpu python tools/archive_drill.py`"
+        )
+        assert check_archive_main([_ARTIFACT]) == 0, (
+            "committed ARCHIVE_r23.json no longer validates — "
+            "regenerate with `JAX_PLATFORMS=cpu python "
+            "tools/archive_drill.py` and commit the result"
+        )
+
+    @pytest.mark.parametrize("mutate,needle", [
+        (lambda r: r.update(baseline_continuity=0.0),
+         "baseline_continuity"),
+        (lambda r: r.update(capture_completeness=0.5),
+         "capture_completeness"),
+        (lambda r: r.update(captured_bundles=2), "captured_bundles"),
+        (lambda r: r.update(archive_overhead_frac=0.5),
+         "archive_overhead_frac"),
+        (lambda r: r.update(torn_reload_clean=0.0),
+         "torn_reload_clean"),
+        (lambda r: r["arms"].pop(), "archive_torn_reload"),
+        (lambda r: r["arms"][2].update(skipped_lines=0),
+         "skipped_lines"),
+        (lambda r: r["arms"][1].update(rate_limited=False),
+         "rate_limited"),
+        (lambda r: r["arms"][0].update(watch_graded=False),
+         "no_data"),
+    ])
+    def test_tampered_artifact_rejected(self, mutate, needle):
+        bad = copy.deepcopy(self._load())
+        mutate(bad)
+        errs = validate_archive(bad)
+        assert errs and any(needle in e for e in errs), errs

@@ -1,0 +1,372 @@
+#!/usr/bin/env python
+"""Measure the round-23 durable-telemetry acceptance cells into
+ARCHIVE_r23.json.
+
+Three subprocess arms against real `ia-synth serve` daemons on the
+24px proxy, reusing the chaos harness's spawn/burst plumbing
+(tools/chaos_serve.py):
+
+  restart_continuity  boot 1 runs with `--baseline` + `--archive-dir`,
+                      serves traffic, drains gracefully; boot 2 gets
+                      ONLY `--archive-dir` and must resume the
+                      anomaly baseline from disk (latency watch grades
+                      — never no_data), stamp a strictly later
+                      observatory generation, and render the restart
+                      lineage through `ia-synth history`.
+  incident_capture    a deliberately impossible baseline makes the
+                      latency watch fire on the first graded window;
+                      the black box must capture EXACTLY ONE bundle
+                      (later ticks rate-limited, counted as
+                      suppressed) containing every required section,
+                      renderable by `ia-synth incident <id>`, with the
+                      trigger->bundle latency measured.
+  archive_torn_reload the SIGKILL-mid-append chaos arm, imported from
+                      tools/chaos_serve.py: a torn half-line on disk
+                      must be skipped AND counted on reload, with
+                      baselines still resuming.
+
+The headline `archive_overhead_frac` cell is the LARGEST live
+`overhead_frac` any drilled daemon reported on `GET /archive`
+(cumulative seconds inside archive writes over process wall — the
+same measurement the `ia_archive_overhead_frac` gauge publishes and
+the sentinel pins), held under the shared 2% telemetry budget by
+tools/check_archive.py and trended by tools/check_trajectory.py.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/archive_drill.py \
+        [--out ARCHIVE_r23.json] [--size 24]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+import chaos_serve as cs  # noqa: E402 - path bootstrap above
+
+ARCHIVE_DRILL_SCHEMA_VERSION = 1
+
+# Sections an incident bundle must carry to be a self-contained crime
+# scene (serving/daemon.py `_incident_bundle` + the store's stamps).
+BUNDLE_REQUIRED_KEYS = (
+    "id", "ts", "trigger", "flight", "access_tail", "obs_window",
+    "slo", "anomaly", "serving", "fingerprint",
+)
+
+# Fast archive/observatory cadence so a drill boot snapshots within a
+# second instead of the serving defaults (30 s / 5 s).
+_ARCHIVE_FLAGS = ["--archive-interval-s", "0.2", "--obs-interval-s",
+                  "0.2", "--drain-deadline-s", "60"]
+
+
+def _baseline_record(path: str, p99_ms: float) -> str:
+    with open(path, "w") as f:
+        json.dump({"pipeline": {"p99_warm_ms": p99_ms}}, f)
+    return path
+
+
+def _drain(url: str) -> int:
+    req = urllib.request.Request(
+        url + "/drain", data=b"{}", method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return resp.status
+
+
+def _latency_watch(slo_doc: dict):
+    for w in (slo_doc.get("anomalies") or {}).get("watches") or []:
+        if w.get("watch") == "latency_p99":
+            return w
+    return None
+
+
+def _cli(args, timeout=120):
+    """One `ia-synth` CLI subprocess; returns (rc, stdout)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "image_analogies_tpu.cli", *args],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=timeout,
+    )
+    return proc.returncode, proc.stdout
+
+
+def _arm_restart_continuity(a_path, ap_path, size):
+    _, _, frames = cs._proxy_frames(size, 3)
+    state = tempfile.mkdtemp(prefix="ia_drill_cont_s_")
+    arch = tempfile.mkdtemp(prefix="ia_drill_cont_a_")
+    traces = [tempfile.mkdtemp(prefix="ia_drill_cont_t_")
+              for _ in range(2)]
+    base = _baseline_record(
+        os.path.join(state, "baseline.json"), 50.0
+    )
+    arm = {"name": "restart_continuity", "baseline_p99_ms": 50.0}
+    p1 = p2 = None
+    try:
+        p1, u1 = cs._spawn_serve(
+            a_path, ap_path, traces[0], state_dir=state,
+            extra=[*_ARCHIVE_FLAGS, "--archive-dir", arch,
+                   "--baseline", base],
+        )
+        for f in frames[:2]:
+            cs._post(u1, cs._body(f))
+        time.sleep(0.6)  # >= 2 archive snapshots at the 0.2 s cadence
+        snap1 = cs._get_json(u1 + "/archive")
+        arm["boot1_records"] = snap1.get("records")
+        arm["boot1_overhead_frac"] = snap1.get("overhead_frac")
+        arm["drain_status"] = _drain(u1)
+        p1.wait(timeout=120)
+        arm["boot1_exit_code"] = p1.returncode
+
+        # Boot 2: NO --baseline.  Everything it grades against must
+        # come off the archive.
+        p2, u2 = cs._spawn_serve(
+            a_path, ap_path, traces[1], state_dir=state,
+            extra=[*_ARCHIVE_FLAGS, "--archive-dir", arch],
+        )
+        snap2 = cs._get_json(u2 + "/archive")
+        resumed = snap2.get("resumed") or {}
+        arm.update({
+            "resumed_records": resumed.get("records"),
+            "resumed_boots": resumed.get("boots"),
+            "resumed_generation": resumed.get("generation"),
+            "obs_generation": snap2.get("obs_generation"),
+            "baseline_resumed": bool(
+                snap2.get("anomaly_baseline_p99_ms") == 50.0
+            ),
+            "generation_monotonic": bool(
+                isinstance(resumed.get("generation"), int)
+                and isinstance(snap2.get("obs_generation"), int)
+                and snap2["obs_generation"] > resumed["generation"]
+            ),
+        })
+        cs._post(u2, cs._body(frames[2]))
+        time.sleep(0.8)  # two obs ticks: the window needs >= 2 snaps
+        watch = _latency_watch(cs._get_json(u2 + "/slo"))
+        arm["post_restart_watch"] = watch
+        arm["watch_graded"] = bool(
+            watch is not None and watch.get("status") != "no_data"
+        )
+        arm["boot2_overhead_frac"] = cs._get_json(
+            u2 + "/archive"
+        ).get("overhead_frac")
+        arm["drain2_status"] = _drain(u2)
+        p2.wait(timeout=120)
+        arm["boot2_exit_code"] = p2.returncode
+
+        # The lineage must RENDER: `ia-synth history` over the same
+        # archive dir shows both boots (json mode for the assertion).
+        rc, out = _cli(["history", "--archive-dir", arch,
+                        "--format", "json"])
+        arm["history_rc"] = rc
+        try:
+            arm["history_boots"] = len(json.loads(out).get("boots", []))
+        except ValueError:
+            arm["history_boots"] = None
+        arm["baseline_continuity"] = float(
+            arm["baseline_resumed"] and arm["watch_graded"]
+            and arm["generation_monotonic"]
+            and rc == 0 and (arm["history_boots"] or 0) >= 2
+        )
+        return arm
+    finally:
+        for p in (p1, p2):
+            if p is not None:
+                cs._reap(p)
+        for d in (state, arch, *traces):
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def _arm_incident_capture(a_path, ap_path, size):
+    _, _, frames = cs._proxy_frames(size, 2)
+    state = tempfile.mkdtemp(prefix="ia_drill_inc_s_")
+    arch = tempfile.mkdtemp(prefix="ia_drill_inc_a_")
+    trace = tempfile.mkdtemp(prefix="ia_drill_inc_t_")
+    # A baseline no real request can meet: the latency watch fires on
+    # the first window that grades, which is the black-box trigger.
+    base = _baseline_record(
+        os.path.join(state, "baseline.json"), 0.001
+    )
+    arm = {"name": "incident_capture", "baseline_p99_ms": 0.001}
+    proc = None
+    try:
+        proc, url = cs._spawn_serve(
+            a_path, ap_path, trace, state_dir=state,
+            extra=[*_ARCHIVE_FLAGS, "--archive-dir", arch,
+                   "--baseline", base],
+        )
+        for f in frames:
+            cs._post(url, cs._body(f))
+        t0 = time.monotonic()
+        captured = 0
+        deadline = t0 + 30
+        while time.monotonic() < deadline:
+            idx = cs._get_json(url + "/incidents")
+            captured = idx.get("captured", 0)
+            if captured >= 1:
+                break
+            time.sleep(0.1)
+        arm["capture_latency_ms"] = round(
+            (time.monotonic() - t0) * 1000.0, 3
+        )
+        # Let several more firing ticks elapse: the episode stays hot,
+        # the store must rate-limit every one of them.
+        time.sleep(1.5)
+        idx = cs._get_json(url + "/incidents")
+        arm["captured"] = idx.get("captured")
+        arm["suppressed"] = idx.get("suppressed")
+        arm["rate_limited"] = bool(
+            idx.get("captured") == 1 and idx.get("suppressed", 0) >= 1
+        )
+        incidents = idx.get("incidents") or []
+        arm["trigger_kind"] = (
+            incidents[0].get("trigger_kind") if incidents else None
+        )
+        inc_id = incidents[0]["id"] if incidents else None
+        arm["incident_id"] = inc_id
+        missing = []
+        if inc_id:
+            bundle = cs._get_json(
+                f"{url}/incidents?id={inc_id}"
+            )
+            missing = [
+                k for k in BUNDLE_REQUIRED_KEYS
+                if bundle.get(k) is None
+            ]
+            arm["access_tail_len"] = len(bundle.get("access_tail")
+                                         or [])
+            arm["flight_events"] = len(
+                (bundle.get("flight") or {}).get("events") or []
+            )
+            # The bundle must RENDER, live and from disk — the whole
+            # point of a black box is being readable after the crash.
+            arm["render_url_rc"] = _cli(
+                ["incident", inc_id, "--url", url]
+            )[0]
+            arm["render_disk_rc"] = _cli(
+                ["incident", inc_id, "--archive-dir", arch]
+            )[0]
+        arm["bundle_missing_keys"] = missing
+        arm["capture_completeness"] = float(
+            inc_id is not None and not missing
+            and arm.get("render_url_rc") == 0
+            and arm.get("render_disk_rc") == 0
+        )
+        arm["overhead_frac"] = cs._get_json(
+            url + "/archive"
+        ).get("overhead_frac")
+        arm["drain_status"] = _drain(url)
+        proc.wait(timeout=120)
+        arm["exit_code"] = proc.returncode
+        return arm
+    finally:
+        if proc is not None:
+            cs._reap(proc)
+        for d in (state, arch, trace):
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def run_archive_drill(size: int = 24):
+    from image_analogies_tpu.utils.io import save_image
+
+    a, ap, _ = cs._proxy_frames(size, 0)
+    asset_dir = tempfile.mkdtemp(prefix="ia_drill_assets_")
+    a_path = os.path.join(asset_dir, "a.png")
+    ap_path = os.path.join(asset_dir, "ap.png")
+    save_image(a_path, a)
+    save_image(ap_path, ap)
+    try:
+        cont = _arm_restart_continuity(a_path, ap_path, size)
+        inc = _arm_incident_capture(a_path, ap_path, size)
+        torn = cs._arm_archive_torn(a_path, ap_path, size)
+    finally:
+        shutil.rmtree(asset_dir, ignore_errors=True)
+
+    overheads = [
+        v for v in (
+            cont.get("boot1_overhead_frac"),
+            cont.get("boot2_overhead_frac"),
+            inc.get("overhead_frac"),
+        ) if isinstance(v, (int, float))
+    ]
+    return {
+        "schema_version": ARCHIVE_DRILL_SCHEMA_VERSION,
+        "kind": "archive_drill",
+        "round": 23,
+        "generated_by": "tools/archive_drill.py",
+        "proxy_size": size,
+        "config": {
+            "levels": 2, "matcher": "patchmatch", "em_iters": 1,
+            "pm_iters": 2, "max_batch": 1,
+            "archive_interval_s": 0.2, "obs_interval_s": 0.2,
+        },
+        # Headline cells tools/check_trajectory.py trends.
+        "baseline_continuity": cont.get("baseline_continuity", 0.0),
+        "capture_completeness": inc.get("capture_completeness", 0.0),
+        "captured_bundles": inc.get("captured"),
+        "capture_latency_ms": inc.get("capture_latency_ms"),
+        "archive_overhead_frac": (
+            max(overheads) if overheads else None
+        ),
+        "torn_reload_clean": float(bool(
+            torn.get("reload_clean")
+            and torn.get("baseline_resumed")
+            and torn.get("post_restart_request_ok")
+        )),
+        "generation_monotonic": float(bool(
+            cont.get("generation_monotonic")
+            and torn.get("generation_monotonic")
+        )),
+        "arms": [cont, inc, torn],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="ARCHIVE_r23.json")
+    ap.add_argument("--size", type=int, default=24)
+    args = ap.parse_args(argv)
+    record = run_archive_drill(args.size)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    for arm in record["arms"]:
+        keys = [
+            k for k in (
+                "baseline_continuity", "capture_completeness",
+                "captured", "suppressed", "capture_latency_ms",
+                "reload_clean", "baseline_resumed",
+                "generation_monotonic", "skipped_lines",
+            ) if k in arm
+        ]
+        print(
+            f"{arm['name']:>22}: "
+            + ", ".join(f"{k}={arm[k]}" for k in keys)
+        )
+    print(
+        f"wrote {args.out} (continuity="
+        f"{record['baseline_continuity']}, completeness="
+        f"{record['capture_completeness']}, overhead_frac="
+        f"{record['archive_overhead_frac']})"
+    )
+    from check_archive import validate_archive
+
+    errs = validate_archive(record)
+    for e in errs:
+        print(f"archive_drill: VIOLATION: {e}", file=sys.stderr)
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -635,6 +635,79 @@ def _arm_serve_crash_torn(a_path, ap_path, size):
     return arm
 
 
+def _arm_archive_torn(a_path, ap_path, size):
+    """Round 23: IA_FAULT_PLAN=archive_crash hard-exits the daemon
+    with half an archive snapshot line on disk; a restart with the
+    same --archive-dir must reload cleanly (torn tail skipped and
+    COUNTED), resume the pre-crash anomaly baseline, and stamp its
+    windows with a strictly later observatory generation.  Reused by
+    tools/archive_drill.py for ARCHIVE_r23.json's torn cell."""
+    _, _, frames = _proxy_frames(size, 1)
+    state_dir = tempfile.mkdtemp(prefix="ia_chaos_archt_")
+    arch_dir = tempfile.mkdtemp(prefix="ia_chaos_archd_")
+    trace_a = tempfile.mkdtemp(prefix="ia_chaos_archv_")
+    trace_b = tempfile.mkdtemp(prefix="ia_chaos_archw_")
+    base_path = os.path.join(state_dir, "baseline.json")
+    with open(base_path, "w") as f:
+        json.dump({"pipeline": {"p99_warm_ms": 50.0}}, f)
+    archive_flags = [
+        "--archive-dir", arch_dir,
+        "--archive-interval-s", "0.2", "--obs-interval-s", "0.2",
+    ]
+    # Archive write ordinal 3: past the boot record (seq 0) and at
+    # least two whole snapshots, so the torn tail lands on a snapshot
+    # that already has durable predecessors carrying the baseline.
+    proc, _url = _spawn_serve(
+        a_path, ap_path, trace_a, state_dir=state_dir,
+        extra=[*archive_flags, "--baseline", base_path],
+        env_extra={"IA_FAULT_PLAN": "archive_crash:3:fail"},
+    )
+    arm = {"name": "archive_torn_reload", "torn_line_appended": True}
+    proc2 = None
+    try:
+        proc.wait(timeout=180)
+        arm["crash_exit_code"] = proc.returncode
+    except subprocess.TimeoutExpired:
+        arm["crash_exit_code"] = None
+    finally:
+        _reap(proc)
+    # Belt and braces on top of the fault's own half-line: a second
+    # torn fragment with no newline, as a crash AFTER the buffered
+    # write but before the next would leave.
+    with open(os.path.join(arch_dir, "archive.jsonl"), "ab") as f:
+        f.write(b'{"kind":"snapshot","boot_id":"torn-')
+    try:
+        proc2, url2 = _spawn_serve(
+            a_path, ap_path, trace_b, state_dir=state_dir,
+            extra=archive_flags,  # NO --baseline: must come from disk
+        )
+        snap = _get_json(url2 + "/archive")
+        resumed = snap.get("resumed") or {}
+        arm.update({
+            "reload_clean": bool(resumed.get("records", 0) >= 2),
+            "skipped_lines": resumed.get("skipped_lines"),
+            "boots_before_restart": resumed.get("boots"),
+            "baseline_resumed": bool(
+                snap.get("anomaly_baseline_p99_ms") == 50.0
+            ),
+            "resumed_generation": resumed.get("generation"),
+            "obs_generation": snap.get("obs_generation"),
+            "generation_monotonic": bool(
+                isinstance(resumed.get("generation"), int)
+                and isinstance(snap.get("obs_generation"), int)
+                and snap["obs_generation"] > resumed["generation"]
+            ),
+        })
+        code, _resp, _ = _post(url2, _body(frames[0]))
+        arm["post_restart_request_ok"] = bool(code == 200)
+    finally:
+        if proc2 is not None:
+            _reap(proc2)
+        for d in (state_dir, arch_dir, trace_a, trace_b):
+            shutil.rmtree(d, ignore_errors=True)
+    return arm
+
+
 def _arm_drain_handoff(a_path, ap_path, size):
     """POST /drain with a request in flight: in-flight 200 delivered,
     new request 503 + Retry-After, exit 0, flight reason drain."""
@@ -814,6 +887,11 @@ def run_chaos_serve(size: int = 24):
         arms.append(_arm_kill_midburst(a_path, ap_path, size))
         arms.append(_arm_serve_crash_torn(a_path, ap_path, size))
         arms.append(_arm_lattice_shape_burst(a_path, ap_path, size))
+        # Round 23: telemetry-archive SIGKILL-mid-append arm.  Not a
+        # headline cell (the committed CHAOS_SERVE_r16.json predates
+        # it; the validator checks required arms by name and ignores
+        # extras) — ARCHIVE_r23.json carries its acceptance floor.
+        arms.append(_arm_archive_torn(a_path, ap_path, size))
     finally:
         shutil.rmtree(asset_dir, ignore_errors=True)
 
@@ -865,6 +943,8 @@ def main(argv=None) -> int:
                 "acked_loss", "replay_bit_identical", "exit_code",
                 "response_ok", "bounded", "survived", "honest_miss",
                 "inflight_delivered", "new_request_503",
+                "reload_clean", "baseline_resumed",
+                "generation_monotonic",
             ) if k in arm
         ]
         print(

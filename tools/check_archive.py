@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Validate an ARCHIVE_r23.json durable-telemetry artifact (round 23).
+
+The black-box acceptance bar, enforced by a validator instead of
+trusted to prose:
+
+  - baseline continuity: a daemon restarted with only `--archive-dir`
+    resumes its anomaly watches against the PRE-restart baseline (the
+    latency watch grades, never no_data), stamps a strictly later
+    observatory generation, and the lineage renders through
+    `ia-synth history`;
+  - black-box capture: an induced anomaly episode yields EXACTLY ONE
+    incident bundle — later firing ticks rate-limited and COUNTED as
+    suppressed — containing every required section and renderable by
+    `ia-synth incident <id>` both live (--url) and post-mortem
+    (--archive-dir);
+  - torn-tail tolerance: a SIGKILL mid-archive-append leaves a torn
+    half-line that reload SKIPS and COUNTS, with baselines still
+    resuming (the chaos arm from tools/chaos_serve.py);
+  - bounded overhead: the archive write path's self-measured wall
+    fraction stays under the shared 2% telemetry budget.
+
+Usage:
+    python tools/check_archive.py ARCHIVE_r23.json
+
+Runs under pytest too (tests/test_archive.py validates the COMMITTED
+artifact) so tier-1 fails if the record is missing, truncated, or
+claims a continuity it cannot show.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+ARCHIVE_DRILL_SCHEMA_VERSION = 1
+
+# The shared telemetry-overhead ceiling (tools/check_sentinel.py's
+# OVERHEAD_BUDGET_FRAC): the archive is one more observability
+# surface, priced under the same budget.
+OVERHEAD_CEILING_FRAC = 0.02
+
+_REQUIRED_ARMS = (
+    "restart_continuity",
+    "incident_capture",
+    "archive_torn_reload",
+)
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_archive(record: dict) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(record, dict):
+        return ["record is not a JSON object"]
+    if record.get("schema_version") != ARCHIVE_DRILL_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {record.get('schema_version')!r} != "
+            f"{ARCHIVE_DRILL_SCHEMA_VERSION}"
+        )
+    if record.get("kind") != "archive_drill":
+        errs.append(f"kind {record.get('kind')!r} != 'archive_drill'")
+    rnd = record.get("round")
+    if not (_num(rnd) and rnd >= 23):
+        errs.append(f"round {rnd!r} is not >= 23")
+    size = record.get("proxy_size")
+    if not (_num(size) and size >= 16):
+        errs.append(f"proxy_size {size!r} is not a size >= 16")
+
+    # Headline floors/ceilings.
+    if record.get("baseline_continuity") != 1.0:
+        errs.append(
+            "baseline_continuity "
+            f"{record.get('baseline_continuity')!r} != 1.0 — a "
+            "restart that forgets its baselines is the cold-start "
+            "the archive exists to prevent"
+        )
+    if record.get("capture_completeness") != 1.0:
+        errs.append(
+            "capture_completeness "
+            f"{record.get('capture_completeness')!r} != 1.0 — an "
+            "incident bundle missing a section is a black box that "
+            "recorded half the flight"
+        )
+    if record.get("captured_bundles") != 1:
+        errs.append(
+            f"captured_bundles {record.get('captured_bundles')!r} "
+            "!= 1 — one burn episode must yield exactly one bundle "
+            "(zero is a miss, more is a rate-limiter failure)"
+        )
+    lat = record.get("capture_latency_ms")
+    if not (_num(lat) and 0 < lat < 60000):
+        errs.append(
+            f"capture_latency_ms {lat!r} is not a positive "
+            "sub-minute wall — the trigger-to-bundle delay is part "
+            "of the claim"
+        )
+    ov = record.get("archive_overhead_frac")
+    if not (_num(ov) and 0 <= ov < OVERHEAD_CEILING_FRAC):
+        errs.append(
+            f"archive_overhead_frac {ov!r} is not under the "
+            f"{OVERHEAD_CEILING_FRAC:.0%} telemetry budget"
+        )
+    if record.get("torn_reload_clean") != 1.0:
+        errs.append(
+            f"torn_reload_clean {record.get('torn_reload_clean')!r} "
+            "!= 1.0 — a torn tail that poisons reload defeats the "
+            "durability idiom"
+        )
+    if record.get("generation_monotonic") != 1.0:
+        errs.append(
+            "generation_monotonic "
+            f"{record.get('generation_monotonic')!r} != 1.0 — window "
+            "epochs must never run backwards across a restart"
+        )
+
+    arms = record.get("arms")
+    if not isinstance(arms, list) or not arms:
+        return errs + ["arms: missing/empty list"]
+    by_name = {
+        arm.get("name"): arm for arm in arms if isinstance(arm, dict)
+    }
+    for need in _REQUIRED_ARMS:
+        if need not in by_name:
+            errs.append(
+                f"arms is missing {need!r} — every continuity claim "
+                "must be exercised"
+            )
+    if set(_REQUIRED_ARMS) - set(by_name):
+        return errs  # per-arm checks need the arms present
+
+    cont = by_name["restart_continuity"]
+    if cont.get("baseline_resumed") is not True:
+        errs.append(
+            "restart_continuity: baseline_resumed is not true — "
+            "boot 2 did not grade against boot 1's baseline"
+        )
+    if cont.get("watch_graded") is not True:
+        errs.append(
+            "restart_continuity: the post-restart latency watch "
+            "reported no_data — the resumed baseline never reached "
+            "the detector"
+        )
+    if not (_num(cont.get("history_boots"))
+            and cont["history_boots"] >= 2):
+        errs.append(
+            f"restart_continuity: history_boots "
+            f"{cont.get('history_boots')!r} < 2 — `ia-synth history` "
+            "did not render the restart lineage"
+        )
+    for k in ("boot1_exit_code", "boot2_exit_code"):
+        if cont.get(k) != 0:
+            errs.append(
+                f"restart_continuity: {k} {cont.get(k)!r} != 0 — "
+                "the drill's graceful drains must exit clean"
+            )
+
+    inc = by_name["incident_capture"]
+    if inc.get("rate_limited") is not True:
+        errs.append(
+            "incident_capture: rate_limited is not true — either no "
+            "later tick was suppressed (the episode ended too soon "
+            "to prove the limiter) or a duplicate bundle was written"
+        )
+    if inc.get("bundle_missing_keys"):
+        errs.append(
+            "incident_capture: bundle is missing sections "
+            f"{inc['bundle_missing_keys']!r}"
+        )
+    for k in ("render_url_rc", "render_disk_rc"):
+        if inc.get(k) != 0:
+            errs.append(
+                f"incident_capture: {k} {inc.get(k)!r} != 0 — "
+                "`ia-synth incident` could not render the bundle"
+            )
+
+    torn = by_name["archive_torn_reload"]
+    if torn.get("torn_line_appended") is not True:
+        errs.append(
+            "archive_torn_reload: torn_line_appended is not true — "
+            "the arm must prove a torn tail is skipped, not absent"
+        )
+    if not (_num(torn.get("skipped_lines"))
+            and torn["skipped_lines"] >= 1):
+        errs.append(
+            f"archive_torn_reload: skipped_lines "
+            f"{torn.get('skipped_lines')!r} — the torn tail must be "
+            "COUNTED on reload, not silently absorbed"
+        )
+    if torn.get("crash_exit_code") != 137:
+        errs.append(
+            "archive_torn_reload: crash_exit_code "
+            f"{torn.get('crash_exit_code')!r} != 137 — the injected "
+            "kill never landed mid-append"
+        )
+    if torn.get("post_restart_request_ok") is not True:
+        errs.append(
+            "archive_torn_reload: the restarted daemon did not "
+            "serve a request after reloading past the torn tail"
+        )
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="ARCHIVE_r23.json to validate")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"check_archive: cannot read {args.path}: {e}")
+        return 1
+    errs = validate_archive(record)
+    if errs:
+        print(f"check_archive: {args.path} INVALID:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print(
+        f"check_archive: {args.path} OK (continuity="
+        f"{record.get('baseline_continuity')}, completeness="
+        f"{record.get('capture_completeness')}, overhead_frac="
+        f"{record.get('archive_overhead_frac')})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,7 +47,7 @@ FLIGHT_SCHEMA_VERSION = 1
 
 _FLIGHT_REASONS = (
     "sigterm", "sigint", "atexit", "violation", "watchdog",
-    "session-end", "manual", "drain",
+    "session-end", "manual", "drain", "incident",
 )
 _FLIGHT_EVENT_KINDS = ("open", "close", "mark")
 

@@ -69,6 +69,7 @@ _OBS_RE = re.compile(r"^OBS_r(\d+)\.json$")
 _LATTICE_RE = re.compile(r"^LATTICE_r(\d+)\.json$")
 _ROUTER_RE = re.compile(r"^ROUTER_r(\d+)\.json$")
 _TRACE_RE = re.compile(r"^TRACE_r(\d+)\.json$")
+_ARCHIVE_RE = re.compile(r"^ARCHIVE_r(\d+)\.json$")
 
 PROVENANCES = ("measured", "carried", "modeled")
 
@@ -273,6 +274,32 @@ TRACE_SERIES: Tuple[Dict, ...] = (
               "(min-paired-delta, traced vs bare router)"},
 )
 
+# ARCHIVE artifacts (round 23: tools/archive_drill.py) carry the
+# durable-telemetry headlines: baseline continuity and incident-bundle
+# completeness are ABSOLUTE invariants (floor 1.0 — a restart that
+# forgets its baselines, or a black box missing a section, is a
+# regression no trend tolerance excuses), and the archive write-path
+# overhead fraction rides the same 2% telemetry budget as the
+# observatory/trace surfaces (loose trend — rel_tol 1.0 + abs_tol
+# 0.01, because the self-measured fraction on a quiet drill daemon is
+# near-zero and noisy — with the hard ceiling as the real gate,
+# re-stated here so a future check_archive edit cannot silently drop
+# it from history).
+ARCHIVE_SERIES: Tuple[Dict, ...] = (
+    {"field": "baseline_continuity", "direction": "higher",
+     "abs_tol": 0.0, "floor": 1.0, "since": 23,
+     "label": "restart baseline/generation continuity (1.0 = the "
+              "restarted daemon grades against pre-restart state)"},
+    {"field": "capture_completeness", "direction": "higher",
+     "abs_tol": 0.0, "floor": 1.0, "since": 23,
+     "label": "incident-bundle completeness (1.0 = every required "
+              "section present and renderable)"},
+    {"field": "archive_overhead_frac", "direction": "lower",
+     "rel_tol": 1.0, "abs_tol": 0.01, "ceiling": 0.02, "since": 23,
+     "label": "archive write-path overhead fraction (live "
+              "ia_archive_overhead_frac gauge, worst drilled boot)"},
+)
+
 # SCALE rows are keyed by size; each series is tracked per size.
 SCALE_SERIES: Tuple[Dict, ...] = (
     {"field": "wall_s", "direction": "lower", "rel_tol": 0.10,
@@ -390,7 +417,7 @@ def _flatten_serve_persist(rec):
 
 def load_history(root: str):
     """(bench, scale, video, slo, chaos_serve, mesh2d, serve_persist,
-    obs, lattice, router, trace) lists of
+    obs, lattice, router, trace, archive) lists of
     (round, filename, payload), round-sorted.  BENCH payloads unwrap the driver's capture wrapper
     to the parsed record.  Builder probe files (BENCH_r*_builder*.json)
     do not match the round pattern and are deliberately out of scope —
@@ -407,6 +434,7 @@ def load_history(root: str):
     lattice = []
     router = []
     trace = []
+    archive = []
     for name in sorted(os.listdir(root)):
         m = _BENCH_RE.match(name)
         if m:
@@ -467,6 +495,10 @@ def load_history(root: str):
         if m:
             with open(os.path.join(root, name)) as f:
                 trace.append((int(m.group(1)), name, json.load(f)))
+        m = _ARCHIVE_RE.match(name)
+        if m:
+            with open(os.path.join(root, name)) as f:
+                archive.append((int(m.group(1)), name, json.load(f)))
     bench.sort(key=lambda t: t[0])
     scale.sort(key=lambda t: t[0])
     video.sort(key=lambda t: t[0])
@@ -478,8 +510,9 @@ def load_history(root: str):
     lattice.sort(key=lambda t: t[0])
     router.sort(key=lambda t: t[0])
     trace.sort(key=lambda t: t[0])
+    archive.sort(key=lambda t: t[0])
     return (bench, scale, video, slo, chaos_serve, mesh2d,
-            serve_persist, obs, lattice, router, trace)
+            serve_persist, obs, lattice, router, trace, archive)
 
 
 # ------------------------------------------------------ schema (by era)
@@ -710,8 +743,8 @@ def check_series(
 def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
     """All schema + trajectory checks over the committed history.
     Returns (violations, machine-readable report rows)."""
-    (bench, scale, video, slo, chaos_serve, mesh2d,
-     serve_persist, obs, lattice, router, trace) = load_history(root)
+    (bench, scale, video, slo, chaos_serve, mesh2d, serve_persist,
+     obs, lattice, router, trace, archive) = load_history(root)
     errs: List[str] = []
     report: List[Dict] = []
 
@@ -785,6 +818,15 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
             f"{name}: {e}" for e in validate_fleet_trace(rec)
         )
 
+    for rnd, name, rec in archive:
+        # Durable-telemetry artifacts carry their full contract — the
+        # restart-continuity floors, the exactly-one-bundle capture
+        # gate, torn-tail tolerance and the overhead ceiling — in
+        # check_archive.
+        from check_archive import validate_archive
+
+        errs.extend(f"{name}: {e}" for e in validate_archive(rec))
+
     for decl in BENCH_SERIES:
         check_series(
             decl, [(r, n, rec) for r, n, rec in bench],
@@ -851,6 +893,12 @@ def check_trajectory(root: str) -> Tuple[List[str], List[Dict]]:
                     (rec.get("overhead") or {}).get("frac"),
             }) for r, n, rec in trace],
             f"trace.{decl['field']}", errs, report,
+        )
+    for decl in ARCHIVE_SERIES:
+        # The durable-telemetry headline cells are top-level.
+        check_series(
+            decl, [(r, n, rec) for r, n, rec in archive],
+            f"archive.{decl['field']}", errs, report,
         )
     def _rows(data):
         rows = data.get("rows") if isinstance(data, dict) else None
